@@ -1,0 +1,9 @@
+"""dalle_tpu — a TPU-native (JAX/XLA/Pallas/pjit) text→image framework with the
+full capability surface of maroomir/DALLE-pytorch, designed from scratch for the
+MXU/HBM/ICI rather than translated from CUDA. See SURVEY.md for the blueprint."""
+
+__version__ = "0.1.0"
+
+from .config import (MeshConfig, PrecisionConfig, DVAEConfig, TransformerConfig,
+                     DalleConfig, ClipConfig, VQGANConfig, OptimConfig,
+                     TrainConfig, AnnealConfig)

@@ -1,0 +1,385 @@
+"""Typed configuration tree for the whole framework.
+
+One dataclass tree serves the three roles the reference spreads over argparse flags,
+in-script DeepSpeed config dicts, and checkpoint-embedded hparams
+(reference: legacy/train_dalle.py:88-138, 481-500, 535-582):
+
+  * CLI: every leaf field can be set from the command line via ``add_args``/``from_args``.
+  * Run config: the config object is what models/trainers consume.
+  * Checkpoint metadata: ``to_dict``/``from_dict`` round-trip losslessly, so model
+    identity travels inside the checkpoint exactly like the reference's ``hparams``.
+
+Design is TPU-first: configs carry mesh/sharding/precision fields that have no
+reference counterpart (the reference is data-parallel only, SURVEY.md §2.6).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+from dataclasses import dataclass, field, fields, is_dataclass
+from typing import Any, Optional, Tuple
+
+
+def _coerce(tp, value):
+    """Best-effort coercion of JSON/CLI values into annotated field types."""
+    if value is None:
+        return None
+    origin = getattr(tp, "__origin__", None)
+    if origin is tuple:
+        args = tp.__args__
+        if len(args) == 2 and args[1] is Ellipsis:
+            return tuple(_coerce(args[0], v) for v in value)
+        return tuple(_coerce(a, v) for a, v in zip(args, value))
+    if origin is not None:  # Optional[...] and friends
+        args = [a for a in tp.__args__ if a is not type(None)]
+        if len(args) == 1:
+            return _coerce(args[0], value)
+        return value
+    if is_dataclass(tp) and isinstance(value, dict):
+        return config_from_dict(tp, value)
+    if tp in (int, float, str, bool) and not isinstance(value, tp):
+        if tp is bool and isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "on")
+        return tp(value)
+    return value
+
+
+def config_to_dict(cfg) -> dict:
+    out = {}
+    for f in fields(cfg):
+        v = getattr(cfg, f.name)
+        if is_dataclass(v):
+            out[f.name] = config_to_dict(v)
+        elif isinstance(v, tuple):
+            out[f.name] = list(v)
+        else:
+            out[f.name] = v
+    return out
+
+
+def config_from_dict(cls, d: dict):
+    import typing
+    hints = typing.get_type_hints(cls)
+    kwargs = {}
+    for f in fields(cls):
+        if f.name in d:
+            kwargs[f.name] = _coerce(hints[f.name], d[f.name])
+    return cls(**kwargs)
+
+
+class ConfigBase:
+    """Mixin: dict/json round-trip + argparse wiring for flat overrides."""
+
+    def to_dict(self) -> dict:
+        return config_to_dict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, d: dict):
+        return config_from_dict(cls, d)
+
+    @classmethod
+    def from_json(cls, s: str):
+        return cls.from_dict(json.loads(s))
+
+    def replace(self, **kw):
+        return dataclasses.replace(self, **kw)
+
+    @classmethod
+    def add_args(cls, parser: argparse.ArgumentParser, prefix: str = ""):
+        """Add one ``--prefix.field`` flag per leaf field (dotted paths for nesting)."""
+        import typing
+        hints = typing.get_type_hints(cls)
+        for f in fields(cls):
+            tp = hints[f.name]
+            name = f"{prefix}{f.name}"
+            if is_dataclass(tp):
+                tp.add_args(parser, prefix=f"{name}.")
+                continue
+            origin = getattr(tp, "__origin__", None)
+            if origin is tuple:
+                parser.add_argument(f"--{name}", type=str, default=None,
+                                    help=f"(comma list) default={getattr(cls, f.name, None)}")
+            elif tp is bool or tp == Optional[bool]:
+                parser.add_argument(f"--{name}", type=str, default=None, metavar="BOOL")
+            else:
+                base = tp
+                if origin is not None:
+                    nn = [a for a in tp.__args__ if a is not type(None)]
+                    base = nn[0] if len(nn) == 1 else str
+                if not callable(base) or is_dataclass(base):
+                    base = str
+                parser.add_argument(f"--{name}", type=base, default=None)
+
+    @classmethod
+    def from_args(cls, args: argparse.Namespace, base=None, prefix: str = ""):
+        """Apply any ``--a.b.c`` overrides from an argparse namespace onto ``base``."""
+        cfg = base if base is not None else cls()
+        d = config_to_dict(cfg)
+
+        def apply(cls_, sub: dict, pfx: str):
+            import typing
+            hints = typing.get_type_hints(cls_)
+            for f in fields(cls_):
+                tp = hints[f.name]
+                name = f"{pfx}{f.name}"
+                if is_dataclass(tp):
+                    apply(tp, sub[f.name], f"{name}.")
+                    continue
+                v = getattr(args, name, None)
+                if v is None:
+                    continue
+                origin = getattr(tp, "__origin__", None)
+                if origin is tuple and isinstance(v, str):
+                    v = [s for s in v.split(",") if s]
+                sub[f.name] = v
+
+        apply(cls, d, prefix)
+        return cls.from_dict(d)
+
+
+# ---------------------------------------------------------------------------
+# Mesh / parallelism
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class MeshConfig(ConfigBase):
+    """Logical device mesh. Axes: dp (data), fsdp (param/opt-state sharding, ZeRO-like),
+    tp (tensor/model), sp (sequence/context for ring attention).
+
+    The reference supports data parallelism only (SURVEY.md §2.6); tp/sp/fsdp are
+    TPU-native additions, laid out so collectives ride ICI.
+    """
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    # names, in mesh order (outer→inner = DCN→ICI friendliness)
+    axis_names: Tuple[str, ...] = ("dp", "fsdp", "sp", "tp")
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.fsdp * self.tp * self.sp
+
+    def shape(self) -> Tuple[int, ...]:
+        m = {"dp": self.dp, "fsdp": self.fsdp, "tp": self.tp, "sp": self.sp}
+        return tuple(m[a] for a in self.axis_names)
+
+
+@dataclass(frozen=True)
+class PrecisionConfig(ConfigBase):
+    """Mixed-precision policy (replaces the reference's Apex AMP / DeepSpeed fp16,
+    legacy/train_dalle.py:481-500). bf16 is the TPU-native choice."""
+    params: str = "float32"
+    compute: str = "bfloat16"
+    output: str = "float32"
+
+
+# ---------------------------------------------------------------------------
+# Models
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class DVAEConfig(ConfigBase):
+    """Discrete VAE (reference: dalle_pytorch/dalle_pytorch.py:101-252)."""
+    image_size: int = 128
+    num_tokens: int = 8192       # codebook vocabulary
+    codebook_dim: int = 512
+    num_layers: int = 3          # conv downsamples; image_seq = (image_size/2**num_layers)**2
+    num_resnet_blocks: int = 1
+    hidden_dim: int = 64
+    channels: int = 3
+    smooth_l1_loss: bool = False
+    kl_div_loss_weight: float = 0.0
+    straight_through: bool = False
+    normalization: Optional[Tuple[Tuple[float, float, float], Tuple[float, float, float]]] = None
+    temperature: float = 0.9
+
+    @property
+    def image_seq_len(self) -> int:
+        return (self.image_size // (2 ** self.num_layers)) ** 2
+
+    @property
+    def fmap_size(self) -> int:
+        return self.image_size // (2 ** self.num_layers)
+
+
+@dataclass(frozen=True)
+class TransformerConfig(ConfigBase):
+    """Transformer stack (reference: dalle_pytorch/transformer.py:204-328)."""
+    dim: int = 512
+    depth: int = 12
+    heads: int = 8
+    dim_head: int = 64
+    ff_mult: int = 4
+    attn_dropout: float = 0.0
+    ff_dropout: float = 0.0
+    # cyclic per-layer attention kinds: full | axial_row | axial_col | conv_like | sparse
+    attn_types: Tuple[str, ...] = ("full",)
+    image_fmap_size: int = 32
+    sparse_attn_kernel: int = 5          # conv_like unfold kernel
+    sparse_block_size: int = 128         # block-sparse tile (TPU lane-adapted; ref uses 16)
+    sparse_num_random_blocks: int = 0    # 0 → seq_len // block // 4 like the reference
+    reversible: bool = False
+    use_remat: bool = True               # jax.checkpoint over blocks
+    stable: bool = False                 # stable softmax + DivideMax
+    sandwich_norm: bool = False
+    shift_tokens: bool = False
+    rotary_emb: bool = True
+    shared_attn_ids: Optional[Tuple[int, ...]] = None
+    shared_ff_ids: Optional[Tuple[int, ...]] = None
+    optimize_for_inference: bool = False  # sparse→dense+static-mask swap
+    use_pallas: bool = False              # pallas flash-attention on the full path
+
+
+@dataclass(frozen=True)
+class DalleConfig(ConfigBase):
+    """DALL·E AR model (reference: dalle_pytorch/dalle_pytorch.py:336-440)."""
+    num_text_tokens: int = 10000
+    text_seq_len: int = 256
+    dim: int = 512
+    depth: int = 12
+    heads: int = 8
+    dim_head: int = 64
+    ff_mult: int = 4
+    attn_dropout: float = 0.0
+    ff_dropout: float = 0.0
+    attn_types: Tuple[str, ...] = ("full",)
+    loss_img_weight: float = 7.0
+    stable: bool = False
+    sandwich_norm: bool = False
+    shift_tokens: bool = False
+    rotary_emb: bool = True
+    shared_attn_ids: Optional[Tuple[int, ...]] = None
+    shared_ff_ids: Optional[Tuple[int, ...]] = None
+    share_input_output_emb: bool = False
+    reversible: bool = False
+    use_remat: bool = True
+    use_pallas: bool = False
+    sparse_block_size: int = 128
+    sparse_attn_kernel: int = 5
+    # filled from the vae at model build time
+    image_size: int = 128
+    image_vocab_size: int = 8192   # vae num_tokens
+    image_fmap_size: int = 16      # image_size / 2**vae_layers
+
+    @property
+    def image_seq_len(self) -> int:
+        return self.image_fmap_size ** 2
+
+    @property
+    def total_seq_len(self) -> int:
+        return self.text_seq_len + self.image_seq_len
+
+    @property
+    def total_tokens(self) -> int:
+        # text vocab reserves one unique pad token per text position (ref :370)
+        return self.num_text_tokens + self.text_seq_len + self.image_vocab_size
+
+    def transformer(self) -> TransformerConfig:
+        return TransformerConfig(
+            dim=self.dim, depth=self.depth, heads=self.heads, dim_head=self.dim_head,
+            ff_mult=self.ff_mult, attn_dropout=self.attn_dropout, ff_dropout=self.ff_dropout,
+            attn_types=self.attn_types, image_fmap_size=self.image_fmap_size,
+            reversible=self.reversible, use_remat=self.use_remat, stable=self.stable,
+            sandwich_norm=self.sandwich_norm, shift_tokens=self.shift_tokens,
+            rotary_emb=self.rotary_emb, shared_attn_ids=self.shared_attn_ids,
+            shared_ff_ids=self.shared_ff_ids, use_pallas=self.use_pallas,
+            sparse_block_size=self.sparse_block_size, sparse_attn_kernel=self.sparse_attn_kernel,
+        )
+
+
+@dataclass(frozen=True)
+class ClipConfig(ConfigBase):
+    """CLIP reranker (reference: dalle_pytorch/dalle_pytorch.py:256-332)."""
+    dim_text: int = 512
+    dim_image: int = 512
+    dim_latent: int = 512
+    num_text_tokens: int = 10000
+    text_enc_depth: int = 6
+    text_seq_len: int = 256
+    text_heads: int = 8
+    num_visual_tokens: int = 512
+    visual_enc_depth: int = 6
+    visual_heads: int = 8
+    visual_image_size: int = 256
+    visual_patch_size: int = 32
+    channels: int = 3
+
+
+@dataclass(frozen=True)
+class VQGANConfig(ConfigBase):
+    """VQGAN autoencoder (reference: dalle_pytorch/taming/models/vqgan.py +
+    taming/modules/diffusionmodules/model.py:342-537)."""
+    embed_dim: int = 256
+    n_embed: int = 1024
+    double_z: bool = False
+    z_channels: int = 256
+    resolution: int = 256
+    in_channels: int = 3
+    out_ch: int = 3
+    ch: int = 128
+    ch_mult: Tuple[int, ...] = (1, 1, 2, 2, 4)
+    num_res_blocks: int = 2
+    attn_resolutions: Tuple[int, ...] = (16,)
+    dropout: float = 0.0
+    quantizer: str = "vq"     # vq | gumbel
+    beta: float = 0.25        # commitment cost
+    gumbel_kl_weight: float = 5e-4
+    straight_through: bool = True
+
+    @property
+    def num_layers(self) -> int:
+        import math
+        return int(math.log2(self.resolution) - math.log2(self.attn_resolutions[0]))
+
+
+# ---------------------------------------------------------------------------
+# Training
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class OptimConfig(ConfigBase):
+    optimizer: str = "adam"
+    learning_rate: float = 3e-4
+    beta1: float = 0.9
+    beta2: float = 0.999
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    grad_clip_norm: float = 0.5          # ref: legacy/train_dalle.py --clip_grad_norm
+    grad_accum_steps: int = 1            # ref: --ga_steps
+    lr_decay: bool = False               # ReduceLROnPlateau equivalent (cosine here)
+    warmup_steps: int = 0
+    total_steps: int = 100_000
+    lr_scheduler: str = "constant"       # constant | cosine | exponential | plateau
+
+
+@dataclass(frozen=True)
+class TrainConfig(ConfigBase):
+    batch_size: int = 64                 # global batch
+    epochs: int = 20
+    seed: int = 42
+    log_every: int = 10
+    save_every_steps: int = 1000
+    keep_n_checkpoints: Optional[int] = None
+    checkpoint_dir: str = "./checkpoints"
+    resume: bool = False
+    nan_rollback: bool = True            # ref fork: vae.py:100-110
+    preflight_checkpoint: bool = True    # ref: legacy/train_dalle.py:591-594
+    sample_every_steps: int = 0
+    profile_step: int = 0                # >0 → dump a jax.profiler trace + MFU report
+    optim: OptimConfig = field(default_factory=OptimConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    precision: PrecisionConfig = field(default_factory=PrecisionConfig)
+
+
+# temperature annealing for dVAE training (ref: legacy/train_vae.py:269-271)
+@dataclass(frozen=True)
+class AnnealConfig(ConfigBase):
+    starting_temp: float = 1.0
+    temp_min: float = 0.5
+    anneal_rate: float = 1e-6

@@ -1,0 +1,5 @@
+from .sampling import top_k_filter, top_p_filter, gumbel_sample, prob_mask_like, masked_mean
+from .quantize import gumbel_softmax, vector_quantize, gumbel_quantize, kl_to_uniform, VQOutput
+from .rotary import apply_rotary, dalle_pos_emb, rotate_half
+from .attention import attend, cached_attend, stable_softmax, KVCache
+from .attn_masks import build_mask, causal_mask, axial_mask, conv_like_mask, block_sparse_mask

@@ -1,0 +1,111 @@
+"""Attention math — functional core shared by every attention layer.
+
+Reference: dalle_pytorch/attention.py:39-99 (dense causal `Attention` with
+stable softmax, key-padding mask, static mask, KV cache). The sparse variants
+(attention.py:103-398) are realized as static masks over this same core — see
+ops/attn_masks.py for the rationale — or via the Pallas kernels in
+ops/flash_attention.py / ops/block_sparse.py.
+
+TPU notes:
+  * qk/av contractions are einsums on (b, h, n, d) — MXU-shaped, bf16-friendly.
+  * masking is `jnp.where` folded into the softmax epilogue by XLA.
+  * the decode cache is a *preallocated* (b, h, max_seq, d) buffer updated with
+    `lax.dynamic_update_slice` and a scalar length — static shapes under jit,
+    replacing the reference's growing-concat cache (attention.py:71-76).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = jnp.float32(-1e9)
+
+
+def stable_softmax(t: jnp.ndarray, axis: int = -1, alpha: float = 32.0 ** 2) -> jnp.ndarray:
+    """Softmax with pre-division by alpha and detached-max subtraction
+    (reference attention.py:27-30)."""
+    t = t / alpha
+    t = t - jax.lax.stop_gradient(jnp.max(t, axis=axis, keepdims=True))
+    return jax.nn.softmax(t * alpha, axis=axis)
+
+
+def attend(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+           causal: bool = True,
+           key_mask: Optional[jnp.ndarray] = None,      # (b, j) True=valid
+           static_mask: Optional[jnp.ndarray] = None,   # (i, j) True=may attend
+           stable: bool = False,
+           scale: Optional[float] = None) -> jnp.ndarray:
+    """Dense attention. q: (b,h,i,d), k/v: (b,h,j,d) → (b,h,i,d).
+
+    When i < j (cached decode), causality aligns the query block to the *end* of
+    the key sequence, matching the reference's `triu_(j - i + 1)` convention.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    q = q * scale
+    dots = jnp.einsum("bhid,bhjd->bhij", q, k)
+    i, j = dots.shape[-2], dots.shape[-1]
+
+    if key_mask is not None:
+        dots = jnp.where(key_mask[:, None, None, :], dots, NEG_INF)
+    if causal:
+        qpos = jnp.arange(i) + (j - i)
+        kpos = jnp.arange(j)
+        dots = jnp.where(kpos[None, :] <= qpos[:, None], dots, NEG_INF)
+    if static_mask is not None:
+        # queries occupy key positions j-i..j-1 (same alignment as the causal
+        # branch above), so index mask rows by key position, not from the end
+        dots = jnp.where(static_mask[j - i:j, :j], dots, NEG_INF)
+
+    softmax = stable_softmax if stable else jax.nn.softmax
+    attn = softmax(dots.astype(jnp.float32), axis=-1).astype(v.dtype)
+    return jnp.einsum("bhij,bhjd->bhid", attn, v)
+
+
+class KVCache(NamedTuple):
+    """Preallocated decode cache for one attention layer."""
+    k: jnp.ndarray       # (b, h, max_seq, d)
+    v: jnp.ndarray       # (b, h, max_seq, d)
+
+    @classmethod
+    def init(cls, batch: int, heads: int, max_seq: int, dim_head: int,
+             dtype=jnp.float32) -> "KVCache":
+        z = jnp.zeros((batch, heads, max_seq, dim_head), dtype=dtype)
+        return cls(z, z)
+
+    def append(self, k_new: jnp.ndarray, v_new: jnp.ndarray, offset) -> "KVCache":
+        """Write (b,h,n,d) new keys/values at position ``offset`` (scalar)."""
+        k = jax.lax.dynamic_update_slice(self.k, k_new.astype(self.k.dtype), (0, 0, offset, 0))
+        v = jax.lax.dynamic_update_slice(self.v, v_new.astype(self.v.dtype), (0, 0, offset, 0))
+        return KVCache(k, v)
+
+
+def cached_attend(q: jnp.ndarray, cache: KVCache, length, *,
+                  static_mask: Optional[jnp.ndarray] = None,
+                  stable: bool = False,
+                  qpos=None,
+                  scale: Optional[float] = None) -> jnp.ndarray:
+    """Single-step decode: q is (b,h,1,d); attends to cache[:length].
+
+    ``length`` is a traced scalar — the full (b,h,max,d) cache participates in the
+    matmul and positions ≥ length are masked, keeping shapes static under scan.
+    ``qpos`` (defaults to length-1) indexes the static_mask row.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    q = q * scale
+    dots = jnp.einsum("bhid,bhjd->bhij", q, cache.k)        # (b,h,1,max)
+    jpos = jnp.arange(cache.k.shape[2])
+    valid = jpos[None, None, None, :] < length
+    if static_mask is not None:
+        if qpos is None:
+            qpos = length - 1
+        row = jax.lax.dynamic_index_in_dim(static_mask, qpos, axis=0, keepdims=False)
+        valid = valid & row[None, None, None, :]
+    dots = jnp.where(valid, dots, NEG_INF)
+    softmax = stable_softmax if stable else jax.nn.softmax
+    attn = softmax(dots.astype(jnp.float32), axis=-1).astype(cache.v.dtype)
+    return jnp.einsum("bhij,bhjd->bhid", attn, cache.v)

@@ -1,0 +1,112 @@
+"""Static attention-mask builders for every sparse variant.
+
+TPU-first design decision: the reference implements axial/conv-like sparsity as
+separate gather/unfold kernels (dalle_pytorch/attention.py:103-335) because dense
+O(n²) attention was too slow on its GPUs; on TPU the dense MXU matmul with a fused
+boolean mask is usually *faster* than gather-based sparsity at DALLE sequence
+lengths (≤1280), and XLA fuses `where(mask, dots, -inf)` into the attention matmul
+epilogue. So masks are the primary representation here — the same trick the
+reference itself uses for inference (`optimize_for_inference` swaps sparse modules
+for dense+static-mask, transformer.py:333-350) — and the Pallas block-sparse
+kernel (ops/block_sparse.py) consumes the *same* masks block-wise for the long-seq
+training path. Masks are numpy (compile-time constants folded by XLA).
+
+All masks are (seq, seq) boolean, True = may attend, and already include
+causality. ``seq = text_len + fmap**2`` where text_len counts <bos>.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def causal_mask(seq: int) -> np.ndarray:
+    return np.tril(np.ones((seq, seq), dtype=bool))
+
+
+def axial_mask(text_len: int, fmap: int, axis: int) -> np.ndarray:
+    """axial_row (axis=0) / axial_col (axis=1): text→text causal; image→all text;
+    image→image causal along one axis only (reference attention.py:287-327 and the
+    equivalent static mask at transformer.py:333-350)."""
+    seq = text_len + fmap * fmap
+    m = np.zeros((seq, seq), dtype=bool)
+    m[:, :text_len] = True
+    idx = np.arange(fmap * fmap)
+    r, c = idx // fmap, idx % fmap
+    if axis == 0:   # same row
+        same = r[:, None] == r[None, :]
+    else:           # same column
+        same = c[:, None] == c[None, :]
+    m[text_len:, text_len:] = same
+    return m & causal_mask(seq)
+
+
+def conv_like_mask(text_len: int, fmap: int, kernel_size: int = 5,
+                   dilation: int = 1) -> np.ndarray:
+    """conv_like: text→text causal; image→all text; image query (r,c) → image keys
+    in the k×k dilated window whose bottom-right corner is (r,c) (the causal
+    padding construction in reference attention.py:166-196: every key in the
+    window has row ≤ r and col ≤ c, so the pattern is causal by construction)."""
+    assert kernel_size % 2 == 1, "kernel size must be odd"
+    seq = text_len + fmap * fmap
+    span = (kernel_size - 1) * dilation
+    m = np.zeros((seq, seq), dtype=bool)
+    m[:, :text_len] = True
+    idx = np.arange(fmap * fmap)
+    r, c = idx // fmap, idx % fmap
+    dr = r[:, None] - r[None, :]
+    dc = c[:, None] - c[None, :]
+    win = (dr >= 0) & (dr <= span) & (dr % dilation == 0) & \
+          (dc >= 0) & (dc <= span) & (dc % dilation == 0)
+    m[text_len:, text_len:] = win
+    return m & causal_mask(seq)
+
+
+def block_sparse_mask(seq: int, text_len: int, block: int = 128,
+                      num_random_blocks: int | None = None,
+                      seed: int = 0, causal: bool = True) -> np.ndarray:
+    """DeepSpeed VariableSparsityConfig-equivalent pattern (reference
+    attention.py:349-365): global blocks covering the text prefix (attend to and
+    from), local diagonal blocks, plus ``num_random_blocks`` random blocks per
+    block-row; unidirectional (causal). Defaults follow the reference:
+    num_random_blocks = seq//block//4. Block default is 128 (TPU lane width;
+    the reference's 16 doesn't tile the MXU)."""
+    nb = (seq + block - 1) // block
+    if num_random_blocks is None:
+        num_random_blocks = max(seq // block // 4, 0)
+    n_global = (text_len + block - 1) // block
+    bm = np.zeros((nb, nb), dtype=bool)
+    np.fill_diagonal(bm, True)                  # local
+    bm[:, :n_global] = True                     # attend to global text blocks
+    bm[:n_global, :] = True                     # global blocks attend everywhere
+    rng = np.random.RandomState(seed)
+    for i in range(nb):
+        hi = i + 1 if causal else nb
+        if hi > 0 and num_random_blocks > 0:
+            cols = rng.randint(0, hi, size=num_random_blocks)
+            bm[i, cols] = True
+    mask = np.kron(bm, np.ones((block, block), dtype=bool))[:seq, :seq]
+    if causal:
+        mask &= causal_mask(seq)
+    return mask
+
+
+def build_mask(attn_type: str, text_len: int, fmap: int, *, kernel_size: int = 5,
+               dilation: int = 1, block: int = 128,
+               num_random_blocks: int | None = None, seed: int = 0) -> np.ndarray:
+    """``num_random_blocks``: None or 0 → the reference default seq//block//4."""
+    seq = text_len + fmap * fmap
+    if attn_type == "full":
+        return causal_mask(seq)
+    if attn_type == "axial_row":
+        return axial_mask(text_len, fmap, axis=0)
+    if attn_type == "axial_col":
+        return axial_mask(text_len, fmap, axis=1)
+    if attn_type == "conv_like":
+        return conv_like_mask(text_len, fmap, kernel_size, dilation)
+    if attn_type == "sparse":
+        if not num_random_blocks:   # 0/None → reference default
+            num_random_blocks = None
+        return block_sparse_mask(seq, text_len, block=block,
+                                 num_random_blocks=num_random_blocks, seed=seed)
+    raise ValueError(f'attention type "{attn_type}" is not valid')

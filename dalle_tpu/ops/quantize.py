@@ -1,0 +1,88 @@
+"""Discrete quantizers as pure XLA ops.
+
+The reference's quantizer family:
+  * Gumbel-softmax codebook mixing for the dVAE
+    (dalle_pytorch/dalle_pytorch.py:229-230: ``F.gumbel_softmax`` + codebook einsum).
+  * ``VectorQuantizer2`` nearest-neighbour + straight-through estimator
+    (dalle_pytorch/taming/modules/vqvae/quantize.py:213-329).
+  * ``GumbelQuantize`` (quantize.py:110-210).
+
+All three are plain functional ops here: no buffers, no in-place mutation; the STE
+is ``z + stop_gradient(z_q - z)``, which XLA fuses for free.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def gumbel_softmax(key: jax.Array, logits: jnp.ndarray, tau: float,
+                   hard: bool = False, axis: int = -1) -> jnp.ndarray:
+    """Differentiable sample from a categorical relaxation (torch F.gumbel_softmax
+    semantics, used by the dVAE at dalle_pytorch.py:229)."""
+    g = jax.random.gumbel(key, logits.shape, dtype=logits.dtype)
+    y_soft = jax.nn.softmax((logits + g) / tau, axis=axis)
+    if not hard:
+        return y_soft
+    idx = jnp.argmax(y_soft, axis=axis)
+    y_hard = jax.nn.one_hot(idx, logits.shape[axis], dtype=logits.dtype, axis=axis)
+    # straight-through: forward hard, backward soft
+    return y_soft + jax.lax.stop_gradient(y_hard - y_soft)
+
+
+class VQOutput(NamedTuple):
+    quantized: jnp.ndarray   # same shape as input z
+    indices: jnp.ndarray     # int32 codebook indices
+    loss: jnp.ndarray        # codebook + commitment loss (scalar)
+
+
+def vector_quantize(z: jnp.ndarray, codebook: jnp.ndarray, beta: float = 0.25) -> VQOutput:
+    """Nearest-neighbour vector quantization with straight-through gradients.
+
+    ``z``: (..., d) continuous latents; ``codebook``: (n, d).
+    Matches VectorQuantizer2 (taming quantize.py:280-298): expanded-L2 NN lookup,
+    loss = mean((sg[zq]-z)^2) + beta*mean((zq-sg[z])^2), STE passthrough.
+
+    The distance computation is phrased as one big matmul (z @ codebook.T) so the
+    MXU does the work instead of a VPU-bound broadcast subtraction.
+    """
+    d = z.shape[-1]
+    flat = z.reshape(-1, d)
+    # ||z||^2 - 2 z.e + ||e||^2 ; the z.e term is a matmul → MXU
+    z_sq = jnp.sum(flat ** 2, axis=-1, keepdims=True)
+    e_sq = jnp.sum(codebook ** 2, axis=-1)
+    dist = z_sq - 2.0 * flat @ codebook.T + e_sq[None, :]
+    idx = jnp.argmin(dist, axis=-1)
+    zq = codebook[idx].reshape(z.shape)
+    commit = jnp.mean((zq - jax.lax.stop_gradient(z)) ** 2)
+    codebook_loss = jnp.mean((jax.lax.stop_gradient(zq) - z) ** 2)
+    loss = codebook_loss + beta * commit
+    zq = z + jax.lax.stop_gradient(zq - z)  # straight-through
+    return VQOutput(zq, idx.reshape(z.shape[:-1]).astype(jnp.int32), loss)
+
+
+def gumbel_quantize(key: jax.Array, logits: jnp.ndarray, codebook: jnp.ndarray,
+                    tau: float, hard: bool, kl_weight: float) -> VQOutput:
+    """GumbelQuantize forward (taming quantize.py:171-200): gumbel-softmax over
+    codebook logits, mix codebook rows, KL-to-uniform prior regularizer."""
+    n = codebook.shape[0]
+    one_hot = gumbel_softmax(key, logits, tau=tau, hard=hard, axis=-1)
+    zq = one_hot @ codebook
+    probs = jax.nn.softmax(logits, axis=-1)
+    kl = kl_weight * jnp.mean(jnp.sum(probs * jnp.log(probs * n + 1e-10), axis=-1))
+    idx = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return VQOutput(zq, idx, kl)
+
+
+def kl_to_uniform(logits: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    """KL(softmax(logits) ‖ uniform), 'batchmean' reduction — summed over
+    positions and vocab, divided by batch size (leading dim), matching the dVAE
+    regularizer (dalle_pytorch.py:242-246: F.kl_div(..., 'batchmean'))."""
+    n = logits.shape[axis]
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    p = jnp.exp(logp)
+    kl = jnp.sum(p * (logp + jnp.log(float(n))), axis=axis)
+    return jnp.sum(kl) / logits.shape[0]

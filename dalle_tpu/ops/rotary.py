@@ -1,0 +1,84 @@
+"""Rotary position embeddings — functional, precomputed as a static table.
+
+Reproduces the reference's vendored rotary-embedding-torch semantics
+(dalle_pytorch/rotary_embedding_torch/rotary_embedding_torch.py:61-112) and the
+DALLE-specific combined text+2D-image frequency table
+(dalle_pytorch/transformer.py:302-328):
+
+  * ``lang`` freqs: 1/theta^(2i/dim); ``pixel`` freqs: linspace(1, max_freq/2, dim//2)*pi.
+  * Each frequency repeated twice adjacently; rotation acts on adjacent pairs.
+  * Text token positions 0..text_len over the lang bank; image tokens pinned at
+    lang-position 8192. Image tokens get 2D axial pixel freqs over linspace(-1,1)
+    per row/col; text tokens pinned at axial position -10.
+  * The combined table has last-dim 3·2·(dim_head//3//2) and rotates only the
+    leading slice of each head dim (the rest passes through).
+
+Everything here is a compile-time constant table — XLA folds it — so there is no
+runtime cost beyond the fused multiply-adds of ``apply_rotary``.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def lang_freqs(dim: int, theta: float = 10000.0) -> np.ndarray:
+    return 1.0 / (theta ** (np.arange(0, dim, 2)[: dim // 2].astype(np.float32) / dim))
+
+
+def pixel_freqs(dim: int, max_freq: float = 10.0) -> np.ndarray:
+    return np.linspace(1.0, max_freq / 2, dim // 2).astype(np.float32) * math.pi
+
+
+def freqs_table(positions: np.ndarray, freqs: np.ndarray) -> np.ndarray:
+    """outer(positions, freqs) with each column doubled adjacently → (n, 2*(dim//2))."""
+    table = np.einsum("i,j->ij", positions.astype(np.float32), freqs)
+    return np.repeat(table, 2, axis=-1)
+
+
+def rotate_half(x: jnp.ndarray) -> jnp.ndarray:
+    """Pairwise (x1,x2) → (-x2,x1) on adjacent feature pairs."""
+    x = x.reshape(*x.shape[:-1], -1, 2)
+    x1, x2 = x[..., 0], x[..., 1]
+    return jnp.stack((-x2, x1), axis=-1).reshape(*x.shape[:-2], -1)
+
+
+def apply_rotary(freqs: jnp.ndarray, t: jnp.ndarray) -> jnp.ndarray:
+    """Rotate the leading ``freqs.shape[-1]`` features of ``t``; pass the rest through.
+    (reference apply_rotary_emb, rotary_embedding_torch.py:40-47)"""
+    rot_dim = freqs.shape[-1]
+    freqs = freqs.astype(t.dtype)
+    t_rot, t_pass = t[..., :rot_dim], t[..., rot_dim:]
+    t_rot = t_rot * jnp.cos(freqs) + rotate_half(t_rot) * jnp.sin(freqs)
+    return jnp.concatenate((t_rot, t_pass), axis=-1)
+
+
+def dalle_pos_emb(text_len: int, image_fmap_size: int, dim_head: int) -> np.ndarray:
+    """The DALLE combined rotary table, shape (text_len + fmap², 3·2·(rot//2)).
+
+    ``text_len`` includes the <bos> slot (reference passes seq_len-img_seq+1,
+    transformer.py:308). Built in numpy: it is a constant.
+    """
+    rot_dim = dim_head // 3
+    img_seq_len = image_fmap_size ** 2
+    lang = lang_freqs(rot_dim)
+    pixel = pixel_freqs(rot_dim)
+
+    # 1D lang-band: text positions 0..text_len-1; images pinned far away at 8192
+    text_freqs = freqs_table(np.arange(text_len), lang)
+    img_to_text = freqs_table(np.full((img_seq_len,), 8192.0), lang)
+    band1 = np.concatenate((text_freqs, img_to_text), axis=0)
+
+    # 2D pixel-band: rows/cols over linspace(-1,1); text pinned at -10 on both axes
+    axial = freqs_table(np.linspace(-1.0, 1.0, image_fmap_size), pixel)  # (f, d)
+    rows = np.broadcast_to(axial[:, None, :], (image_fmap_size, image_fmap_size, axial.shape[-1]))
+    cols = np.broadcast_to(axial[None, :, :], (image_fmap_size, image_fmap_size, axial.shape[-1]))
+    img2d = np.concatenate((rows, cols), axis=-1).reshape(img_seq_len, -1)
+    text_axial = freqs_table(np.full((text_len,), -10.0), pixel)
+    text_axial = np.concatenate((text_axial, text_axial), axis=-1)
+    band2 = np.concatenate((text_axial, img2d), axis=0)
+
+    return np.concatenate((band1, band2), axis=-1)

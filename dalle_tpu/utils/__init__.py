@@ -1,0 +1,1 @@
+from .misc import exists, default, cast_tuple, divisible_by, log2_int

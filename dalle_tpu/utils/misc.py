@@ -1,0 +1,31 @@
+"""Small helpers (reference analogues: dalle_pytorch/dalle_pytorch.py:14-69)."""
+
+from __future__ import annotations
+
+import math
+
+
+def exists(x) -> bool:
+    return x is not None
+
+
+def default(x, d):
+    if x is not None:
+        return x
+    return d() if callable(d) else d
+
+
+def cast_tuple(x, depth=1):
+    if isinstance(x, (tuple, list)):
+        return tuple(x)
+    return (x,) * depth
+
+
+def divisible_by(n: int, d: int) -> bool:
+    return n % d == 0
+
+
+def log2_int(n: int) -> int:
+    l = int(math.log2(n))
+    assert 2 ** l == n, f"{n} is not a power of 2"
+    return l
