@@ -22,6 +22,16 @@ from dataclasses import dataclass, field, fields, is_dataclass
 from typing import Any, Optional, Tuple
 
 
+def _unwrap_optional(tp):
+    """Optional[X] → X (leaves other types untouched)."""
+    origin = getattr(tp, "__origin__", None)
+    if origin is not None and origin is not tuple:
+        args = [a for a in tp.__args__ if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
 def _coerce(tp, value):
     """Best-effort coercion of JSON/CLI values into annotated field types."""
     if value is None:
@@ -95,7 +105,7 @@ class ConfigBase:
         import typing
         hints = typing.get_type_hints(cls)
         for f in fields(cls):
-            tp = hints[f.name]
+            tp = _unwrap_optional(hints[f.name])
             name = f"{prefix}{f.name}"
             if is_dataclass(tp):
                 tp.add_args(parser, prefix=f"{name}.")
@@ -104,16 +114,12 @@ class ConfigBase:
             if origin is tuple:
                 parser.add_argument(f"--{name}", type=str, default=None,
                                     help=f"(comma list) default={getattr(cls, f.name, None)}")
-            elif tp is bool or tp == Optional[bool]:
+            elif tp is bool:
                 parser.add_argument(f"--{name}", type=str, default=None, metavar="BOOL")
+            elif tp in (int, float, str):
+                parser.add_argument(f"--{name}", type=tp, default=None)
             else:
-                base = tp
-                if origin is not None:
-                    nn = [a for a in tp.__args__ if a is not type(None)]
-                    base = nn[0] if len(nn) == 1 else str
-                if not callable(base) or is_dataclass(base):
-                    base = str
-                parser.add_argument(f"--{name}", type=base, default=None)
+                parser.add_argument(f"--{name}", type=str, default=None)
 
     @classmethod
     def from_args(cls, args: argparse.Namespace, base=None, prefix: str = ""):
@@ -125,7 +131,7 @@ class ConfigBase:
             import typing
             hints = typing.get_type_hints(cls_)
             for f in fields(cls_):
-                tp = hints[f.name]
+                tp = _unwrap_optional(hints[f.name])
                 name = f"{pfx}{f.name}"
                 if is_dataclass(tp):
                     apply(tp, sub[f.name], f"{name}.")
@@ -196,7 +202,9 @@ class DVAEConfig(ConfigBase):
     smooth_l1_loss: bool = False
     kl_div_loss_weight: float = 0.0
     straight_through: bool = False
-    normalization: Optional[Tuple[Tuple[float, float, float], Tuple[float, float, float]]] = None
+    # per-channel (means, stds); reference default is 0.5/0.5 (dalle_pytorch.py:116)
+    normalization: Optional[Tuple[Tuple[float, float, float], Tuple[float, float, float]]] = (
+        (0.5, 0.5, 0.5), (0.5, 0.5, 0.5))
     temperature: float = 0.9
 
     @property

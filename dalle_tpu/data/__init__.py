@@ -1,0 +1,1 @@
+from .synthetic import ShapesDataset, batch_iterator, render, SHAPES, COLORS, SCALES

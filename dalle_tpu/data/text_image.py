@@ -1,0 +1,107 @@
+"""Folder-based text/image dataset.
+
+Reference: ``TextImageDataset`` (dalle_pytorch/loader.py:28-99) — pairs ``*.txt``
+caption files with images by path stem, picks a random caption line per access,
+random-resized-crop augmentation, and **skips corrupt images / empty captions by
+resampling** (:58-96). Host-side (numpy/PIL); the device never sees ragged data.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from pathlib import Path
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
+
+
+def _center_crop_resize(img, size: int, resize_ratio: float, rng: random.Random):
+    """RandomResizedCrop(scale=(resize_ratio, 1), ratio 1:1) equivalent
+    (reference loader.py:46-53)."""
+    from PIL import Image
+    w, h = img.size
+    short = min(w, h)
+    scale = rng.uniform(resize_ratio, 1.0)
+    crop = max(int(short * scale ** 0.5), 1)
+    left = rng.randint(0, w - crop) if w > crop else 0
+    top = rng.randint(0, h - crop) if h > crop else 0
+    img = img.crop((left, top, left + crop, top + crop))
+    return img.resize((size, size), Image.BILINEAR)
+
+
+class TextImageDataset:
+    """Yields (caption str, image float32 [0,1] HWC). Corrupt/empty samples are
+    skipped by resampling (random when shuffled, next index otherwise)."""
+
+    def __init__(self, folder: str, image_size: int = 128, resize_ratio: float = 0.75,
+                 shuffle: bool = False, seed: int = 0, text_from_filename: bool = False):
+        self.image_size = image_size
+        self.resize_ratio = resize_ratio
+        self.shuffle = shuffle
+        self.text_from_filename = text_from_filename
+        self.rng = random.Random(seed)
+
+        root = Path(folder)
+        images = {p.stem: p for p in root.rglob("*") if p.suffix.lower() in IMAGE_EXTS}
+        if text_from_filename:
+            keys = sorted(images.keys())
+            self.pairs: List[Tuple[Optional[Path], Path]] = [(None, images[k]) for k in keys]
+        else:
+            texts = {p.stem: p for p in root.rglob("*.txt")}
+            keys = sorted(images.keys() & texts.keys())
+            self.pairs = [(texts[k], images[k]) for k in keys]
+        if not self.pairs:
+            raise ValueError(f"no usable text/image pairs under {folder}")
+
+    def __len__(self):
+        return len(self.pairs)
+
+    def _caption_from(self, text_path: Optional[Path], image_path: Path) -> str:
+        if text_path is None:
+            # fork-style filename labels: "medium_red_circle_00042" → words
+            # minus the trailing numeric id (reference loader.py:52-66 fork flow)
+            parts = image_path.stem.split("_")
+            words = [p for p in parts if not p.isdigit()]
+            return " ".join(words)
+        lines = [l.strip() for l in text_path.read_text().splitlines() if l.strip()]
+        if not lines:
+            raise ValueError(f"empty caption file {text_path}")
+        return self.rng.choice(lines)  # random caption line per epoch (ref :77-81)
+
+    def _load(self, i: int):
+        from PIL import Image
+        text_path, image_path = self.pairs[i]
+        caption = self._caption_from(text_path, image_path)
+        img = Image.open(image_path).convert("RGB")
+        img = _center_crop_resize(img, self.image_size, self.resize_ratio, self.rng)
+        arr = np.asarray(img, dtype=np.float32) / 255.0
+        return caption, arr
+
+    def __getitem__(self, i: int):
+        # skip-by-resampling fault tolerance (reference loader.py:58-96)
+        for _ in range(len(self.pairs)):
+            try:
+                return self._load(i)
+            except Exception:
+                i = self.rng.randrange(len(self.pairs)) if self.shuffle \
+                    else (i + 1) % len(self.pairs)
+        raise RuntimeError("every sample in the dataset failed to load")
+
+    def batches(self, batch_size: int, epochs: Optional[int] = None,
+                drop_last: bool = True):
+        """Yields (images f32 NHWC, captions list)."""
+        epoch = 0
+        order = list(range(len(self)))
+        while epochs is None or epoch < epochs:
+            if self.shuffle:
+                self.rng.shuffle(order)
+            stop = len(order) - (batch_size - 1 if drop_last else 0)
+            for s in range(0, max(stop, 0), batch_size):
+                items = [self[i] for i in order[s:s + batch_size]]
+                imgs = np.stack([im for _, im in items])
+                caps = [c for c, _ in items]
+                yield imgs, caps
+            epoch += 1

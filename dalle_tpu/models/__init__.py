@@ -1,0 +1,1 @@
+from .dvae import DiscreteVAE, init_dvae

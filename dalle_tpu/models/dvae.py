@@ -1,0 +1,174 @@
+"""Discrete VAE — the trainable image tokenizer.
+
+Reference: ``DiscreteVAE`` (dalle_pytorch/dalle_pytorch.py:101-252) and ``ResBlock``
+(:87-99). Re-designed for TPU:
+
+  * NHWC layout throughout (XLA:TPU's native conv layout; the reference is NCHW).
+  * The Gumbel-softmax quantizer + codebook contraction is pure XLA
+    (ops/quantize.py) — the reference's ``F.gumbel_softmax`` + einsum
+    (dalle_pytorch.py:229-230) becomes one fused softmax+matmul that lands on
+    the MXU.
+  * Explicit RNG: the gumbel key is a ``'gumbel'`` rng collection, not hidden
+    global state — this is what makes data-parallel determinism trivial
+    (SURVEY.md §7 "Gumbel-softmax determinism across hosts").
+
+Capability parity: encoder/decoder conv stacks with ResBlocks, per-channel
+normalization buffers, smooth-l1/mse recon loss + batchmean KL-to-uniform,
+``get_codebook_indices`` (argmax of logits), ``decode`` (codebook → decoder),
+temperature / straight-through options.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..config import DVAEConfig
+from ..ops.quantize import gumbel_softmax, kl_to_uniform
+
+
+class ResBlock(nn.Module):
+    """conv3x3 → relu → conv3x3 → relu → conv1x1, residual (reference :87-99)."""
+    chan: int
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Conv(self.chan, (3, 3), padding=1, name="conv1")(x)
+        h = nn.relu(h)
+        h = nn.Conv(self.chan, (3, 3), padding=1, name="conv2")(h)
+        h = nn.relu(h)
+        h = nn.Conv(self.chan, (1, 1), name="conv3")(h)
+        return h + x
+
+
+class Encoder(nn.Module):
+    """num_layers × (conv4x4/s2 + relu), then ResBlocks, then 1×1 to num_tokens
+    logits (reference :140-158 layer assembly)."""
+    cfg: DVAEConfig
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.cfg
+        for i in range(c.num_layers):
+            x = nn.Conv(c.hidden_dim, (4, 4), strides=(2, 2), padding=1,
+                        name=f"down_{i}")(x)
+            x = nn.relu(x)
+        for i in range(c.num_resnet_blocks):
+            x = ResBlock(c.hidden_dim, name=f"res_{i}")(x)
+        x = nn.Conv(c.num_tokens, (1, 1), name="to_logits")(x)
+        return x  # (b, h', w', num_tokens)
+
+
+class Decoder(nn.Module):
+    """1×1 from codebook_dim (when resblocks exist), ResBlocks, then
+    num_layers × (convT4x4/s2 + relu), final 1×1 to channels (reference :144-158)."""
+    cfg: DVAEConfig
+
+    @nn.compact
+    def __call__(self, z):
+        c = self.cfg
+        if c.num_resnet_blocks > 0:
+            z = nn.Conv(c.hidden_dim, (1, 1), name="proj_in")(z)
+            for i in range(c.num_resnet_blocks):
+                z = ResBlock(c.hidden_dim, name=f"res_{i}")(z)
+        for i in range(c.num_layers):
+            z = nn.ConvTranspose(c.hidden_dim, (4, 4), strides=(2, 2),
+                                 padding="SAME", name=f"up_{i}")(z)
+            z = nn.relu(z)
+        z = nn.Conv(c.channels, (1, 1), name="to_pixels")(z)
+        return z
+
+
+class DiscreteVAE(nn.Module):
+    """The dVAE. Images are NHWC floats in [0, 1].
+
+    Methods (select with ``method=`` in ``.apply``):
+      * ``__call__(img, temp, return_loss, return_recons)`` — train/recon path;
+        needs a ``'gumbel'`` rng.
+      * ``get_codebook_indices(img)`` — (b, n) int32 hard token ids.
+      * ``decode(img_seq)`` — token ids → image.
+      * ``encode_logits(img)`` — (b, h, w, num_tokens) logits.
+    """
+    cfg: DVAEConfig
+
+    def setup(self):
+        c = self.cfg
+        assert c.image_size & (c.image_size - 1) == 0, "image size must be a power of 2"
+        assert c.num_layers >= 1
+        self.encoder = Encoder(c, name="encoder")
+        self.decoder = Decoder(c, name="decoder")
+        self.codebook = nn.Embed(c.num_tokens, c.codebook_dim, name="codebook")
+
+    def norm(self, images):
+        """Per-channel (x - mean)/std buffers (reference :181-189)."""
+        if self.cfg.normalization is None:
+            return images
+        means, stds = self.cfg.normalization
+        means = jnp.asarray(means, images.dtype)
+        stds = jnp.asarray(stds, images.dtype)
+        return (images - means) / stds
+
+    def encode_logits(self, img):
+        assert img.shape[1] == img.shape[2] == self.cfg.image_size, (
+            f"input must be {self.cfg.image_size}px, got {img.shape}")
+        return self.encoder(self.norm(img))
+
+    def get_codebook_indices(self, img):
+        """argmax over token logits, flattened to raster order (reference :191-196)."""
+        logits = self.encode_logits(img)
+        b = logits.shape[0]
+        return jnp.argmax(logits, axis=-1).reshape(b, -1).astype(jnp.int32)
+
+    def decode(self, img_seq):
+        """(b, n) token ids → (b, H, W, C) image (reference :198-208)."""
+        emb = self.codebook(img_seq)
+        b, n, d = emb.shape
+        hw = int(n ** 0.5)
+        return self.decoder(emb.reshape(b, hw, hw, d))
+
+    def __call__(self, img, temp: Optional[float] = None, return_loss: bool = False,
+                 return_recons: bool = False, hard_recons: bool = False):
+        c = self.cfg
+        img_n = self.norm(img)
+        logits = self.encoder(img_n)
+
+        temp = c.temperature if temp is None else temp
+        if hard_recons:
+            # deterministic eval path: argmax codebook lookup, no gumbel noise
+            one_hot = jax.nn.one_hot(jnp.argmax(logits, -1), c.num_tokens, dtype=logits.dtype)
+        else:
+            key = self.make_rng("gumbel")
+            one_hot = gumbel_softmax(key, logits, tau=temp, hard=c.straight_through)
+        # (b,h,w,n) @ (n,d): the quantizer is a single MXU matmul
+        sampled = jnp.einsum("bhwn,nd->bhwd", one_hot, self.codebook.embedding)
+        out = self.decoder(sampled)
+
+        if not return_loss:
+            return out
+
+        # recon loss on *normalized* target, as the reference does (:236)
+        diff = img_n - out
+        if c.smooth_l1_loss:
+            a = jnp.abs(diff)
+            recon = jnp.mean(jnp.where(a < 1.0, 0.5 * diff ** 2, a - 0.5))
+        else:
+            recon = jnp.mean(diff ** 2)
+
+        b, h, w, n = logits.shape
+        kl = kl_to_uniform(logits.reshape(b, h * w, n))
+        loss = recon + kl * c.kl_div_loss_weight
+
+        if not return_recons:
+            return loss
+        return loss, out
+
+
+def init_dvae(cfg: DVAEConfig, key: jax.Array, batch: int = 1):
+    """Initialize params with a dummy batch. Returns (model, params)."""
+    model = DiscreteVAE(cfg)
+    img = jnp.zeros((batch, cfg.image_size, cfg.image_size, cfg.channels), jnp.float32)
+    params = model.init({"params": key, "gumbel": key}, img, return_loss=True)
+    return model, params

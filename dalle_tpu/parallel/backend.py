@@ -152,11 +152,14 @@ class JaxBackend(DistributedBackend):
         if coord and nproc and int(nproc) > 1:
             pid = self._process_id
             if pid is None:
-                pid = int(os.environ.get("JAX_PROCESS_ID", "0"))
+                env_pid = os.environ.get("JAX_PROCESS_ID")
+                pid = int(env_pid) if env_pid is not None else None
+            # pid None → jax.distributed.initialize infers it from platform
+            # metadata (the TPU-pod norm); forcing 0 would collide across hosts
             jax.distributed.initialize(
                 coordinator_address=coord,
                 num_processes=int(nproc),
-                process_id=int(pid),
+                process_id=pid,
             )
         self.mesh = build_mesh(mesh_config)
 

@@ -1,0 +1,75 @@
+"""Checkpoint save/restore with embedded model identity.
+
+Contract parity with the reference (SURVEY.md §5.4): checkpoints carry
+``hparams``/``vae_params``/``vae_class_name`` *inside* the file so generation can
+reconstruct the exact model (legacy/train_dalle.py:535-582, generate.py:82-106);
+rotation keeps the newest ``keep_n`` (:547-550); a pre-flight save fails fast on
+misconfiguration (:591-594).
+
+Implementation is Orbax (sharded, multi-host-safe — the TPU equivalent of the
+DeepSpeed partitioned checkpoint dir) with the metadata dict stored alongside.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, keep_n: Optional[int] = None):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        opts = ocp.CheckpointManagerOptions(
+            max_to_keep=keep_n, create=True, enable_async_checkpointing=False)
+        self._mgr = ocp.CheckpointManager(self.directory, options=opts)
+
+    def save(self, step: int, state: Any, metadata: Optional[dict] = None):
+        """``state`` is any pytree (TrainState works). ``metadata`` is the
+        config/hparams dict that travels with the weights."""
+        args = {"state": ocp.args.PyTreeSave(state)}
+        if metadata is not None:
+            args["metadata"] = ocp.args.JsonSave(metadata)
+        self._mgr.save(step, args=ocp.args.Composite(**args))
+        self._mgr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, state_template: Any, step: Optional[int] = None):
+        """Restore into the structure/shardings of ``state_template``.
+        Returns (state, metadata|None)."""
+        step = step if step is not None else self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint found in {self.directory}")
+        restored = self._mgr.restore(
+            step, args=ocp.args.Composite(
+                state=ocp.args.PyTreeRestore(state_template)))
+        meta = self.load_metadata(step)
+        return restored["state"], meta
+
+    def load_metadata(self, step: Optional[int] = None) -> Optional[dict]:
+        step = step if step is not None else self._mgr.latest_step()
+        if step is None:
+            return None
+        meta_path = os.path.join(self.directory, str(step), "metadata")
+        if not os.path.isdir(meta_path):
+            return None
+        try:
+            restored = self._mgr.restore(
+                step, args=ocp.args.Composite(metadata=ocp.args.JsonRestore()))
+            return restored["metadata"]
+        except Exception:
+            return None
+
+    def preflight(self, state: Any, metadata: Optional[dict] = None):
+        """Save-before-training so a broken checkpoint config fails immediately
+        (reference legacy/train_dalle.py:591-594)."""
+        self.save(0, state, metadata)
+
+    def close(self):
+        self._mgr.close()

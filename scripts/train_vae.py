@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Train the discrete VAE on TPU (or the CPU mesh).
+
+Reference: legacy/train_vae.py (full distributed flow, SURVEY.md §3.4) and the
+fork's vae.py (NaN rollback, best-loss checkpointing). One process per host;
+data-parallelism comes from the mesh, not a launcher.
+
+Examples:
+  python scripts/sampler.py --outdir /tmp/shapes --count 256 --image_size 64
+  python scripts/train_vae.py --image_folder /tmp/shapes --image_size 64 \
+      --num_layers 2 --hidden_dim 32 --num_tokens 256 --epochs 2 --batch_size 8
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_parser():
+    ap = argparse.ArgumentParser(description=__doc__)
+    data = ap.add_argument_group("data")
+    data.add_argument("--image_folder", type=str, default=None,
+                      help="folder of images (txt captions ignored for VAE)")
+    data.add_argument("--synthetic", action="store_true",
+                      help="train on the built-in shapes dataset")
+
+    model = ap.add_argument_group("model")
+    model.add_argument("--image_size", type=int, default=128)
+    model.add_argument("--num_tokens", type=int, default=8192)
+    model.add_argument("--codebook_dim", type=int, default=512)
+    model.add_argument("--num_layers", type=int, default=3)
+    model.add_argument("--num_resnet_blocks", type=int, default=1)
+    model.add_argument("--hidden_dim", type=int, default=64)
+    model.add_argument("--smooth_l1_loss", action="store_true")
+    model.add_argument("--kl_loss_weight", type=float, default=0.0)
+    model.add_argument("--straight_through", action="store_true")
+
+    train = ap.add_argument_group("training")
+    train.add_argument("--epochs", type=int, default=20)
+    train.add_argument("--batch_size", type=int, default=8)
+    train.add_argument("--learning_rate", type=float, default=1e-3)
+    train.add_argument("--lr_decay_rate", type=float, default=0.98)
+    train.add_argument("--starting_temp", type=float, default=1.0)
+    train.add_argument("--temp_min", type=float, default=0.5)
+    train.add_argument("--anneal_rate", type=float, default=1e-6)
+    train.add_argument("--clip_grad_norm", type=float, default=0.0)
+    train.add_argument("--output_dir", type=str, default="./vae_ckpt")
+    train.add_argument("--save_every_steps", type=int, default=1000)
+    train.add_argument("--keep_n_checkpoints", type=int, default=None)
+    train.add_argument("--seed", type=int, default=42)
+    train.add_argument("--steps", type=int, default=None,
+                       help="hard stop after N steps (overrides epochs)")
+    train.add_argument("--no_preflight", action="store_true")
+
+    from dalle_tpu.parallel import wrap_arg_parser
+    wrap_arg_parser(ap)
+    return ap
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if not args.image_folder and not args.synthetic:
+        print("error: provide --image_folder or --synthetic", file=sys.stderr)
+        return 2
+
+    from dalle_tpu.config import (AnnealConfig, DVAEConfig, OptimConfig, TrainConfig)
+    from dalle_tpu.parallel import set_backend_from_args
+    from dalle_tpu.train.trainer_vae import VAETrainer
+
+    backend = set_backend_from_args(args).initialize()
+    backend.check_batch_size(args.batch_size)
+
+    model_cfg = DVAEConfig(
+        image_size=args.image_size, num_tokens=args.num_tokens,
+        codebook_dim=args.codebook_dim, num_layers=args.num_layers,
+        num_resnet_blocks=args.num_resnet_blocks, hidden_dim=args.hidden_dim,
+        smooth_l1_loss=args.smooth_l1_loss, kl_div_loss_weight=args.kl_loss_weight,
+        straight_through=args.straight_through)
+    train_cfg = TrainConfig(
+        batch_size=args.batch_size, epochs=args.epochs, seed=args.seed,
+        checkpoint_dir=args.output_dir, save_every_steps=args.save_every_steps,
+        keep_n_checkpoints=args.keep_n_checkpoints,
+        preflight_checkpoint=not args.no_preflight,
+        optim=OptimConfig(learning_rate=args.learning_rate,
+                          grad_clip_norm=args.clip_grad_norm,
+                          lr_scheduler="exponential"))
+    anneal = AnnealConfig(starting_temp=args.starting_temp,
+                          temp_min=args.temp_min, anneal_rate=args.anneal_rate)
+
+    if args.synthetic:
+        from dalle_tpu.data.synthetic import ShapesDataset, batch_iterator
+        ds = ShapesDataset(image_size=args.image_size)
+        batches = batch_iterator(ds, args.batch_size, seed=args.seed,
+                                 epochs=args.epochs)
+    else:
+        from dalle_tpu.data.text_image import TextImageDataset
+        ds = TextImageDataset(args.image_folder, image_size=args.image_size,
+                              shuffle=True, seed=args.seed, text_from_filename=True)
+        batches = ds.batches(args.batch_size, epochs=args.epochs)
+
+    if backend.is_root_worker():
+        print(f"dVAE: {model_cfg.to_json()}")
+        print(f"dataset: {len(ds)} samples; mesh {dict(backend.mesh.shape)}")
+
+    trainer = VAETrainer(model_cfg, train_cfg, anneal, backend=backend)
+    log = print if backend.is_root_worker() else (lambda *a, **k: None)
+    trainer.fit(batches, steps=args.steps, log=log)
+
+    final = int(trainer.state.step)
+    trainer.ckpt.save(final, trainer.state,
+                      {"hparams": model_cfg.to_dict(), "train": train_cfg.to_dict(),
+                       "model_class": "DiscreteVAE"})
+    if backend.is_root_worker():
+        print(f"done at step {final}; checkpoints in {args.output_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

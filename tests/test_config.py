@@ -1,0 +1,63 @@
+"""Config tree round-trip + CLI override tests."""
+
+import argparse
+
+from dalle_tpu.config import (DalleConfig, DVAEConfig, MeshConfig, TrainConfig,
+                              VQGANConfig)
+
+
+def test_dict_roundtrip():
+    cfg = DalleConfig(depth=4, attn_types=("full", "axial_row"))
+    d = cfg.to_dict()
+    back = DalleConfig.from_dict(d)
+    assert back == cfg
+    assert back.attn_types == ("full", "axial_row")
+
+
+def test_json_roundtrip_nested():
+    cfg = TrainConfig(batch_size=32, mesh=MeshConfig(dp=2, tp=4))
+    back = TrainConfig.from_json(cfg.to_json())
+    assert back == cfg and back.mesh.tp == 4
+
+
+def test_cli_overrides_including_optional_tuple():
+    p = argparse.ArgumentParser()
+    DalleConfig.add_args(p)
+    args = p.parse_args(["--shared_attn_ids", "0,0,1,1", "--depth", "4",
+                         "--attn_types", "full,axial_row"])
+    cfg = DalleConfig.from_args(args)
+    assert cfg.shared_attn_ids == (0, 0, 1, 1)
+    assert cfg.depth == 4
+    assert cfg.attn_types == ("full", "axial_row")
+    # untouched fields keep defaults
+    assert cfg.dim == DalleConfig().dim
+
+
+def test_cli_nested_override():
+    p = argparse.ArgumentParser()
+    TrainConfig.add_args(p)
+    args = p.parse_args(["--optim.learning_rate", "0.01", "--mesh.tp", "2"])
+    cfg = TrainConfig.from_args(args)
+    assert cfg.optim.learning_rate == 0.01
+    assert cfg.mesh.tp == 2
+
+
+def test_bool_coercion_from_cli():
+    p = argparse.ArgumentParser()
+    DVAEConfig.add_args(p)
+    args = p.parse_args(["--straight_through", "true"])
+    assert DVAEConfig.from_args(args).straight_through is True
+    args = p.parse_args(["--straight_through", "false"])
+    assert DVAEConfig.from_args(args).straight_through is False
+
+
+def test_derived_properties():
+    cfg = DVAEConfig(image_size=128, num_layers=3)
+    assert cfg.fmap_size == 16 and cfg.image_seq_len == 256
+    d = DalleConfig(text_seq_len=256, image_fmap_size=32,
+                    num_text_tokens=10000, image_vocab_size=8192)
+    assert d.image_seq_len == 1024
+    assert d.total_seq_len == 1280
+    assert d.total_tokens == 10000 + 256 + 8192
+    v = VQGANConfig(resolution=256, attn_resolutions=(16,))
+    assert v.num_layers == 4
