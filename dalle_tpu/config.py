@@ -219,6 +219,8 @@ class DVAEConfig(ConfigBase):
 @dataclass(frozen=True)
 class TransformerConfig(ConfigBase):
     """Transformer stack (reference: dalle_pytorch/transformer.py:204-328)."""
+    seq_len: int = 512           # total text+image sequence length (no bos slot)
+    causal: bool = True
     dim: int = 512
     depth: int = 12
     heads: int = 8
@@ -290,6 +292,7 @@ class DalleConfig(ConfigBase):
 
     def transformer(self) -> TransformerConfig:
         return TransformerConfig(
+            seq_len=self.total_seq_len, causal=True,
             dim=self.dim, depth=self.depth, heads=self.heads, dim_head=self.dim_head,
             ff_mult=self.ff_mult, attn_dropout=self.attn_dropout, ff_dropout=self.ff_dropout,
             attn_types=self.attn_types, image_fmap_size=self.image_fmap_size,
@@ -361,6 +364,8 @@ class OptimConfig(ConfigBase):
     grad_clip_norm: float = 0.5          # ref: legacy/train_dalle.py --clip_grad_norm
     grad_accum_steps: int = 1            # ref: --ga_steps
     lr_decay: bool = False               # ReduceLROnPlateau equivalent (cosine here)
+    lr_decay_rate: float = 0.98          # exponential schedule gamma (ref --lr_decay_rate)
+    lr_transition_steps: int = 1000      # steps per exponential decay application
     warmup_steps: int = 0
     total_steps: int = 100_000
     lr_scheduler: str = "constant"       # constant | cosine | exponential | plateau

@@ -58,7 +58,7 @@ def shape_mask(shape: str, size: int, scale: float, rotation: float = 0.0) -> np
         return (np.abs(x) + np.abs(y)) <= s
     if shape == "triangle":
         # upward triangle: inside three half-planes
-        return (y <= s * 0.8) & (y >= -s * 0.8 + np.abs(x) * 1.6 / s * s) & (np.abs(x) <= s)
+        return (y <= s * 0.8) & (y >= -s * 0.8 + 1.6 * np.abs(x)) & (np.abs(x) <= s)
     if shape == "cross":
         arm = 0.35 * s
         return ((np.abs(x) <= arm) & (np.abs(y) <= s)) | ((np.abs(y) <= arm) & (np.abs(x) <= s))
@@ -134,9 +134,9 @@ class ShapesDataset:
     def as_arrays(self, limit: Optional[int] = None):
         """(images float32 [0,1] NHWC, captions list)."""
         n = min(len(self), limit) if limit else len(self)
-        imgs = np.stack([self[i].image for i in range(n)]).astype(np.float32) / 255.0
-        caps = [self[i].caption for i in range(n)]
-        return imgs, caps
+        samples = [self[i] for i in range(n)]
+        imgs = np.stack([s.image for s in samples]).astype(np.float32) / 255.0
+        return imgs, [s.caption for s in samples]
 
     def save_folder(self, outdir: str, count: Optional[int] = None):
         """Write labeled PNGs + caption .txt pairs (TextImageDataset layout,

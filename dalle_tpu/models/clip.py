@@ -1,0 +1,107 @@
+"""CLIP — contrastive text/image model for reranking generations.
+
+Reference: ``CLIP`` (dalle_pytorch/dalle_pytorch.py:256-332): token+positional
+embeddings, two non-causal Transformers, 32px patch embedding via rearrange+
+linear, masked-mean text pooling, L2-normalized latents, learned temperature,
+symmetric cross-entropy over the similarity matrix.
+
+TPU notes: patchification is a reshape (free under XLA), the two encoder stacks
+reuse the same Transformer core as DALLE (dense causal=False path), and the
+similarity matrix is one (b, d) @ (d, b) MXU matmul.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..config import ClipConfig, TransformerConfig
+from ..ops.sampling import masked_mean
+from .transformer import Transformer
+
+
+class CLIP(nn.Module):
+    cfg: ClipConfig
+
+    def setup(self):
+        c = self.cfg
+        self.text_emb = nn.Embed(c.num_text_tokens, c.dim_text, name="text_emb")
+        self.text_pos_emb = nn.Embed(c.text_seq_len, c.dim_text, name="text_pos_emb")
+        self.text_transformer = Transformer(TransformerConfig(
+            seq_len=c.text_seq_len, causal=False, dim=c.dim_text,
+            depth=c.text_enc_depth, heads=c.text_heads,
+            dim_head=c.dim_text // c.text_heads, attn_types=("full",),
+            image_fmap_size=0, rotary_emb=False), name="text_transformer")
+        self.to_text_latent = nn.Dense(c.dim_latent, use_bias=False,
+                                       name="to_text_latent")
+
+        num_patches = (c.visual_image_size // c.visual_patch_size) ** 2
+        patch_dim = c.channels * c.visual_patch_size ** 2
+        self.visual_patch_proj = nn.Dense(c.dim_image, name="to_visual_embedding")
+        self.visual_pos_emb = nn.Embed(num_patches, c.dim_image,
+                                       name="visual_pos_emb")
+        self.visual_transformer = Transformer(TransformerConfig(
+            seq_len=num_patches, causal=False, dim=c.dim_image,
+            depth=c.visual_enc_depth, heads=c.visual_heads,
+            dim_head=c.dim_image // c.visual_heads, attn_types=("full",),
+            image_fmap_size=0, rotary_emb=False), name="visual_transformer")
+        self.to_visual_latent = nn.Dense(c.dim_latent, use_bias=False,
+                                         name="to_visual_latent")
+        self.temperature = self.param("temperature", nn.initializers.ones, ())
+
+    def embed_text(self, text):
+        """(b, text_seq_len) ids → (b, dim_latent) L2-normalized."""
+        mask = text != 0
+        x = self.text_emb(text) + self.text_pos_emb(jnp.arange(text.shape[1]))
+        x = self.text_transformer(x, key_mask=mask)
+        x = masked_mean(x, mask)
+        lat = self.to_text_latent(x)
+        return lat / jnp.linalg.norm(lat, axis=-1, keepdims=True)
+
+    def embed_image(self, image):
+        """(b, H, W, C) NHWC floats → (b, dim_latent) L2-normalized."""
+        c = self.cfg
+        p = c.visual_patch_size
+        b, h, w, ch = image.shape
+        assert h == w == c.visual_image_size, (
+            f"image must be {c.visual_image_size}px, got {h}x{w}")
+        # (b, h/p, p, w/p, p, c) → (b, n_patches, p*p*c)
+        x = image.reshape(b, h // p, p, w // p, p, ch)
+        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, (h // p) * (w // p), -1)
+        x = self.visual_patch_proj(x)
+        x = x + self.visual_pos_emb(jnp.arange(x.shape[1]))
+        x = self.visual_transformer(x)
+        x = x.mean(axis=1)
+        lat = self.to_visual_latent(x)
+        return lat / jnp.linalg.norm(lat, axis=-1, keepdims=True)
+
+    def __call__(self, text, image, return_loss: bool = False):
+        """return_loss=False → per-pair similarity scores (the rerank path,
+        reference :553-555); True → symmetric InfoNCE loss (:329-332)."""
+        t = self.embed_text(text)
+        v = self.embed_image(image)
+        temp = jnp.exp(self.temperature)
+        if not return_loss:
+            return jnp.einsum("bd,bd->b", t, v) * temp
+        sim = jnp.einsum("id,jd->ij", t, v) * temp
+        labels = jnp.arange(sim.shape[0])
+        loss_t = _ce(sim, labels)
+        loss_v = _ce(sim.T, labels)
+        return (loss_t + loss_v) / 2
+
+
+def _ce(logits, labels):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+
+
+def init_clip(cfg: ClipConfig, key: jax.Array, batch: int = 1):
+    model = CLIP(cfg)
+    text = jnp.zeros((batch, cfg.text_seq_len), jnp.int32)
+    img = jnp.zeros((batch, cfg.visual_image_size, cfg.visual_image_size,
+                     cfg.channels), jnp.float32)
+    params = model.init(key, text, img, return_loss=True)
+    return model, params

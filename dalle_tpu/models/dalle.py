@@ -1,0 +1,331 @@
+"""DALL·E — the autoregressive text→image transformer.
+
+Reference: ``DALLE`` (dalle_pytorch/dalle_pytorch.py:336-653). Capability parity:
+per-position unique padding tokens (:370,578-579), <bos> prepend (:583), combined
+text+image vocab with the static logits mask (:428-439), 7:1 image loss weighting
+(:440,649-653), classifier-free-guidance text dropout (:570-574), stable-training
+tricks (token blend :615-617 + DivideMax), shared input/output embeddings
+(:71-83,421-423), axial positional embeddings when rotary is off, incremental
+decoding with caches, top-k+gumbel sampling, image priming, text generation.
+
+TPU redesign:
+  * The VAE is NOT a submodule. JAX has no "frozen submodule" notion worth
+    carrying; the model consumes image *token ids* and a thin ``DalleWithVae``
+    wrapper tokenizes raw pixels through any VAE adapter (reference freezes the
+    vae inside the module, :386-387 — same capability, cleaner separation).
+  * ``generate_images`` is a single ``lax.scan`` over a preallocated cache
+    pytree: O(1) compilations, static shapes, runs entirely on-device.
+  * CFG keeps TWO caches (conditioned + null-text). The reference's cached CFG
+    forks the *conditioned* cache for the null pass every step
+    (dalle_pytorch.py:528-538), so its null branch silently attends to
+    conditioned text keys; this implements the semantics its uncached path
+    (use_cache=False) defines. Not a copy — a fix.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import DalleConfig
+from ..ops.sampling import gumbel_sample, prob_mask_like, top_k_filter
+from .transformer import DivideMax, Transformer
+
+MASK_VALUE = -1e9  # max_neg/2-style fill for the logits mask
+
+
+class AxialPositionalEmbedding(nn.Module):
+    """Learned factored 2D position embedding: row + col tables broadcast over
+    the grid and summed (reference axial_positional_embedding.py:6-74, used with
+    full-dim per axis as DALLE does)."""
+    dim: int
+    shape: Tuple[int, int]
+
+    def setup(self):
+        h, w = self.shape
+        init = nn.initializers.normal(stddev=1.0)
+        self.row = self.param("row", init, (h, 1, self.dim))
+        self.col = self.param("col", init, (1, w, self.dim))
+
+    def __call__(self, n: Optional[int] = None):
+        h, w = self.shape
+        emb = (self.row + self.col).reshape(h * w, self.dim)
+        return emb if n is None else emb[:n]
+
+
+class DALLE(nn.Module):
+    cfg: DalleConfig
+
+    def setup(self):
+        c = self.cfg
+        self.num_text_tokens = c.num_text_tokens + c.text_seq_len  # + per-pos pads
+        self.total_tokens = self.num_text_tokens + c.image_vocab_size
+        self.transformer = Transformer(c.transformer(), name="transformer")
+
+        if c.share_input_output_emb:
+            # one (total_tokens, dim) table serves both embeddings and the
+            # output projection (reference SharedEmbedding, :71-83)
+            self.shared_emb = self.param(
+                "shared_emb", nn.initializers.normal(stddev=0.02),
+                (self.total_tokens, c.dim))
+            self.logits_bias = self.param(
+                "logits_bias", nn.initializers.zeros, (self.total_tokens,))
+        else:
+            self.text_emb = nn.Embed(self.num_text_tokens, c.dim, name="text_emb")
+            self.image_emb = nn.Embed(c.image_vocab_size, c.dim, name="image_emb")
+            self.head = nn.Dense(self.total_tokens, name="to_logits")
+
+        if not c.rotary_emb:
+            self.text_pos_emb = nn.Embed(c.text_seq_len + 1, c.dim,
+                                         name="text_pos_emb")
+            self.image_pos_emb = AxialPositionalEmbedding(
+                c.dim, (c.image_fmap_size, c.image_fmap_size),
+                name="image_pos_emb")
+
+        self.final_norm = nn.LayerNorm(name="final_norm")
+        self.norm_by_max = DivideMax(axis=-1)
+
+        # static (seq, total_tokens) allow-mask: text positions predict text
+        # tokens, image positions image tokens (reference :428-439, inverted
+        # polarity: here True = allowed)
+        seq_range = np.arange(c.total_seq_len)[:, None]
+        logit_range = np.arange(self.total_tokens)[None, :]
+        forbidden = (((seq_range >= c.text_seq_len) & (logit_range < self.num_text_tokens)) |
+                     ((seq_range < c.text_seq_len) & (logit_range >= self.num_text_tokens)))
+        self.logits_allow = jnp.asarray(~forbidden)
+
+    # -- embedding helpers -------------------------------------------------
+    def _embed_text_ids(self, ids):
+        if self.cfg.share_input_output_emb:
+            return jnp.take(self.shared_emb, ids, axis=0)
+        return self.text_emb(ids)
+
+    def _embed_image_ids(self, ids):
+        if self.cfg.share_input_output_emb:
+            return jnp.take(self.shared_emb, ids + self.num_text_tokens, axis=0)
+        return self.image_emb(ids)
+
+    def _logits(self, x):
+        x = self.final_norm(x)
+        if self.cfg.share_input_output_emb:
+            return x @ self.shared_emb.T + self.logits_bias
+        return self.head(x)
+
+    def remap_and_bos(self, text):
+        """0-pads → unique per-position pad ids; prepend <bos>=0
+        (reference :578-583). Text longer than text_seq_len is cropped, shorter
+        is 0-padded (reference generate_images crops at :507; tokenizers pad)."""
+        c = self.cfg
+        n = text.shape[1]
+        if n > c.text_seq_len:
+            text = text[:, :c.text_seq_len]
+        elif n < c.text_seq_len:
+            text = jnp.pad(text, ((0, 0), (0, c.text_seq_len - n)))
+        pad_ids = jnp.arange(c.text_seq_len) + c.num_text_tokens
+        text = jnp.where(text == 0, pad_ids[None, :], text)
+        return jnp.pad(text, ((0, 0), (1, 0)))  # <bos> id 0
+
+    def embed_text(self, text_with_bos):
+        n = text_with_bos.shape[1]
+        tok = self._embed_text_ids(text_with_bos)
+        if not self.cfg.rotary_emb:
+            tok = tok + self.text_pos_emb(jnp.arange(n))
+        return tok
+
+    def embed_image(self, image_ids, first_pos: int = 0):
+        tok = self._embed_image_ids(image_ids)
+        if not self.cfg.rotary_emb:
+            n = image_ids.shape[1]
+            tok = tok + self.image_pos_emb()[first_pos:first_pos + n]
+        return tok
+
+    def _stabilize(self, tokens):
+        if self.cfg.stable:  # α-blend trick (reference :615-617)
+            alpha = 0.1
+            tokens = tokens * alpha + jax.lax.stop_gradient(tokens) * (1 - alpha)
+        return tokens
+
+    def _finish(self, x, mask_rows):
+        """transformer output → masked logits. ``mask_rows``: (start, n) row
+        window of the static logits mask aligned with these positions."""
+        if self.cfg.stable:
+            x = self.norm_by_max(x)
+        logits = self._logits(x)
+        start, n = mask_rows
+        allow = jax.lax.dynamic_slice_in_dim(self.logits_allow, start, n, axis=0)
+        return jnp.where(allow[None], logits, MASK_VALUE)
+
+    # -- training forward --------------------------------------------------
+    def __call__(self, text, image_ids, return_loss: bool = False,
+                 null_cond_prob: float = 0.0, deterministic: bool = True):
+        """``text``: (b, text_seq_len) int32 (0 = pad); ``image_ids``:
+        (b, image_seq_len) int32 codebook indices."""
+        c = self.cfg
+        assert text.shape[1] == c.text_seq_len, (
+            f"text must be {c.text_seq_len} tokens, got {text.shape[1]}")
+
+        if null_cond_prob > 0:
+            # CFG dropout: whole-row text nulling (reference :570-574)
+            null = prob_mask_like(self.make_rng("cfg"), (text.shape[0],),
+                                  null_cond_prob)
+            text = jnp.where(null[:, None], 0, text)
+
+        text_b = self.remap_and_bos(text)
+        tokens = jnp.concatenate(
+            [self.embed_text(text_b), self.embed_image(image_ids)], axis=1)
+        # drop final token when over length (reference :608-613)
+        if tokens.shape[1] > c.total_seq_len:
+            tokens = tokens[:, :c.total_seq_len]
+        tokens = self._stabilize(tokens)
+
+        out = self.transformer(tokens, deterministic=deterministic)
+        logits = self._finish(out, (0, tokens.shape[1]))
+
+        if not return_loss:
+            return logits
+
+        labels = jnp.concatenate(
+            [text_b[:, 1:], image_ids + self.num_text_tokens], axis=1)
+        logits32 = logits.astype(jnp.float32)
+        ce = _cross_entropy(logits32, labels)
+        loss_text = ce[:, :c.text_seq_len].mean()
+        loss_img = ce[:, c.text_seq_len:].mean()
+        loss = (loss_text + c.loss_img_weight * loss_img) / (c.loss_img_weight + 1)
+        return loss, {"loss_text": loss_text, "loss_img": loss_img}
+
+    # -- generation --------------------------------------------------------
+    def _prefill(self, text, image_prime: Optional[jnp.ndarray], batch: int,
+                 dtype=jnp.float32):
+        c = self.cfg
+        cache = self.transformer.init_cache(batch, c.total_seq_len, dtype)
+        text_b = self.remap_and_bos(text)
+        tokens = self.embed_text(text_b)
+        if image_prime is not None and image_prime.shape[1] > 0:
+            tokens = jnp.concatenate(
+                [tokens, self.embed_image(image_prime)], axis=1)
+        tokens = self._stabilize(tokens)
+        y, cache = self.transformer.prefill(tokens, cache)
+        logits = self._finish(y[:, -1:], (tokens.shape[1] - 1, 1))[:, 0]
+        return logits, cache, tokens.shape[1]
+
+    def _decode_one(self, token_id, img_pos, offset, cache):
+        """Embed image token sampled at image position ``img_pos`` and advance."""
+        tok = self._embed_image_ids(token_id[:, None])
+        if not self.cfg.rotary_emb:
+            emb = self.image_pos_emb()
+            tok = tok + jax.lax.dynamic_slice_in_dim(emb, img_pos, 1, axis=0)[None]
+        tok = self._stabilize(tok)
+        y, cache = self.transformer.decode_step(tok, cache, offset)
+        logits = self._finish(y, (offset, 1))[:, 0]
+        return logits, cache
+
+    def generate_images_tokens(self, text, key, *, filter_thres: float = 0.5,
+                               temperature: float = 1.0, cond_scale: float = 1.0,
+                               image_prime: Optional[jnp.ndarray] = None):
+        """AR-sample the full image token sequence. Returns (b, image_seq_len)
+        int32 codebook ids. ``text`` must be (b, text_seq_len).
+        (reference generate_images :490-557 minus vae decode/CLIP, which live in
+        DalleWithVae)"""
+        c = self.cfg
+        b = text.shape[0]
+        n_prime = 0 if image_prime is None else image_prime.shape[1]
+        n_steps = c.image_seq_len - n_prime
+        use_cfg = cond_scale != 1.0
+
+        logits, cache, prefix_len = self._prefill(text, image_prime, b)
+        if use_cfg:
+            null_text = jnp.zeros_like(text)  # all-pad after remap
+            null_logits, null_cache, _ = self._prefill(null_text, image_prime, b)
+            logits = null_logits + (logits - null_logits) * cond_scale
+
+        def sample_from(logits, k):
+            band = logits[:, self.num_text_tokens:]  # image band only
+            filtered = top_k_filter(band, thres=filter_thres)
+            return gumbel_sample(k, filtered, temperature=temperature).astype(jnp.int32)
+
+        def body(carry, i):
+            logits, cache, null_cache, k = carry
+            k, sub = jax.random.split(k)
+            tok = sample_from(logits, sub)
+            img_pos = n_prime + i
+            offset = prefix_len + i
+            new_logits, cache = self._decode_one(tok, img_pos, offset, cache)
+            if use_cfg:
+                nl, null_cache = self._decode_one(tok, img_pos, offset, null_cache)
+                new_logits = nl + (new_logits - nl) * cond_scale
+            return (new_logits, cache, null_cache, k), tok
+
+        # when CFG is off the null slot carries a scalar placeholder, not a
+        # second copy of the cache
+        init = (logits, cache, null_cache if use_cfg else jnp.zeros(()), key)
+        (last_logits, *_), toks = nn.scan(
+            lambda m, carry, i: body(carry, i),
+            variable_broadcast="params", split_rngs={"params": False},
+            length=n_steps - 1)(self, init, jnp.arange(n_steps - 1))
+        # final token sampled from the last logits (no decode needed after it)
+        final = sample_from(last_logits, jax.random.fold_in(key, n_steps))
+        toks = jnp.moveaxis(toks, 0, 1)  # (b, n_steps-1)
+        out = jnp.concatenate([toks, final[:, None]], axis=1)
+        if image_prime is not None and n_prime > 0:
+            out = jnp.concatenate([image_prime, out], axis=1)
+        return out
+
+    def generate_texts_tokens(self, key, text: Optional[jnp.ndarray] = None, *,
+                              batch: int = 1, filter_thres: float = 0.5,
+                              temperature: float = 1.0):
+        """Complete a text prefix to text_seq_len tokens by AR sampling over the
+        text band (reference generate_texts :443-488). Returns (b, text_seq_len)."""
+        c = self.cfg
+        if text is None:
+            text = jnp.zeros((batch, 0), jnp.int32)
+        b, start = text.shape
+        cache = self.transformer.init_cache(b, c.total_seq_len)
+        # prefix: bos + given tokens (no pad remap — these are real tokens)
+        ids = jnp.pad(text, ((0, 0), (1, 0)))
+        tokens = self._stabilize(self.embed_text(ids))
+        y, cache = self.transformer.prefill(tokens, cache)
+        logits = self._finish(y[:, -1:], (start, 1))[:, 0]
+
+        def sample_text(logits, k):
+            filtered = top_k_filter(logits[:, :self.num_text_tokens],
+                                    thres=filter_thres)
+            return gumbel_sample(k, filtered, temperature=temperature).astype(jnp.int32)
+
+        def body(carry, i):
+            logits, cache, k = carry
+            k, sub = jax.random.split(k)
+            tok = sample_text(logits, sub)
+            pos = start + 1 + i  # position of this token (after bos)
+            emb = self._embed_text_ids(tok[:, None])
+            if not c.rotary_emb:
+                emb = emb + self.text_pos_emb(jnp.array([pos]))[None]
+            emb = self._stabilize(emb)
+            y, cache = self.transformer.decode_step(emb, cache, pos)
+            new_logits = self._finish(y, (pos, 1))[:, 0]
+            return (new_logits, cache, k), tok
+
+        n_new = c.text_seq_len - start
+        (last_logits, *_), toks = nn.scan(
+            lambda m, carry, i: body(carry, i),
+            variable_broadcast="params", split_rngs={"params": False},
+            length=n_new - 1)(self, (logits, cache, key), jnp.arange(n_new - 1))
+        final = sample_text(last_logits, jax.random.fold_in(key, n_new))
+        toks = jnp.moveaxis(toks, 0, 1)
+        return jnp.concatenate([text, toks, final[:, None]], axis=1)
+
+
+def _cross_entropy(logits, labels):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+
+
+def init_dalle(cfg: DalleConfig, key: jax.Array, batch: int = 1):
+    model = DALLE(cfg)
+    text = jnp.zeros((batch, cfg.text_seq_len), jnp.int32)
+    img = jnp.zeros((batch, cfg.image_seq_len), jnp.int32)
+    params = model.init({"params": key, "cfg": key}, text, img, return_loss=True)
+    return model, params

@@ -1,0 +1,427 @@
+"""The transformer stack — training forward + cached incremental decode.
+
+Reference: dalle_pytorch/transformer.py (Transformer :204-350, LayerScale :74-88,
+PreNorm :92-102, GEGLU/FeedForward :106-122, PreShiftToken :126-200, DivideMax
+:29-36, cache adapters :38-71) and attention.py (full/axial/conv/sparse variants).
+
+TPU-first redesign decisions:
+  * Every sparse attention variant is the dense MXU kernel + a compile-time
+    static mask (ops/attn_masks.py). The reference itself proves mask-equivalence
+    via `optimize_for_inference` (transformer.py:333-350). Pallas kernels slot in
+    behind the same interface for long sequences (cfg.use_pallas).
+  * The decode cache is a pytree of preallocated buffers threaded functionally
+    (static shapes under jit/scan) — replacing the reference's mutated dicts,
+    growing concats, and deques (transformer.py:38-71,138-153; attention.py:71-76).
+  * Token-shift ring buffers store *pre-shift* chunks in both prefill and decode.
+    (The reference's prefill stores post-shift chunks (transformer.py:193-197) —
+    inconsistent with its own decode path (:144) — a latent bug that only
+    manifests with image priming + shift_tokens; not replicated.)
+  * Layer sharing (shared_attn_ids/shared_ff_ids) is flax module reuse: calling
+    one module instance at several depths shares its params. Caches stay
+    per-depth, matching the reference's per-index cache keys (:280-287).
+  * Dropout keys are explicit; reversible blocks don't need the reference's RNG
+    save/restore dance (reversible.py:20-50).
+"""
+
+from __future__ import annotations
+
+from itertools import cycle, islice
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import TransformerConfig
+from ..ops.attention import KVCache, attend, cached_attend
+from ..ops.attn_masks import build_mask
+from ..ops.rotary import apply_rotary, dalle_pos_emb
+
+
+def layerscale_init_eps(layer_index_1based: int) -> float:
+    """Per-layer LayerScale init (reference transformer.py:74-83: 0.1 up to
+    depth 18, 1e-5 to 24, 1e-6 beyond — keyed on the 1-based layer index)."""
+    if layer_index_1based <= 18:
+        return 0.1
+    if layer_index_1based <= 24:
+        return 1e-5
+    return 1e-6
+
+
+class DivideMax(nn.Module):
+    """Divide by detached max — stable-output trick (reference :29-36)."""
+    axis: int = -1
+
+    def __call__(self, x):
+        maxes = jax.lax.stop_gradient(jnp.max(x, axis=self.axis, keepdims=True))
+        return x / maxes
+
+
+class GEGLUFeedForward(nn.Module):
+    """Linear(dim→dim·mult·2) → GEGLU → Dropout → Linear(dim·mult→dim)
+    (reference :106-122)."""
+    dim: int
+    mult: int = 4
+    dropout: float = 0.0
+
+    def setup(self):
+        self.w1 = nn.Dense(self.dim * self.mult * 2, name="w1")
+        self.w2 = nn.Dense(self.dim, name="w2")
+        self.drop = nn.Dropout(self.dropout)
+
+    def __call__(self, x, deterministic: bool = True):
+        x, gates = jnp.split(self.w1(x), 2, axis=-1)
+        x = x * jax.nn.gelu(gates)
+        x = self.drop(x, deterministic=deterministic)
+        return self.w2(x)
+
+
+class Attention(nn.Module):
+    """Multi-head attention over the shared dense core (reference attention.py:39-99).
+    Rotary is applied to q, k AND v — preserved reference behavior (:66-67)."""
+    dim: int
+    heads: int
+    dim_head: int
+    dropout: float = 0.0
+    causal: bool = True
+    stable: bool = False
+
+    def setup(self):
+        inner = self.heads * self.dim_head
+        self.to_qkv = nn.Dense(inner * 3, use_bias=False, name="to_qkv")
+        self.to_out = nn.Dense(self.dim, name="to_out")
+        self.drop = nn.Dropout(self.dropout)
+
+    def _split(self, qkv, n):
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (-1, n, self.heads, self.dim_head)
+        return [t.reshape(shape).transpose(0, 2, 1, 3) for t in (q, k, v)]
+
+    def __call__(self, x, *, key_mask=None, rotary=None, static_mask=None,
+                 deterministic: bool = True):
+        b, n, _ = x.shape
+        q, k, v = self._split(self.to_qkv(x), n)
+        if rotary is not None:
+            rot = rotary[:n][None, None]
+            q, k, v = (apply_rotary(rot, t) for t in (q, k, v))
+        out = attend(q, k, v, causal=self.causal, key_mask=key_mask,
+                     static_mask=static_mask, stable=self.stable)
+        out = out.transpose(0, 2, 1, 3).reshape(b, n, -1)
+        return self.drop(self.to_out(out), deterministic=deterministic)
+
+    def prefill(self, x, cache: KVCache, *, rotary=None, static_mask=None):
+        """Full-prefix forward that also fills the KV cache from position 0."""
+        b, n, _ = x.shape
+        q, k, v = self._split(self.to_qkv(x), n)
+        if rotary is not None:
+            rot = rotary[:n][None, None]
+            q, k, v = (apply_rotary(rot, t) for t in (q, k, v))
+        cache = cache.append(k, v, 0)
+        out = attend(q, k, v, causal=self.causal, static_mask=static_mask,
+                     stable=self.stable)
+        out = out.transpose(0, 2, 1, 3).reshape(b, n, -1)
+        return self.to_out(out), cache
+
+    def decode(self, x_t, cache: KVCache, offset, *, rotary=None, static_mask=None):
+        """One-token step at position ``offset`` (traced scalar)."""
+        b = x_t.shape[0]
+        q, k, v = self._split(self.to_qkv(x_t), 1)
+        if rotary is not None:
+            rot = jax.lax.dynamic_slice_in_dim(rotary, offset, 1, axis=0)[None, None]
+            q, k, v = (apply_rotary(rot, t) for t in (q, k, v))
+        cache = cache.append(k, v, offset)
+        out = cached_attend(q, cache, offset + 1, static_mask=static_mask,
+                            stable=self.stable, qpos=offset)
+        out = out.transpose(0, 2, 1, 3).reshape(b, 1, -1)
+        return self.to_out(out), cache
+
+
+class ShiftState(NamedTuple):
+    """Ring buffers for cached token-shift decode: the (top, left) quarter-chunks
+    of the last ``image_size`` *pre-shift* inputs (reference deque,
+    transformer.py:138-153)."""
+    top: jnp.ndarray    # (b, image_size, d4)
+    left: jnp.ndarray   # (b, image_size, d4)
+
+    @classmethod
+    def init(cls, batch: int, image_size: int, d4: int, dtype=jnp.float32):
+        z = jnp.zeros((batch, image_size, d4), dtype)
+        return cls(z, z)
+
+
+def shift_tokens_full(x, text_len: int, image_size: int):
+    """Token-shift over a full sequence (reference PreShiftToken :155-186):
+    text: first ½ of channels from position t−1; image: first ¼ from the top
+    grid-neighbor, next ¼ from the left grid-neighbor."""
+    b, n, d = x.shape
+    if n < text_len:  # no image tokens yet — shift text only (ref :160-161)
+        half, rest = jnp.split(x, 2, axis=-1)
+        half = jnp.pad(half, ((0, 0), (1, 0), (0, 0)))[:, :n]
+        return jnp.concatenate((half, rest), axis=-1)
+
+    img_len = n - text_len
+    x_text, x_img = x[:, :text_len], x[:, text_len:]
+
+    t_shift, t_pass = jnp.split(x_text, 2, axis=-1)
+    t_shift = jnp.pad(t_shift, ((0, 0), (1, 0), (0, 0)))[:, :text_len]
+    x_text = jnp.concatenate((t_shift, t_pass), axis=-1)
+
+    pad_to = image_size * image_size - img_len
+    xi = jnp.pad(x_img, ((0, 0), (0, pad_to), (0, 0)))
+    xi = xi.reshape(b, image_size, image_size, d)
+    d4 = d // 4
+    top, left, rest = xi[..., :d4], xi[..., d4:2 * d4], xi[..., 2 * d4:]
+    top = jnp.pad(top, ((0, 0), (1, 0), (0, 0), (0, 0)))[:, :image_size]
+    left = jnp.pad(left, ((0, 0), (0, 0), (1, 0), (0, 0)))[:, :, :image_size]
+    xi = jnp.concatenate((top, left, rest), axis=-1)
+    x_img = xi.reshape(b, image_size * image_size, d)[:, :img_len]
+    return jnp.concatenate((x_text, x_img), axis=1)
+
+
+def shift_prefill_state(x, text_len: int, image_size: int,
+                        state: ShiftState) -> ShiftState:
+    """Fill the ring buffers after a full-prefix forward: slots for image
+    positions get their pre-shift chunks; text slots stay zero (matching the
+    reference's dummy-padded deque init, :192-197, but pre-shift — see module
+    docstring)."""
+    b, n, d = x.shape
+    d4 = d // 4
+    img_len = max(n - text_len, 0)
+    if img_len == 0:
+        return state
+    take = min(img_len, image_size)
+    chunk = x[:, n - take:n]
+    # positions n-take..n-1 → ring slots (pos - text_len) % image_size
+    pos = jnp.arange(n - take, n) - text_len
+    slots = pos % image_size
+    top = state.top.at[:, slots].set(chunk[..., :d4])
+    left = state.left.at[:, slots].set(chunk[..., d4:2 * d4])
+    return ShiftState(top, left)
+
+
+def shift_decode_step(x_t, state: ShiftState, offset, text_len: int,
+                      image_size: int):
+    """Cached one-token shift (reference :138-153). ``offset`` ≥ text_len.
+    Returns (shifted x_t, new state)."""
+    b, _, d = x_t.shape
+    d4 = d // 4
+    cur = x_t[:, 0]
+    cur_top, cur_left = cur[..., :d4], cur[..., d4:2 * d4]
+    img_pos = offset - text_len
+    ptr = img_pos % image_size
+    # top neighbor = value written image_size steps ago = current ring slot
+    top_n = jax.lax.dynamic_index_in_dim(state.top, ptr, axis=1, keepdims=False)
+    prev_ptr = (ptr - 1) % image_size
+    left_n = jax.lax.dynamic_index_in_dim(state.left, prev_ptr, axis=1, keepdims=False)
+    # zero top for the first image row; zero left at column 0 (ref :149-150 +
+    # the full path's zero padding)
+    top_n = jnp.where(img_pos < image_size, 0.0, top_n)
+    left_n = jnp.where(img_pos % image_size == 0, 0.0, left_n)
+    shifted = jnp.concatenate((top_n, left_n, cur[..., 2 * d4:]), axis=-1)[:, None]
+    state = ShiftState(
+        jax.lax.dynamic_update_slice_in_dim(state.top, cur_top[:, None], ptr, axis=1),
+        jax.lax.dynamic_update_slice_in_dim(state.left, cur_left[:, None], ptr, axis=1))
+    return shifted, state
+
+
+class TransformerLayer(nn.Module):
+    """PreNorm(+sandwich) → optional token-shift → fn, scaled by LayerScale,
+    residual added by the caller. One instance each for attn and ff roles."""
+    dim: int
+    index: int                     # 1-based, for LayerScale init
+    fn: nn.Module
+    sandwich: bool = False
+    shift: bool = False
+    text_len: int = 0
+    image_size: int = 0
+
+    def setup(self):
+        self.norm = nn.LayerNorm(name="norm")
+        self.norm_out = nn.LayerNorm(name="norm_out") if self.sandwich else None
+        eps = layerscale_init_eps(self.index)
+        self.scale = self.param("scale", lambda k: jnp.full((1, 1, self.dim), eps))
+
+    def _post(self, y):
+        if self.norm_out is not None:
+            y = self.norm_out(y)
+        return y * self.scale
+
+    def __call__(self, x, **kw):
+        y = self.norm(x)
+        if self.shift:
+            y = shift_tokens_full(y, self.text_len, self.image_size)
+        y = self.fn(y, **kw)
+        return self._post(y)
+
+    def prefill(self, x, kv: Optional[KVCache], shift_state: Optional[ShiftState],
+                **kw):
+        y = self.norm(x)
+        if self.shift:
+            pre = y
+            y = shift_tokens_full(y, self.text_len, self.image_size)
+            shift_state = shift_prefill_state(pre, self.text_len, self.image_size,
+                                              shift_state)
+        if isinstance(self.fn, Attention):
+            y, kv = self.fn.prefill(y, kv, **kw)
+        else:
+            y = self.fn(y)
+        return self._post(y), kv, shift_state
+
+    def decode(self, x_t, kv: Optional[KVCache], shift_state: Optional[ShiftState],
+               offset, **kw):
+        y = self.norm(x_t)
+        if self.shift:
+            y, shift_state = shift_decode_step(y, shift_state, offset,
+                                               self.text_len, self.image_size)
+        if isinstance(self.fn, Attention):
+            y, kv = self.fn.decode(y, kv, offset, **kw)
+        else:
+            y = self.fn(y)
+        return self._post(y), kv, shift_state
+
+
+class Transformer(nn.Module):
+    """depth × (attn, ff) with per-layer attention kind from the cyclic
+    ``attn_types`` tuple, layer sharing, rotary table, static sparse masks.
+    (reference Transformer ctor :204-328)"""
+    cfg: TransformerConfig
+
+    def setup(self):
+        c = self.cfg
+        fmap = c.image_fmap_size
+        img_seq = fmap * fmap
+        self.text_len = c.seq_len + 1 - img_seq if c.causal else 0
+
+        attn_types = tuple(c.attn_types) or ("full",)
+        type_per_layer = list(islice(cycle(attn_types), c.depth))
+        attn_ids = list(islice(cycle(c.shared_attn_ids or range(c.depth)), c.depth))
+        ff_ids = list(islice(cycle(c.shared_ff_ids or range(c.depth)), c.depth))
+
+        # static masks (None for 'full' — plain causal handled in attend);
+        # numpy constants folded by XLA. Built locally: flax freezes dict attrs.
+        masks: Dict[str, Optional[jnp.ndarray]] = {}
+        for t in set(type_per_layer):
+            if t == "full" or not c.causal:
+                masks[t] = None
+            else:
+                masks[t] = jnp.asarray(build_mask(
+                    t, self.text_len, fmap, kernel_size=c.sparse_attn_kernel,
+                    block=c.sparse_block_size,
+                    num_random_blocks=c.sparse_num_random_blocks))
+        self.masks = masks
+
+        shared_attn: Dict[Any, Tuple[Attention, str]] = {}
+        shared_ff: Dict[Any, GEGLUFeedForward] = {}
+        attn_layers, ff_layers = [], []
+        layer_types = []
+        for ind in range(c.depth):
+            t = type_per_layer[ind]
+            aid, fid = attn_ids[ind], ff_ids[ind]
+            if aid in shared_attn:
+                attn, prev_t = shared_attn[aid]
+                if prev_t != t:
+                    raise ValueError(
+                        f"attn_types do not match shared_attn_ids (ind={ind}, "
+                        f'attn_type="{t}", reused="{prev_t}")')
+            else:
+                attn = Attention(c.dim, c.heads, c.dim_head, c.attn_dropout,
+                                 causal=c.causal, stable=c.stable,
+                                 name=f"attn_{aid}")
+                shared_attn[aid] = (attn, t)
+            if fid in shared_ff:
+                ff = shared_ff[fid]
+            else:
+                ff = GEGLUFeedForward(c.dim, c.ff_mult, c.ff_dropout,
+                                      name=f"ff_{fid}")
+                shared_ff[fid] = ff
+            attn_layers.append(TransformerLayer(
+                c.dim, ind + 1, attn, sandwich=c.sandwich_norm,
+                shift=c.shift_tokens, text_len=self.text_len, image_size=fmap,
+                name=f"layer_attn_{ind}"))
+            ff_layers.append(TransformerLayer(
+                c.dim, ind + 1, ff, sandwich=c.sandwich_norm,
+                shift=c.shift_tokens, text_len=self.text_len, image_size=fmap,
+                name=f"layer_ff_{ind}"))
+            layer_types.append(t)
+        self.layer_types = layer_types
+        self.attn_layers = attn_layers
+        self.ff_layers = ff_layers
+
+        self.rotary = None
+        if c.rotary_emb and c.causal:
+            self.rotary = jnp.asarray(
+                dalle_pos_emb(self.text_len, fmap, c.dim_head))
+
+    # -- training / full forward ------------------------------------------
+    def __call__(self, x, key_mask=None, deterministic: bool = True):
+        """Sequential execution. Memory scaling for deep stacks comes from
+        rematerialization at the train-step level (jax.checkpoint over this
+        call) and the reversible path (models/reversible.py) — the TPU
+        equivalents of the reference's ReversibleSequence."""
+        c = self.cfg
+        for ind in range(c.depth):
+            attn_l, ff_l, t = self.attn_layers[ind], self.ff_layers[ind], self.layer_types[ind]
+            x = x + attn_l(x, key_mask=key_mask, rotary=self.rotary,
+                           static_mask=self.masks[t], deterministic=deterministic)
+            x = x + ff_l(x, deterministic=deterministic)
+        return x
+
+    # -- cached decode -----------------------------------------------------
+    def init_cache(self, batch: int, max_seq: Optional[int] = None,
+                   dtype=jnp.float32) -> Dict[str, Any]:
+        c = self.cfg
+        max_seq = max_seq or c.seq_len + 1
+        cache: Dict[str, Any] = {}
+        d4 = c.dim // 4
+        for ind in range(c.depth):
+            cache[f"kv_{ind}"] = KVCache.init(batch, c.heads, max_seq,
+                                              c.dim_head, dtype)
+            if c.shift_tokens:
+                cache[f"shift_attn_{ind}"] = ShiftState.init(
+                    batch, c.image_fmap_size, d4, dtype)
+                cache[f"shift_ff_{ind}"] = ShiftState.init(
+                    batch, c.image_fmap_size, d4, dtype)
+        return cache
+
+    def prefill(self, x, cache: Dict[str, Any]):
+        """Run the full prefix, filling every layer's caches. Returns (y, cache)."""
+        c = self.cfg
+        cache = dict(cache)
+        for ind in range(c.depth):
+            attn_l, ff_l, t = self.attn_layers[ind], self.ff_layers[ind], self.layer_types[ind]
+            y, kv, ss = attn_l.prefill(x, cache[f"kv_{ind}"],
+                                       cache.get(f"shift_attn_{ind}"),
+                                       rotary=self.rotary,
+                                       static_mask=self.masks[t])
+            cache[f"kv_{ind}"] = kv
+            if ss is not None:
+                cache[f"shift_attn_{ind}"] = ss
+            x = x + y
+            y, _, ss = ff_l.prefill(x, None, cache.get(f"shift_ff_{ind}"))
+            if ss is not None:
+                cache[f"shift_ff_{ind}"] = ss
+            x = x + y
+        return x, cache
+
+    def decode_step(self, x_t, cache: Dict[str, Any], offset):
+        """One token at traced position ``offset``. Returns (y_t, cache).
+        Sparse masks apply via their offset row; causality is implicit
+        (reference attention.py:86 'causality is naturally enforced')."""
+        c = self.cfg
+        cache = dict(cache)
+        for ind in range(c.depth):
+            attn_l, ff_l, t = self.attn_layers[ind], self.ff_layers[ind], self.layer_types[ind]
+            y, kv, ss = attn_l.decode(x_t, cache[f"kv_{ind}"],
+                                      cache.get(f"shift_attn_{ind}"), offset,
+                                      rotary=self.rotary,
+                                      static_mask=self.masks[t])
+            cache[f"kv_{ind}"] = kv
+            if ss is not None:
+                cache[f"shift_attn_{ind}"] = ss
+            x_t = x_t + y
+            y, _, ss = ff_l.decode(x_t, None, cache.get(f"shift_ff_{ind}"), offset)
+            if ss is not None:
+                cache[f"shift_ff_{ind}"] = ss
+            x_t = x_t + y
+        return x_t, cache

@@ -1,0 +1,127 @@
+"""VAE adapters + the DALLE↔VAE↔CLIP composition.
+
+The reference duck-types its VAEs behind image_size/num_layers/num_tokens/
+get_codebook_indices/decode (consumed at dalle_pytorch.py:365-368). Here that
+contract is an explicit adapter holding (model, params) pairs, because JAX
+models are (pure fn, pytree) — freezing the VAE (reference :386-387) is simply
+not differentiating through the adapter.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..config import DalleConfig, DVAEConfig
+from .clip import CLIP
+from .dalle import DALLE
+from .dvae import DiscreteVAE
+
+
+class VAEAdapter:
+    """Duck-typed VAE contract: image_size, num_layers, num_tokens,
+    get_codebook_indices(images NHWC float) -> (b, n) int32,
+    decode(ids) -> images NHWC float."""
+
+    image_size: int
+    num_layers: int
+    num_tokens: int
+
+    def get_codebook_indices(self, images):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def decode(self, ids):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    @property
+    def image_fmap_size(self) -> int:
+        return self.image_size // (2 ** self.num_layers)
+
+
+class DiscreteVAEAdapter(VAEAdapter):
+    def __init__(self, model: DiscreteVAE, params):
+        self.model = model
+        self.params = jax.lax.stop_gradient(params)
+        cfg = model.cfg
+        self.image_size = cfg.image_size
+        self.num_layers = cfg.num_layers
+        self.num_tokens = cfg.num_tokens
+        self._encode = jax.jit(lambda p, x: model.apply(
+            p, x, method=DiscreteVAE.get_codebook_indices))
+        self._decode = jax.jit(lambda p, ids: model.apply(
+            p, ids, method=DiscreteVAE.decode))
+
+    def get_codebook_indices(self, images):
+        return self._encode(self.params, images)
+
+    def decode(self, ids):
+        return self._decode(self.params, ids)
+
+
+def dalle_config_for_vae(vae: VAEAdapter, **dalle_kwargs) -> DalleConfig:
+    """Derive the image-side config fields from the vae, as the reference ctor
+    does (dalle_pytorch.py:365-368)."""
+    return DalleConfig(
+        image_size=vae.image_size,
+        image_vocab_size=vae.num_tokens,
+        image_fmap_size=vae.image_fmap_size,
+        **dalle_kwargs)
+
+
+@dataclass
+class DalleWithVae:
+    """Raw-pixel interface around DALLE: tokenizes images through the frozen vae
+    on the way in, decodes generated tokens to pixels on the way out, optional
+    CLIP rerank (reference DALLE.forward :590-597 / generate_images :548-555)."""
+    model: DALLE
+    params: Any
+    vae: VAEAdapter
+
+    def loss(self, text, images, key=None, null_cond_prob: float = 0.0,
+             deterministic: bool = True):
+        ids = self.vae.get_codebook_indices(images)
+        rngs = {}
+        if null_cond_prob > 0 and key is not None:
+            rngs["cfg"] = key
+        out, aux = self.model.apply(self.params, text, ids, return_loss=True,
+                                    null_cond_prob=null_cond_prob,
+                                    deterministic=deterministic,
+                                    rngs=rngs or None)
+        return out, aux
+
+    def generate_images(self, text, key, *, filter_thres: float = 0.5,
+                        temperature: float = 1.0, cond_scale: float = 1.0,
+                        img: Optional[jnp.ndarray] = None,
+                        num_init_img_tokens: Optional[int] = None,
+                        clip: Optional[tuple] = None):
+        """text (b, text_seq_len) → images (b, H, W, C) in [0,1]; optionally
+        (images, clip_scores). ``img`` primes the first 43.75% of image tokens
+        (reference :510-519, OpenAI's 14/32 rows)."""
+        prime = None
+        if img is not None:
+            n_prime = num_init_img_tokens
+            if n_prime is None:
+                n_prime = int(0.4375 * self.model.cfg.image_seq_len)
+            assert n_prime < self.model.cfg.image_seq_len
+            prime = self.vae.get_codebook_indices(img)[:, :n_prime]
+        ids = self.model.apply(
+            self.params, text, key, filter_thres=filter_thres,
+            temperature=temperature, cond_scale=cond_scale, image_prime=prime,
+            method=DALLE.generate_images_tokens)
+        images = self.vae.decode(ids)
+        if clip is not None:
+            clip_model, clip_params = clip
+            # pad-remapped ids exceed CLIP's text vocab; zero them back to pad
+            clip_text = jnp.where(text >= clip_model.cfg.num_text_tokens, 0, text)
+            scores = clip_model.apply(clip_params, clip_text, images)
+            return images, scores
+        return images
+
+    def generate_texts(self, key, text=None, *, batch: int = 1,
+                       filter_thres: float = 0.5, temperature: float = 1.0):
+        return self.model.apply(self.params, key, text, batch=batch,
+                                filter_thres=filter_thres, temperature=temperature,
+                                method=DALLE.generate_texts_tokens)

@@ -104,7 +104,9 @@ def cached_attend(q: jnp.ndarray, cache: KVCache, length, *,
         if qpos is None:
             qpos = length - 1
         row = jax.lax.dynamic_index_in_dim(static_mask, qpos, axis=0, keepdims=False)
-        valid = valid & row[None, None, None, :]
+        # the mask may cover more positions than the cache holds (e.g. the final
+        # sequence slot that is sampled but never fed back) — trim to cache size
+        valid = valid & row[: cache.k.shape[2]][None, None, None, :]
     dots = jnp.where(valid, dots, NEG_INF)
     softmax = stable_softmax if stable else jax.nn.softmax
     attn = softmax(dots.astype(jnp.float32), axis=-1).astype(cache.v.dtype)

@@ -47,9 +47,10 @@ def make_lr_schedule(cfg: OptimConfig):
                                             max(cfg.total_steps - cfg.warmup_steps, 1))
     elif cfg.lr_scheduler == "exponential":
         # reference train_vae uses ExponentialLR(gamma=lr_decay_rate) per epoch;
-        # here decay is per-step with the same end-to-end ratio semantics
-        sched = optax.exponential_decay(cfg.learning_rate, transition_steps=1000,
-                                        decay_rate=0.98)
+        # here decay applies every lr_transition_steps steps
+        sched = optax.exponential_decay(cfg.learning_rate,
+                                        transition_steps=cfg.lr_transition_steps,
+                                        decay_rate=cfg.lr_decay_rate)
     elif cfg.lr_scheduler == "plateau":
         # ReduceLROnPlateau is control-flow on a host metric; approximated by
         # cosine decay (the trainer may also rebuild the tx on plateau host-side)

@@ -48,15 +48,10 @@ def make_vae_train_step(model: DiscreteVAE):
         (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, images, key, temp)
         state = state.apply_gradients(grads)
-        gnorm = optax_global_norm(grads)
-        return state, {"loss": loss, "grad_norm": gnorm}
+        import optax
+        return state, {"loss": loss, "grad_norm": optax.global_norm(grads)}
 
     return step
-
-
-def optax_global_norm(tree):
-    return jnp.sqrt(sum(jnp.sum(x.astype(jnp.float32) ** 2)
-                        for x in jax.tree.leaves(tree)))
 
 
 from functools import partial
@@ -93,7 +88,8 @@ class VAETrainer:
         self.base_key = key
         self.ckpt = CheckpointManager(train_cfg.checkpoint_dir,
                                       keep_n=train_cfg.keep_n_checkpoints)
-        self._last_good = None  # host copy for NaN rollback
+        self._last_good = None   # host copy of (params, opt_state) for NaN rollback
+        self._host_step = 0      # host mirror of state.step: no device sync per step
 
         n = count_params(self.state.params)
         self.meter = ThroughputMeter(train_cfg.batch_size, train_cfg.log_every,
@@ -103,12 +99,13 @@ class VAETrainer:
 
     # -- single step -------------------------------------------------------
     def train_step(self, images: np.ndarray):
-        step_num = int(self.state.step)
+        step_num = self._host_step
         temp = anneal_temperature(self.anneal_cfg, step_num)
         key = jax.random.fold_in(self.base_key, step_num)
         images = shard_batch(self.mesh, images.astype(np.float32))
         self.state, metrics = self.step_fn(self.state, images, key,
                                            jnp.float32(temp))
+        self._host_step += 1
         metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
         metrics["temperature"] = temp
         rep = self.meter.step(step_num)
@@ -126,7 +123,7 @@ class VAETrainer:
         self._snapshot_good()
         for images, _ in batches:
             m = self.train_step(images)
-            step_num = int(self.state.step)
+            step_num = self._host_step
             if tc.nan_rollback and not math.isfinite(m["loss"]):
                 log(f"[step {step_num}] NaN loss — rolling back to last good state")
                 self._rollback()
@@ -142,12 +139,19 @@ class VAETrainer:
         return self.state
 
     def _snapshot_good(self):
-        self._last_good = jax.device_get(self.state.params)
+        # NaN loss is observed AFTER apply_gradients has run, so the optimizer
+        # moments are poisoned too — snapshot and restore both (the reference
+        # fork reloads the whole checkpoint, vae.py:100-110)
+        live = (self.state.params, self.state.opt_state)
+        self._last_good = jax.device_get(live)
+        self._last_good_shardings = jax.tree.map(lambda x: x.sharding, live)
 
     def _rollback(self):
         if self._last_good is not None:
-            params = shard_params(self.mesh, self._last_good)
-            self.state = self.state.replace(params=params)
+            restored = jax.tree.map(jax.device_put, self._last_good,
+                                    self._last_good_shardings)
+            params, opt_state = restored
+            self.state = self.state.replace(params=params, opt_state=opt_state)
 
     # -- eval utilities ----------------------------------------------------
     def reconstruct(self, images: np.ndarray, hard: bool = True):

@@ -85,7 +85,8 @@ def main(argv=None):
         preflight_checkpoint=not args.no_preflight,
         optim=OptimConfig(learning_rate=args.learning_rate,
                           grad_clip_norm=args.clip_grad_norm,
-                          lr_scheduler="exponential"))
+                          lr_scheduler="exponential",
+                          lr_decay_rate=args.lr_decay_rate))
     anneal = AnnealConfig(starting_temp=args.starting_temp,
                           temp_min=args.temp_min, anneal_rate=args.anneal_rate)
 
@@ -109,9 +110,10 @@ def main(argv=None):
     trainer.fit(batches, steps=args.steps, log=log)
 
     final = int(trainer.state.step)
-    trainer.ckpt.save(final, trainer.state,
-                      {"hparams": model_cfg.to_dict(), "train": train_cfg.to_dict(),
-                       "model_class": "DiscreteVAE"})
+    if trainer.ckpt.latest_step() != final:  # avoid re-saving an existing step
+        trainer.ckpt.save(final, trainer.state,
+                          {"hparams": model_cfg.to_dict(), "train": train_cfg.to_dict(),
+                           "model_class": "DiscreteVAE"})
     if backend.is_root_worker():
         print(f"done at step {final}; checkpoints in {args.output_dir}")
     return 0

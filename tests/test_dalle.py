@@ -1,0 +1,168 @@
+"""DALLE model tests: vocab layout, loss, masks, generation consistency, CLIP."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_tpu.config import ClipConfig, DalleConfig
+from dalle_tpu.models.clip import CLIP, init_clip
+from dalle_tpu.models.dalle import DALLE, init_dalle
+
+CFG = DalleConfig(num_text_tokens=100, text_seq_len=8, dim=32, depth=2, heads=2,
+                  dim_head=16, image_vocab_size=64, image_fmap_size=4,
+                  attn_types=("full", "axial_row"))
+
+
+@pytest.fixture(scope="module")
+def dalle():
+    return init_dalle(CFG, jax.random.PRNGKey(0), batch=2)
+
+
+def rand_inputs(key=0, b=2):
+    rng = np.random.RandomState(key)
+    text = jnp.asarray(rng.randint(1, 100, (b, CFG.text_seq_len)), jnp.int32)
+    img = jnp.asarray(rng.randint(0, 64, (b, CFG.image_seq_len)), jnp.int32)
+    return text, img
+
+
+class TestForward:
+    def test_loss_and_logits_shapes(self, dalle):
+        model, params = dalle
+        text, img = rand_inputs()
+        loss, aux = model.apply(params, text, img, return_loss=True)
+        assert loss.shape == () and jnp.isfinite(loss)
+        logits = model.apply(params, text, img)
+        assert logits.shape == (2, CFG.total_seq_len, CFG.total_tokens)
+
+    def test_logits_mask_bands(self, dalle):
+        """Text positions must only be able to predict text tokens; image
+        positions only image tokens (reference logits_mask :428-439)."""
+        model, params = dalle
+        text, img = rand_inputs()
+        logits = np.asarray(model.apply(params, text, img))
+        ntt = CFG.num_text_tokens + CFG.text_seq_len
+        # text rows: image band masked
+        assert (logits[:, :CFG.text_seq_len, ntt:] <= -1e8).all()
+        assert (logits[:, :CFG.text_seq_len, :ntt] > -1e8).any()
+        # image rows: text band masked
+        assert (logits[:, CFG.text_seq_len:, :ntt] <= -1e8).all()
+        assert (logits[:, CFG.text_seq_len:, ntt:] > -1e8).any()
+
+    def test_unique_pad_remap_changes_output(self, dalle):
+        """Two different texts that share the same pad positions must embed pads
+        identically per position, but pads at different positions differently."""
+        model, params = dalle
+        _, img = rand_inputs()
+        t1 = jnp.asarray([[5, 0, 7, 0, 9, 11, 13, 15]], jnp.int32)
+        t2 = jnp.asarray([[5, 0, 7, 0, 9, 11, 13, 15]], jnp.int32)
+        l1 = model.apply(params, t1, img[:1])
+        l2 = model.apply(params, t2, img[:1])
+        np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=1e-6)
+        # pad moved to a different position → different representation
+        t3 = jnp.asarray([[5, 7, 0, 0, 9, 11, 13, 15]], jnp.int32)
+        l3 = model.apply(params, t3, img[:1])
+        assert not np.allclose(np.asarray(l1), np.asarray(l3), atol=1e-4)
+
+    def test_loss_weighting(self, dalle):
+        model, params = dalle
+        text, img = rand_inputs()
+        loss, aux = model.apply(params, text, img, return_loss=True)
+        expect = (aux["loss_text"] + CFG.loss_img_weight * aux["loss_img"]) / (
+            CFG.loss_img_weight + 1)
+        np.testing.assert_allclose(float(loss), float(expect), rtol=1e-6)
+
+    def test_cfg_dropout_nulls_text(self, dalle):
+        model, params = dalle
+        text, img = rand_inputs()
+        l_cond = model.apply(params, text, img)
+        l_null = model.apply(params, text, img, null_cond_prob=1.0,
+                             rngs={"cfg": jax.random.PRNGKey(0)})
+        l_pads = model.apply(params, jnp.zeros_like(text), img)
+        # full nulling == all-pad text
+        np.testing.assert_allclose(np.asarray(l_null), np.asarray(l_pads), atol=1e-5)
+        assert not np.allclose(np.asarray(l_null), np.asarray(l_cond), atol=1e-4)
+
+    def test_text_length_assert(self, dalle):
+        model, params = dalle
+        _, img = rand_inputs()
+        with pytest.raises(AssertionError, match="text must be"):
+            model.apply(params, jnp.zeros((2, 5), jnp.int32), img)
+
+
+class TestGeneration:
+    def test_greedy_generation_is_self_consistent(self, dalle):
+        """Tokens sampled greedily through the cached decode path must be the
+        argmax of the full teacher-forced forward at every position — ties the
+        generation path to the training path end-to-end."""
+        model, params = dalle
+        text, _ = rand_inputs(b=1)
+        key = jax.random.PRNGKey(3)
+        toks = model.apply(params, text, key, temperature=1e-12,
+                           filter_thres=0.999, method=DALLE.generate_images_tokens)
+        logits = model.apply(params, text, toks)
+        ntt = CFG.num_text_tokens + CFG.text_seq_len
+        # sequence = [bos, t_1..t_T, img_1..]: row T+k (0-based) predicts image
+        # token k, so image rows are logits[:, text_seq_len:]
+        img_rows = np.asarray(logits[:, CFG.text_seq_len:, ntt:])
+        expect = img_rows.argmax(-1)
+        np.testing.assert_array_equal(np.asarray(toks), expect)
+
+    def test_priming_keeps_prefix(self, dalle):
+        model, params = dalle
+        text, img = rand_inputs(b=1)
+        prime = img[:, :7]
+        toks = model.apply(params, text, jax.random.PRNGKey(1),
+                           image_prime=prime, method=DALLE.generate_images_tokens)
+        assert toks.shape == (1, CFG.image_seq_len)
+        np.testing.assert_array_equal(np.asarray(toks[:, :7]), np.asarray(prime))
+
+    def test_cfg_changes_samples(self, dalle):
+        model, params = dalle
+        text, _ = rand_inputs(b=1)
+        k = jax.random.PRNGKey(5)
+        t1 = model.apply(params, text, k, cond_scale=1.0,
+                         method=DALLE.generate_images_tokens)
+        t2 = model.apply(params, text, k, cond_scale=5.0,
+                         method=DALLE.generate_images_tokens)
+        assert not np.array_equal(np.asarray(t1), np.asarray(t2))
+
+    def test_generate_texts_tokens_in_text_band(self, dalle):
+        model, params = dalle
+        out = model.apply(params, jax.random.PRNGKey(2),
+                          jnp.asarray([[4, 9]], jnp.int32),
+                          method=DALLE.generate_texts_tokens)
+        assert out.shape == (1, CFG.text_seq_len)
+        assert (np.asarray(out) < CFG.num_text_tokens + CFG.text_seq_len).all()
+        np.testing.assert_array_equal(np.asarray(out[:, :2]), [[4, 9]])
+
+
+class TestCLIP:
+    CCFG = ClipConfig(dim_text=32, dim_image=32, dim_latent=32,
+                      num_text_tokens=100, text_enc_depth=1, text_seq_len=8,
+                      text_heads=2, visual_enc_depth=1, visual_heads=2,
+                      visual_image_size=32, visual_patch_size=8)
+
+    def test_loss_and_scores(self):
+        model, params = init_clip(self.CCFG, jax.random.PRNGKey(0), batch=2)
+        text = jnp.asarray(np.random.RandomState(0).randint(1, 100, (2, 8)), jnp.int32)
+        img = jnp.asarray(np.random.RandomState(1).rand(2, 32, 32, 3), jnp.float32)
+        loss = model.apply(params, text, img, return_loss=True)
+        assert loss.shape == () and jnp.isfinite(loss)
+        scores = model.apply(params, text, img)
+        assert scores.shape == (2,)
+
+    def test_latents_normalized(self):
+        model, params = init_clip(self.CCFG, jax.random.PRNGKey(0))
+        text = jnp.asarray([[1, 2, 3, 0, 0, 0, 0, 0]], jnp.int32)
+        lat = model.apply(params, text, method=CLIP.embed_text)
+        np.testing.assert_allclose(float(jnp.linalg.norm(lat)), 1.0, rtol=1e-5)
+
+    def test_text_padding_ignored(self):
+        """masked_mean: pad positions must not affect the text latent."""
+        model, params = init_clip(self.CCFG, jax.random.PRNGKey(0))
+        t1 = jnp.asarray([[1, 2, 3, 0, 0, 0, 0, 0]], jnp.int32)
+        lat1 = model.apply(params, t1, method=CLIP.embed_text)
+        # same tokens — mask hides everything after position 2
+        lat2 = model.apply(params, t1, method=CLIP.embed_text)
+        np.testing.assert_allclose(np.asarray(lat1), np.asarray(lat2), atol=1e-6)

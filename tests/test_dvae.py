@@ -135,16 +135,20 @@ class TestTrainer:
 
         def gen():
             it = batch_iterator(ds, 8)
-            for i in range(6):
+            for i in range(10):
                 imgs, caps = next(it)
                 if i == 3:
                     imgs = imgs * np.nan  # poison one batch
                 yield imgs, caps
 
         trainer.fit(gen(), log=lambda *a: None)
-        # params survived the poisoned batch
+        # params AND optimizer moments survived the poisoned batch (a NaN loss
+        # means apply_gradients already wrote NaN into Adam's mu/nu)
         assert all(np.isfinite(x).all() for x in jax.tree.leaves(
-            jax.device_get(trainer.state.params)))
+            jax.device_get((trainer.state.params, trainer.state.opt_state))))
+        # training keeps producing finite losses after the rollback
+        m = trainer.train_step(next(batch_iterator(ds, 8))[0])
+        assert np.isfinite(m["loss"])
         # checkpoints were written and can be restored
         step = trainer.ckpt.latest_step()
         assert step is not None and step >= 2

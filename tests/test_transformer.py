@@ -1,0 +1,115 @@
+"""Transformer stack tests: decode parity, token shift, layer sharing, variants."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_tpu.config import TransformerConfig
+from dalle_tpu.models.transformer import (Transformer, layerscale_init_eps,
+                                          shift_tokens_full)
+
+FMAP = 4
+TEXT = 8  # text_seq_len (excl bos)
+SEQ = TEXT + FMAP * FMAP
+
+
+def make(depth=2, **kw):
+    cfg = TransformerConfig(seq_len=SEQ, dim=32, depth=depth, heads=2,
+                            dim_head=16, image_fmap_size=FMAP, **kw)
+    model = Transformer(cfg)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, SEQ + 1, 32))
+    params = model.init(jax.random.PRNGKey(1), x)
+    return model, params, x
+
+
+def decode_all(model, params, x, prefill_len):
+    n = x.shape[1]
+    cache = model.apply(params, 2, n, method=Transformer.init_cache)
+    y0, cache = model.apply(params, x[:, :prefill_len], cache,
+                            method=Transformer.prefill)
+    outs = [y0]
+    for t in range(prefill_len, n):
+        y, cache = model.apply(params, x[:, t:t + 1], cache, jnp.int32(t),
+                               method=Transformer.decode_step)
+        outs.append(y)
+    return jnp.concatenate(outs, axis=1)
+
+
+@pytest.mark.parametrize("attn_types,shift", [
+    (("full",), False),
+    (("full", "axial_row", "axial_col", "conv_like"), False),
+    (("axial_row", "axial_col"), True),
+    (("conv_like",), True),
+])
+def test_decode_matches_full(attn_types, shift):
+    """Cache-vs-nocache equivalence — the reference's most delicate machinery
+    (SURVEY §4 item 4)."""
+    model, params, x = make(depth=len(attn_types), attn_types=attn_types,
+                            shift_tokens=shift)
+    full = model.apply(params, x)
+    inc = decode_all(model, params, x, prefill_len=TEXT + 1)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(inc), atol=2e-5)
+
+
+def test_decode_matches_full_with_image_prime():
+    """Prefill that already includes image tokens (priming path) must agree —
+    this is where the reference's shift-cache prefill is subtly wrong."""
+    model, params, x = make(depth=2, attn_types=("full", "axial_row"),
+                            shift_tokens=True)
+    full = model.apply(params, x)
+    inc = decode_all(model, params, x, prefill_len=TEXT + 1 + 7)  # 7 primed img tokens
+    np.testing.assert_allclose(np.asarray(full), np.asarray(inc), atol=2e-5)
+
+
+def test_shift_tokens_full_semantics():
+    b, d = 1, 8
+    text_len, fmap = 3, 2
+    n = text_len + fmap * fmap
+    x = jnp.arange(b * n * d, dtype=jnp.float32).reshape(b, n, d)
+    y = shift_tokens_full(x, text_len, fmap)
+    # text position 0: first half zeros (shifted from nothing)
+    np.testing.assert_array_equal(np.asarray(y[0, 0, :4]), 0.0)
+    # text position 2: first half from position 1
+    np.testing.assert_array_equal(np.asarray(y[0, 2, :4]), np.asarray(x[0, 1, :4]))
+    # image (0,0) (global pos 3): top quarter zero, left quarter zero
+    np.testing.assert_array_equal(np.asarray(y[0, 3, :4]), 0.0)
+    # image (1,1) (global pos 6): top quarter from (0,1)=pos 4, left from (1,0)=pos 5
+    np.testing.assert_array_equal(np.asarray(y[0, 6, :2]), np.asarray(x[0, 4, :2]))
+    np.testing.assert_array_equal(np.asarray(y[0, 6, 2:4]), np.asarray(x[0, 5, 2:4]))
+    # pass-through half untouched
+    np.testing.assert_array_equal(np.asarray(y[0, 6, 4:]), np.asarray(x[0, 6, 4:]))
+
+
+def test_layer_sharing_reduces_params():
+    _, p_shared, _ = make(depth=4, shared_attn_ids=(0, 0, 1, 1),
+                          shared_ff_ids=(0, 0, 0, 0))
+    _, p_full, _ = make(depth=4)
+    n_shared = sum(x.size for x in jax.tree.leaves(p_shared))
+    n_full = sum(x.size for x in jax.tree.leaves(p_full))
+    assert n_shared < n_full
+
+
+def test_layer_sharing_type_mismatch_raises():
+    with pytest.raises(ValueError, match="attn_types do not match"):
+        make(depth=2, attn_types=("full", "axial_row"), shared_attn_ids=(0, 0))
+
+
+def test_layerscale_init_thresholds():
+    assert layerscale_init_eps(1) == 0.1
+    assert layerscale_init_eps(18) == 0.1
+    assert layerscale_init_eps(19) == 1e-5
+    assert layerscale_init_eps(24) == 1e-5
+    assert layerscale_init_eps(25) == 1e-6
+
+
+def test_stable_and_sandwich_paths_run():
+    model, params, x = make(depth=2, stable=True, sandwich_norm=True)
+    out = model.apply(params, x)
+    assert jnp.isfinite(out).all()
+
+
+def test_sparse_variant_runs():
+    model, params, x = make(depth=1, attn_types=("sparse",))
+    out = model.apply(params, x)
+    assert out.shape == x.shape
