@@ -1,0 +1,70 @@
+"""Benchmark: DALL·E-small training throughput on the attached chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no formal numbers (BASELINE.md): its only hooks are a
+samples/sec meter and a flops profile. The driver-set target is ≥45% MFU
+(BASELINE.json north_star), so ``vs_baseline`` reports measured MFU / 0.45 —
+>1.0 beats the target.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    from dalle_tpu.config import DalleConfig, MeshConfig, OptimConfig, TrainConfig
+    from dalle_tpu.parallel.mesh import build_mesh
+    from dalle_tpu.train.metrics import device_peak_tflops
+    from dalle_tpu.train.trainer_dalle import DalleTrainer
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    # DALL·E-small (BASELINE.md config 2): 12L/8H/512d, full causal attention,
+    # 256 text + 256 image tokens
+    cfg = DalleConfig(
+        num_text_tokens=10000, text_seq_len=256, dim=512, depth=12, heads=8,
+        dim_head=64, image_size=128, image_vocab_size=8192, image_fmap_size=16)
+    batch = 32 if on_accel else 4
+    steps = 20 if on_accel else 3
+
+    n_dev = jax.device_count()
+    mesh_cfg = MeshConfig(dp=n_dev)
+    mesh = build_mesh(mesh_cfg)
+    train_cfg = TrainConfig(batch_size=batch, checkpoint_dir="/tmp/bench_ckpt",
+                            preflight_checkpoint=False, mesh=mesh_cfg,
+                            optim=OptimConfig(grad_clip_norm=0.5))
+    trainer = DalleTrainer(cfg, train_cfg, mesh=mesh)
+
+    rng = np.random.RandomState(0)
+    text = rng.randint(1, cfg.num_text_tokens, (batch, cfg.text_seq_len))
+    image_ids = rng.randint(0, cfg.image_vocab_size, (batch, cfg.image_seq_len))
+
+    trainer.train_step(text, image_ids)   # compile
+    jax.block_until_ready(trainer.state.params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        trainer.train_step(text, image_ids)
+    jax.block_until_ready(trainer.state.params)
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * cfg.total_seq_len
+    tokens_per_sec_per_chip = tokens_per_step * steps / dt / n_dev
+    flops_per_step = 6.0 * trainer.num_params * tokens_per_step
+    mfu = (flops_per_step * steps / dt) / (device_peak_tflops() * 1e12 * n_dev)
+
+    print(json.dumps({
+        "metric": "dalle_small_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec_per_chip, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.45, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

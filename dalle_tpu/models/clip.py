@@ -17,6 +17,7 @@ from typing import Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import optax
 
 from ..config import ClipConfig, TransformerConfig
 from ..ops.sampling import masked_mean
@@ -88,14 +89,9 @@ class CLIP(nn.Module):
             return jnp.einsum("bd,bd->b", t, v) * temp
         sim = jnp.einsum("id,jd->ij", t, v) * temp
         labels = jnp.arange(sim.shape[0])
-        loss_t = _ce(sim, labels)
-        loss_v = _ce(sim.T, labels)
+        loss_t = optax.softmax_cross_entropy_with_integer_labels(sim, labels).mean()
+        loss_v = optax.softmax_cross_entropy_with_integer_labels(sim.T, labels).mean()
         return (loss_t + loss_v) / 2
-
-
-def _ce(logits, labels):
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    return -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
 
 
 def init_clip(cfg: ClipConfig, key: jax.Array, batch: int = 1):

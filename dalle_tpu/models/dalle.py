@@ -30,6 +30,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
+import optax
 
 from ..config import DalleConfig
 from ..ops.sampling import gumbel_sample, prob_mask_like, top_k_filter
@@ -191,7 +192,7 @@ class DALLE(nn.Module):
         labels = jnp.concatenate(
             [text_b[:, 1:], image_ids + self.num_text_tokens], axis=1)
         logits32 = logits.astype(jnp.float32)
-        ce = _cross_entropy(logits32, labels)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits32, labels)
         loss_text = ce[:, :c.text_seq_len].mean()
         loss_img = ce[:, c.text_seq_len:].mean()
         loss = (loss_text + c.loss_img_weight * loss_img) / (c.loss_img_weight + 1)
@@ -283,6 +284,9 @@ class DALLE(nn.Module):
         if text is None:
             text = jnp.zeros((batch, 0), jnp.int32)
         b, start = text.shape
+        assert start < c.text_seq_len, (
+            f"text prefix must be shorter than text_seq_len={c.text_seq_len}, "
+            f"got {start}")
         cache = self.transformer.init_cache(b, c.total_seq_len)
         # prefix: bos + given tokens (no pad remap — these are real tokens)
         ids = jnp.pad(text, ((0, 0), (1, 0)))
@@ -316,11 +320,6 @@ class DALLE(nn.Module):
         final = sample_text(last_logits, jax.random.fold_in(key, n_new))
         toks = jnp.moveaxis(toks, 0, 1)
         return jnp.concatenate([text, toks, final[:, None]], axis=1)
-
-
-def _cross_entropy(logits, labels):
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    return -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
 
 
 def init_dalle(cfg: DalleConfig, key: jax.Array, batch: int = 1):

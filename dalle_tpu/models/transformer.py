@@ -140,14 +140,16 @@ class Attention(nn.Module):
 class ShiftState(NamedTuple):
     """Ring buffers for cached token-shift decode: the (top, left) quarter-chunks
     of the last ``image_size`` *pre-shift* inputs (reference deque,
-    transformer.py:138-153)."""
+    transformer.py:138-153), plus the previous token's first-half channels for
+    text-position decode (text shift = ½ channels from position t−1)."""
     top: jnp.ndarray    # (b, image_size, d4)
     left: jnp.ndarray   # (b, image_size, d4)
+    prev: jnp.ndarray   # (b, d2) pre-shift first half of the latest token
 
     @classmethod
     def init(cls, batch: int, image_size: int, d4: int, dtype=jnp.float32):
         z = jnp.zeros((batch, image_size, d4), dtype)
-        return cls(z, z)
+        return cls(z, z, jnp.zeros((batch, 2 * d4), dtype))
 
 
 def shift_tokens_full(x, text_len: int, image_size: int):
@@ -187,9 +189,10 @@ def shift_prefill_state(x, text_len: int, image_size: int,
     docstring)."""
     b, n, d = x.shape
     d4 = d // 4
+    prev = x[:, -1, :2 * d4]
     img_len = max(n - text_len, 0)
     if img_len == 0:
-        return state
+        return ShiftState(state.top, state.left, prev)
     take = min(img_len, image_size)
     chunk = x[:, n - take:n]
     # positions n-take..n-1 → ring slots (pos - text_len) % image_size
@@ -197,19 +200,23 @@ def shift_prefill_state(x, text_len: int, image_size: int,
     slots = pos % image_size
     top = state.top.at[:, slots].set(chunk[..., :d4])
     left = state.left.at[:, slots].set(chunk[..., d4:2 * d4])
-    return ShiftState(top, left)
+    return ShiftState(top, left, prev)
 
 
 def shift_decode_step(x_t, state: ShiftState, offset, text_len: int,
                       image_size: int):
-    """Cached one-token shift (reference :138-153). ``offset`` ≥ text_len.
-    Returns (shifted x_t, new state)."""
+    """Cached one-token shift (reference :138-153) at traced position
+    ``offset``. Text positions (offset < text_len) take the previous token's
+    first-half channels; image positions take the (top, left) grid-neighbor
+    quarter-chunks from the ring buffers. Returns (shifted x_t, new state)."""
     b, _, d = x_t.shape
     d4 = d // 4
+    d2 = 2 * d4
     cur = x_t[:, 0]
-    cur_top, cur_left = cur[..., :d4], cur[..., d4:2 * d4]
+    cur_top, cur_left = cur[..., :d4], cur[..., d4:d2]
     img_pos = offset - text_len
-    ptr = img_pos % image_size
+    is_text = offset < text_len
+    ptr = img_pos % image_size  # nonneg also while img_pos < 0 (text phase)
     # top neighbor = value written image_size steps ago = current ring slot
     top_n = jax.lax.dynamic_index_in_dim(state.top, ptr, axis=1, keepdims=False)
     prev_ptr = (ptr - 1) % image_size
@@ -218,10 +225,17 @@ def shift_decode_step(x_t, state: ShiftState, offset, text_len: int,
     # the full path's zero padding)
     top_n = jnp.where(img_pos < image_size, 0.0, top_n)
     left_n = jnp.where(img_pos % image_size == 0, 0.0, left_n)
-    shifted = jnp.concatenate((top_n, left_n, cur[..., 2 * d4:]), axis=-1)[:, None]
-    state = ShiftState(
-        jax.lax.dynamic_update_slice_in_dim(state.top, cur_top[:, None], ptr, axis=1),
-        jax.lax.dynamic_update_slice_in_dim(state.left, cur_left[:, None], ptr, axis=1))
+    img_shift = jnp.concatenate((top_n, left_n, cur[..., d2:]), axis=-1)
+    txt_shift = jnp.concatenate((state.prev, cur[..., d2:]), axis=-1)
+    shifted = jnp.where(is_text, txt_shift, img_shift)[:, None]
+    new_top = jax.lax.dynamic_update_slice_in_dim(
+        state.top, cur_top[:, None], ptr, axis=1)
+    new_left = jax.lax.dynamic_update_slice_in_dim(
+        state.left, cur_left[:, None], ptr, axis=1)
+    # text-phase steps must not write into the image ring buffers
+    state = ShiftState(jnp.where(is_text, state.top, new_top),
+                       jnp.where(is_text, state.left, new_left),
+                       cur[..., :d2])
     return shifted, state
 
 

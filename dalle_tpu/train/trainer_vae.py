@@ -20,6 +20,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+import optax
 
 from ..config import AnnealConfig, DVAEConfig, TrainConfig
 from ..models.dvae import DiscreteVAE, init_dvae
@@ -48,7 +49,6 @@ def make_vae_train_step(model: DiscreteVAE):
         (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, images, key, temp)
         state = state.apply_gradients(grads)
-        import optax
         return state, {"loss": loss, "grad_norm": optax.global_norm(grads)}
 
     return step

@@ -50,17 +50,25 @@ class TestForward:
         assert (logits[:, CFG.text_seq_len:, ntt:] > -1e8).any()
 
     def test_unique_pad_remap_changes_output(self, dalle):
-        """Two different texts that share the same pad positions must embed pads
-        identically per position, but pads at different positions differently."""
+        """0-pads remap to a unique id per position regardless of surrounding
+        text (reference :370,578-579), and moving a pad changes the output."""
         model, params = dalle
         _, img = rand_inputs()
         t1 = jnp.asarray([[5, 0, 7, 0, 9, 11, 13, 15]], jnp.int32)
-        t2 = jnp.asarray([[5, 0, 7, 0, 9, 11, 13, 15]], jnp.int32)
-        l1 = model.apply(params, t1, img[:1])
-        l2 = model.apply(params, t2, img[:1])
-        np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=1e-6)
+        t2 = jnp.asarray([[21, 0, 33, 0, 45, 47, 49, 51]], jnp.int32)
+        r1 = np.asarray(model.apply(params, t1, method=DALLE.remap_and_bos))
+        r2 = np.asarray(model.apply(params, t2, method=DALLE.remap_and_bos))
+        # bos prepended, real tokens preserved
+        assert r1[0, 0] == 0 and r1[0, 1] == 5 and r1[0, 3] == 7
+        # pads (input cols 1 and 3 → remapped cols 2 and 4) get per-position
+        # unique ids, identical across different texts
+        assert r1[0, 2] == CFG.num_text_tokens + 1
+        assert r1[0, 4] == CFG.num_text_tokens + 3
+        assert r1[0, 2] == r2[0, 2] and r1[0, 4] == r2[0, 4]
+        assert r1[0, 2] != r1[0, 4]
         # pad moved to a different position → different representation
         t3 = jnp.asarray([[5, 7, 0, 0, 9, 11, 13, 15]], jnp.int32)
+        l1 = model.apply(params, t1, img[:1])
         l3 = model.apply(params, t3, img[:1])
         assert not np.allclose(np.asarray(l1), np.asarray(l3), atol=1e-4)
 
@@ -159,10 +167,19 @@ class TestCLIP:
         np.testing.assert_allclose(float(jnp.linalg.norm(lat)), 1.0, rtol=1e-5)
 
     def test_text_padding_ignored(self):
-        """masked_mean: pad positions must not affect the text latent."""
+        """Pad positions must not affect the text latent: perturbing the pad
+        token's embedding row must leave the latent unchanged (key_mask blocks
+        pad keys; masked_mean drops pad outputs)."""
+        import copy
         model, params = init_clip(self.CCFG, jax.random.PRNGKey(0))
         t1 = jnp.asarray([[1, 2, 3, 0, 0, 0, 0, 0]], jnp.int32)
         lat1 = model.apply(params, t1, method=CLIP.embed_text)
-        # same tokens — mask hides everything after position 2
-        lat2 = model.apply(params, t1, method=CLIP.embed_text)
-        np.testing.assert_allclose(np.asarray(lat1), np.asarray(lat2), atol=1e-6)
+        mutated = copy.deepcopy(jax.device_get(params))
+        emb = jnp.asarray(mutated["params"]["text_emb"]["embedding"])
+        mutated["params"]["text_emb"]["embedding"] = emb.at[0].add(100.0)
+        lat2 = model.apply(mutated, t1, method=CLIP.embed_text)
+        np.testing.assert_allclose(np.asarray(lat1), np.asarray(lat2), atol=1e-5)
+        # a real token's row, by contrast, must matter
+        mutated["params"]["text_emb"]["embedding"] = emb.at[2].add(100.0)
+        lat3 = model.apply(mutated, t1, method=CLIP.embed_text)
+        assert not np.allclose(np.asarray(lat1), np.asarray(lat3), atol=1e-3)

@@ -1,0 +1,84 @@
+"""DalleTrainer + driver entry points on the 8-device CPU mesh."""
+
+import math
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from dalle_tpu.config import DalleConfig, MeshConfig, OptimConfig, TrainConfig
+from dalle_tpu.parallel.mesh import build_mesh
+from dalle_tpu.train.trainer_dalle import DalleTrainer
+
+TINY = DalleConfig(num_text_tokens=32, text_seq_len=8, dim=32, depth=2, heads=2,
+                   dim_head=16, image_size=16, image_vocab_size=32,
+                   image_fmap_size=4)
+
+
+def _batch(rng, cfg, n):
+    text = rng.randint(1, cfg.num_text_tokens, (n, cfg.text_seq_len))
+    ids = rng.randint(0, cfg.image_vocab_size, (n, cfg.image_seq_len))
+    return text, ids
+
+
+def test_train_step_decreases_loss(tmp_path, rng):
+    mesh_cfg = MeshConfig(dp=4, fsdp=2)
+    tc = TrainConfig(batch_size=8, checkpoint_dir=str(tmp_path),
+                     preflight_checkpoint=False, mesh=mesh_cfg,
+                     optim=OptimConfig(learning_rate=1e-2))
+    tr = DalleTrainer(TINY, tc, mesh=build_mesh(mesh_cfg))
+    text, ids = _batch(rng, TINY, 8)
+    losses = [tr.train_step(text, ids)["loss"] for _ in range(12)]
+    assert all(math.isfinite(x) for x in losses)
+    assert losses[-1] < losses[0]  # memorizes a fixed batch
+
+
+def test_sharded_step_matches_single_device(tmp_path, rng):
+    """DP+TP sharding must not change the math (same seed → same loss)."""
+    text, ids = _batch(rng, TINY, 8)
+    results = {}
+    for name, mesh_cfg in [("multi", MeshConfig(dp=2, fsdp=2, tp=2)),
+                           ("single", MeshConfig())]:
+        mesh = (build_mesh(mesh_cfg) if name == "multi"
+                else build_mesh(mesh_cfg, devices=jax.devices()[:1]))
+        tc = TrainConfig(batch_size=8, checkpoint_dir=str(tmp_path / name),
+                         preflight_checkpoint=False, mesh=mesh_cfg)
+        tr = DalleTrainer(TINY, tc, mesh=mesh)
+        results[name] = [tr.train_step(text, ids)["loss"] for _ in range(3)]
+    np.testing.assert_allclose(results["multi"], results["single"],
+                               rtol=2e-4)
+
+
+def test_fit_checkpoint_resume(tmp_path, rng):
+    mesh_cfg = MeshConfig(dp=2)
+    mesh = build_mesh(mesh_cfg, devices=jax.devices()[:2])
+    tc = TrainConfig(batch_size=4, checkpoint_dir=str(tmp_path),
+                     save_every_steps=5, mesh=mesh_cfg)
+    tr = DalleTrainer(TINY, tc, mesh=mesh)
+    text, ids = _batch(rng, TINY, 4)
+    tr.fit(iter([(text, ids)] * 6), steps=5, log=lambda *a: None)
+    assert tr.ckpt.latest_step() == 5
+
+    tr2 = DalleTrainer(TINY, tc, mesh=mesh)
+    meta = tr2.restore()
+    assert meta["model_class"] == "DALLE"
+    assert int(tr2.state.step) == 5
+    p1 = jax.tree.leaves(tr.state.params)[0]
+    p2 = jax.tree.leaves(tr2.state.params)[0]
+    np.testing.assert_allclose(np.asarray(p1), np.asarray(p2))
+
+
+def test_graft_entry_compiles():
+    sys.path.insert(0, "/root/repo")
+    import __graft_entry__ as ge
+    fn, args = ge.entry()
+    # compile-check only (driver does the same); tiny eval via eval_shape
+    out = jax.eval_shape(fn, *args)
+    assert out.shape == ()
+
+
+def test_graft_dryrun_multichip():
+    sys.path.insert(0, "/root/repo")
+    import __graft_entry__ as ge
+    ge.dryrun_multichip(8)

@@ -62,6 +62,16 @@ def test_decode_matches_full_with_image_prime():
     np.testing.assert_allclose(np.asarray(full), np.asarray(inc), atol=2e-5)
 
 
+def test_decode_matches_full_through_text_positions():
+    """Cached decode that starts INSIDE the text span (the generate_texts path)
+    must apply the text shift (½ channels from t−1), not the image-grid shift."""
+    model, params, x = make(depth=2, attn_types=("full", "axial_row"),
+                            shift_tokens=True)
+    full = model.apply(params, x)
+    inc = decode_all(model, params, x, prefill_len=3)  # bos + 2 text tokens
+    np.testing.assert_allclose(np.asarray(full), np.asarray(inc), atol=2e-5)
+
+
 def test_shift_tokens_full_semantics():
     b, d = 1, 8
     text_len, fmap = 3, 2
