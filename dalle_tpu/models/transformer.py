@@ -79,13 +79,21 @@ class GEGLUFeedForward(nn.Module):
 
 class Attention(nn.Module):
     """Multi-head attention over the shared dense core (reference attention.py:39-99).
-    Rotary is applied to q, k AND v — preserved reference behavior (:66-67)."""
+    Rotary is applied to q, k AND v — preserved reference behavior (:66-67).
+
+    With ``use_pallas`` the full-sequence forward runs the Pallas flash kernel
+    (ops/flash_attention.py), which also block-skips any static sparse mask —
+    the TPU-native successor of the DeepSpeed SparseSelfAttention path
+    (attention.py:339-398). Flash is inherently max-subtracting, so the
+    ``stable`` softmax variant is subsumed. Decode keeps the dense cached core
+    (single-token steps are bandwidth-, not matmul-bound)."""
     dim: int
     heads: int
     dim_head: int
     dropout: float = 0.0
     causal: bool = True
     stable: bool = False
+    use_pallas: bool = False
 
     def setup(self):
         inner = self.heads * self.dim_head
@@ -99,14 +107,18 @@ class Attention(nn.Module):
         return [t.reshape(shape).transpose(0, 2, 1, 3) for t in (q, k, v)]
 
     def __call__(self, x, *, key_mask=None, rotary=None, static_mask=None,
-                 deterministic: bool = True):
+                 np_mask=None, deterministic: bool = True):
         b, n, _ = x.shape
         q, k, v = self._split(self.to_qkv(x), n)
         if rotary is not None:
             rot = rotary[:n][None, None]
             q, k, v = (apply_rotary(rot, t) for t in (q, k, v))
-        out = attend(q, k, v, causal=self.causal, key_mask=key_mask,
-                     static_mask=static_mask, stable=self.stable)
+        if self.use_pallas and key_mask is None:
+            from ..ops.flash_attention import flash_attention
+            out = flash_attention(q, k, v, mask=np_mask, causal=self.causal)
+        else:
+            out = attend(q, k, v, causal=self.causal, key_mask=key_mask,
+                         static_mask=static_mask, stable=self.stable)
         out = out.transpose(0, 2, 1, 3).reshape(b, n, -1)
         return self.drop(self.to_out(out), deterministic=deterministic)
 
@@ -313,17 +325,18 @@ class Transformer(nn.Module):
         ff_ids = list(islice(cycle(c.shared_ff_ids or range(c.depth)), c.depth))
 
         # static masks (None for 'full' — plain causal handled in attend);
-        # numpy constants folded by XLA. Built locally: flax freezes dict attrs.
-        masks: Dict[str, Optional[jnp.ndarray]] = {}
+        # kept as NUMPY (the pallas path needs host-side masks for block-list
+        # construction; the dense path converts per-trace, folded by XLA)
+        masks: Dict[str, Optional[np.ndarray]] = {}
         for t in set(type_per_layer):
             if t == "full" or not c.causal:
                 masks[t] = None
             else:
-                masks[t] = jnp.asarray(build_mask(
+                masks[t] = build_mask(
                     t, self.text_len, fmap, kernel_size=c.sparse_attn_kernel,
                     block=c.sparse_block_size,
-                    num_random_blocks=c.sparse_num_random_blocks))
-        self.masks = masks
+                    num_random_blocks=c.sparse_num_random_blocks)
+        self.np_masks = masks
 
         shared_attn: Dict[Any, Tuple[Attention, str]] = {}
         shared_ff: Dict[Any, GEGLUFeedForward] = {}
@@ -341,6 +354,7 @@ class Transformer(nn.Module):
             else:
                 attn = Attention(c.dim, c.heads, c.dim_head, c.attn_dropout,
                                  causal=c.causal, stable=c.stable,
+                                 use_pallas=c.use_pallas,
                                  name=f"attn_{aid}")
                 shared_attn[aid] = (attn, t)
             if fid in shared_ff:
@@ -367,6 +381,10 @@ class Transformer(nn.Module):
             self.rotary = jnp.asarray(
                 dalle_pos_emb(self.text_len, fmap, c.dim_head))
 
+    def _dense_mask(self, t):
+        m = self.np_masks[t]
+        return None if m is None else jnp.asarray(m)
+
     # -- training / full forward ------------------------------------------
     def __call__(self, x, key_mask=None, deterministic: bool = True):
         """Sequential execution. Memory scaling for deep stacks comes from
@@ -377,7 +395,9 @@ class Transformer(nn.Module):
         for ind in range(c.depth):
             attn_l, ff_l, t = self.attn_layers[ind], self.ff_layers[ind], self.layer_types[ind]
             x = x + attn_l(x, key_mask=key_mask, rotary=self.rotary,
-                           static_mask=self.masks[t], deterministic=deterministic)
+                           static_mask=self._dense_mask(t),
+                           np_mask=self.np_masks[t],
+                           deterministic=deterministic)
             x = x + ff_l(x, deterministic=deterministic)
         return x
 
@@ -407,7 +427,7 @@ class Transformer(nn.Module):
             y, kv, ss = attn_l.prefill(x, cache[f"kv_{ind}"],
                                        cache.get(f"shift_attn_{ind}"),
                                        rotary=self.rotary,
-                                       static_mask=self.masks[t])
+                                       static_mask=self._dense_mask(t))
             cache[f"kv_{ind}"] = kv
             if ss is not None:
                 cache[f"shift_attn_{ind}"] = ss
@@ -429,7 +449,7 @@ class Transformer(nn.Module):
             y, kv, ss = attn_l.decode(x_t, cache[f"kv_{ind}"],
                                       cache.get(f"shift_attn_{ind}"), offset,
                                       rotary=self.rotary,
-                                      static_mask=self.masks[t])
+                                      static_mask=self._dense_mask(t))
             cache[f"kv_{ind}"] = kv
             if ss is not None:
                 cache[f"shift_attn_{ind}"] = ss

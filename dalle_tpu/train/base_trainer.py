@@ -1,0 +1,109 @@
+"""Shared trainer shell: mesh resolution, host step/rng bookkeeping, the fit
+loop with NaN rollback (reference fork vae.py:100-110 / dalle.py:148-151),
+preflight + periodic checkpointing with rotation (legacy/train_dalle.py:547-594),
+and throughput metering — one implementation for every model family."""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+from ..config import TrainConfig
+from .checkpoints import CheckpointManager
+
+
+class BaseTrainer:
+    """Owns (mesh, state, step fn, checkpoints, meter). Subclasses set
+    ``self.state``, ``self.step_fn``-driven ``train_step``, and
+    ``model_class`` for checkpoint metadata."""
+
+    model_class = "Model"
+
+    def __init__(self, train_cfg: TrainConfig, mesh=None, backend=None):
+        self.train_cfg = train_cfg
+        if mesh is None and backend is not None:
+            mesh = backend.mesh
+        if mesh is None:
+            from ..parallel import build_mesh
+            mesh = build_mesh(train_cfg.mesh)
+        self.mesh = mesh
+        self.backend = backend
+        self.base_key = jax.random.PRNGKey(train_cfg.seed)
+        self.ckpt = CheckpointManager(train_cfg.checkpoint_dir,
+                                      keep_n=train_cfg.keep_n_checkpoints)
+        self._last_good = None   # host copy of (params, opt_state) for rollback
+        self._host_step = 0      # host mirror of state.step: no device sync
+
+    # subclasses implement train_step(*batch) -> metrics dict ---------------
+
+    def _meta(self) -> dict:
+        return {"hparams": self.model_cfg.to_dict(),
+                "train": self.train_cfg.to_dict(),
+                "model_class": self.model_class}
+
+    def restore(self, step: Optional[int] = None):
+        """Resume model/opt/step from the checkpoint dir (reference
+        legacy/train_dalle.py:249-272,531-532)."""
+        self.state, meta = self.ckpt.restore(self.state, step)
+        self._host_step = int(self.state.step)
+        return meta
+
+    def fit(self, batches, *, steps: Optional[int] = None, log=print,
+            sample_fn: Optional[Callable[[int], None]] = None):
+        """Epoch-agnostic loop over ``batches`` (iterable of tuples fed to
+        ``train_step``) with the reference's parity behaviors."""
+        tc = self.train_cfg
+        meta = self._meta()
+        if tc.preflight_checkpoint:
+            self.ckpt.preflight(self.state, meta)
+        self._snapshot_good()
+        for batch in batches:
+            m = self.train_step(*batch)
+            step_num = self._host_step
+            nan = tc.nan_rollback and not math.isfinite(m["loss"])
+            if nan:
+                log(f"[step {step_num}] NaN loss — rolling back to last good state")
+                self._rollback()
+            else:
+                if step_num % tc.log_every == 0:
+                    log(f"[step {step_num}] " +
+                        " ".join(f"{k}={v:.5g}" for k, v in m.items()))
+                if step_num % tc.save_every_steps == 0:
+                    self.ckpt.save(step_num, self.state, meta)
+                    self._snapshot_good()
+                if getattr(tc, "sample_every_steps", 0) and sample_fn and \
+                        step_num % tc.sample_every_steps == 0:
+                    sample_fn(step_num)
+            # the steps budget must bound the loop even when steps go NaN
+            if steps is not None and step_num >= steps:
+                break
+        return self.state
+
+    def _snapshot_good(self):
+        # NaN loss is observed AFTER apply_gradients has run, so the optimizer
+        # moments are poisoned too — snapshot and restore both (the reference
+        # fork reloads the whole checkpoint, vae.py:100-110)
+        live = (self.state.params, self.state.opt_state)
+        self._last_good = jax.device_get(live)
+        self._last_good_shardings = jax.tree.map(lambda x: x.sharding, live)
+
+    def _rollback(self):
+        if self._last_good is not None:
+            restored = jax.tree.map(jax.device_put, self._last_good,
+                                    self._last_good_shardings)
+            params, opt_state = restored
+            self.state = self.state.replace(params=params, opt_state=opt_state)
+
+    def _finish_step(self, metrics) -> dict:
+        """Post-step bookkeeping: advance the host step, pull metrics, attach
+        the throughput report keyed on the POST-increment step so it lands in
+        the same metrics dict fit() logs at ``log_every`` boundaries."""
+        self._host_step += 1
+        metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
+        rep = self.meter.step(self._host_step)
+        if rep:
+            metrics.update(rep)
+        return metrics

@@ -16,26 +16,25 @@ MultiSteps transform inside the same program rather than an engine feature.
 
 from __future__ import annotations
 
-import math
-from typing import Callable, Optional
+from functools import partial
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import optax
 
 from ..config import DalleConfig, TrainConfig
 from ..models.dalle import DALLE, init_dalle
 from ..parallel import shard_batch, shard_params
-from .checkpoints import CheckpointManager
+from .base_trainer import BaseTrainer
 from .metrics import ThroughputMeter, count_params, transformer_train_flops
 from .train_state import TrainState, make_optimizer
 
 
 def make_dalle_train_step(model: DALLE, *, null_cond_prob: float = 0.0,
                           use_dropout: bool = False):
-    """Returns step(state, text, image_ids, key) -> (state, metrics). jit-once;
-    ``null_cond_prob``/``use_dropout`` are compile-time (they select rng wiring)."""
+    """Returns step(state, text, image_ids, key) -> (state, metrics). jit-once
+    with the state donated; ``null_cond_prob``/``use_dropout`` are compile-time
+    (they select rng wiring)."""
 
     def loss_fn(params, text, image_ids, key):
         rngs = {}
@@ -49,7 +48,7 @@ def make_dalle_train_step(model: DALLE, *, null_cond_prob: float = 0.0,
                                 rngs=rngs or None)
         return loss, aux
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=(0,))
     def step(state: TrainState, text, image_ids, key):
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, text, image_ids, key)
@@ -60,39 +59,27 @@ def make_dalle_train_step(model: DALLE, *, null_cond_prob: float = 0.0,
     return step
 
 
-class DalleTrainer:
-    """Owns (model, sharded state, step fn, checkpoints, meter). Consumes
-    batches of (text ids, image codebook ids); raw pixels are tokenized by the
-    caller through a VAEAdapter (the reference tokenizes inside DALLE.forward,
-    :590-597 — here the vae is upstream of the hot loop so the train step stays
-    a pure text+ids program)."""
+class DalleTrainer(BaseTrainer):
+    """Consumes batches of (text ids, image codebook ids); raw pixels are
+    tokenized by the caller through a VAEAdapter (the reference tokenizes
+    inside DALLE.forward, :590-597 — here the vae is upstream of the hot loop
+    so the train step stays a pure text+ids program)."""
+
+    model_class = "DALLE"
 
     def __init__(self, model_cfg: DalleConfig, train_cfg: TrainConfig,
                  mesh=None, backend=None, null_cond_prob: float = 0.0):
+        super().__init__(train_cfg, mesh=mesh, backend=backend)
         self.model_cfg = model_cfg
-        self.train_cfg = train_cfg
-        if mesh is None and backend is not None:
-            mesh = backend.mesh
-        if mesh is None:
-            from ..parallel import build_mesh
-            mesh = build_mesh(train_cfg.mesh)
-        self.mesh = mesh
-        self.backend = backend
 
-        key = jax.random.PRNGKey(train_cfg.seed)
-        self.model, params = init_dalle(model_cfg, key)
-        params = shard_params(mesh, params)
+        self.model, params = init_dalle(model_cfg, self.base_key)
+        params = shard_params(self.mesh, params)
         tx = make_optimizer(train_cfg.optim)
         self.state = TrainState.create(apply_fn=self.model.apply, params=params,
                                        tx=tx)
         use_dropout = (model_cfg.attn_dropout > 0 or model_cfg.ff_dropout > 0)
         self.step_fn = make_dalle_train_step(
             self.model, null_cond_prob=null_cond_prob, use_dropout=use_dropout)
-        self.base_key = key
-        self.ckpt = CheckpointManager(train_cfg.checkpoint_dir,
-                                      keep_n=train_cfg.keep_n_checkpoints)
-        self._last_good = None
-        self._host_step = 0
 
         n = count_params(self.state.params)
         self.num_params = n
@@ -102,66 +89,12 @@ class DalleTrainer:
             tokens_per_sample=tokens_per_sample,
             flops_per_step=transformer_train_flops(
                 n, train_cfg.batch_size * tokens_per_sample),
-            num_chips=mesh.size)
-
-    def restore(self, step: Optional[int] = None):
-        """Resume model/opt/step from the checkpoint dir (reference
-        legacy/train_dalle.py:249-272,531-532)."""
-        self.state, meta = self.ckpt.restore(self.state, step)
-        self._host_step = int(self.state.step)
-        return meta
+            num_chips=self.mesh.size)
 
     # -- single step ---------------------------------------------------------
     def train_step(self, text: np.ndarray, image_ids: np.ndarray):
-        step_num = self._host_step
-        key = jax.random.fold_in(self.base_key, step_num)
+        key = jax.random.fold_in(self.base_key, self._host_step)
         text = shard_batch(self.mesh, np.asarray(text, np.int32))
         image_ids = shard_batch(self.mesh, np.asarray(image_ids, np.int32))
         self.state, metrics = self.step_fn(self.state, text, image_ids, key)
-        self._host_step += 1
-        metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
-        rep = self.meter.step(step_num)
-        if rep:
-            metrics.update(rep)
-        return metrics
-
-    # -- full loop with parity behaviors --------------------------------------
-    def fit(self, batches, *, steps: Optional[int] = None, log=print,
-            sample_fn: Optional[Callable[[int], None]] = None):
-        tc = self.train_cfg
-        meta = {"hparams": self.model_cfg.to_dict(), "train": tc.to_dict(),
-                "model_class": "DALLE"}
-        if tc.preflight_checkpoint:
-            self.ckpt.preflight(self.state, meta)
-        self._snapshot_good()
-        for text, image_ids in batches:
-            m = self.train_step(text, image_ids)
-            step_num = self._host_step
-            if tc.nan_rollback and not math.isfinite(m["loss"]):
-                log(f"[step {step_num}] NaN loss — rolling back to last good state")
-                self._rollback()
-                continue
-            if step_num % tc.log_every == 0:
-                log(f"[step {step_num}] " +
-                    " ".join(f"{k}={v:.5g}" for k, v in m.items()))
-            if step_num % tc.save_every_steps == 0:
-                self.ckpt.save(step_num, self.state, meta)
-                self._snapshot_good()
-            if tc.sample_every_steps and sample_fn and \
-                    step_num % tc.sample_every_steps == 0:
-                sample_fn(step_num)
-            if steps is not None and step_num >= steps:
-                break
-        return self.state
-
-    def _snapshot_good(self):
-        live = (self.state.params, self.state.opt_state)
-        self._last_good = jax.device_get(live)
-        self._last_good_shardings = jax.tree.map(lambda x: x.sharding, live)
-
-    def _rollback(self):
-        if self._last_good is not None:
-            restored = jax.tree.map(jax.device_put, self._last_good,
-                                    self._last_good_shardings)
-            params, opt_state = restored
-            self.state = self.state.replace(params=params, opt_state=opt_state)
+        return self._finish_step(metrics)

@@ -7,14 +7,16 @@ averaging over workers, checkpoint {hparams, weights}. The fork adds NaN
 rollback (vae.py:100-110).
 
 TPU design: the entire step (loss, grads, psum over dp via shardings, optimizer)
-is ONE jitted function; temperature enters as a traced scalar so annealing
-doesn't retrigger compilation; the gumbel rng is folded from the step counter
-for cross-host determinism.
+is ONE jitted function with the state donated (params update in place in HBM);
+temperature enters as a traced scalar so annealing doesn't retrigger
+compilation; the gumbel rng is folded from the step counter for cross-host
+determinism.
 """
 
 from __future__ import annotations
 
 import math
+from functools import partial
 from typing import Optional
 
 import jax
@@ -25,7 +27,7 @@ import optax
 from ..config import AnnealConfig, DVAEConfig, TrainConfig
 from ..models.dvae import DiscreteVAE, init_dvae
 from ..parallel import shard_batch, shard_params
-from .checkpoints import CheckpointManager
+from .base_trainer import BaseTrainer
 from .metrics import ThroughputMeter, count_params
 from .train_state import TrainState, make_optimizer
 
@@ -36,7 +38,8 @@ def anneal_temperature(cfg: AnnealConfig, global_step: int) -> float:
 
 
 def make_vae_train_step(model: DiscreteVAE):
-    """Returns step(state, images, key, temp) -> (state, metrics). jit-once."""
+    """Returns step(state, images, key, temp) -> (state, metrics). jit-once;
+    the state is donated so params/moments update in place in HBM."""
 
     def loss_fn(params, images, key, temp):
         loss, recons = model.apply(
@@ -44,7 +47,7 @@ def make_vae_train_step(model: DiscreteVAE):
             rngs={"gumbel": key})
         return loss, recons
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=(0,))
     def step(state: TrainState, images, key, temp):
         (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, images, key, temp)
@@ -54,9 +57,6 @@ def make_vae_train_step(model: DiscreteVAE):
     return step
 
 
-from functools import partial
-
-
 @partial(jax.jit, static_argnums=1)
 def _codebook_counts(indices, num_tokens):
     """Histogram of codebook usage — the collapse monitor the reference logs to
@@ -64,94 +64,40 @@ def _codebook_counts(indices, num_tokens):
     return jnp.bincount(indices.reshape(-1), length=num_tokens)
 
 
-class VAETrainer:
+class VAETrainer(BaseTrainer):
+    model_class = "DiscreteVAE"
+
     def __init__(self, model_cfg: DVAEConfig, train_cfg: TrainConfig,
                  anneal_cfg: Optional[AnnealConfig] = None, mesh=None,
                  backend=None):
+        super().__init__(train_cfg, mesh=mesh, backend=backend)
         self.model_cfg = model_cfg
-        self.train_cfg = train_cfg
         self.anneal_cfg = anneal_cfg or AnnealConfig()
-        if mesh is None and backend is not None:
-            mesh = backend.mesh
-        if mesh is None:
-            from ..parallel import build_mesh
-            mesh = build_mesh(train_cfg.mesh)
-        self.mesh = mesh
-        self.backend = backend
 
-        key = jax.random.PRNGKey(train_cfg.seed)
-        self.model, params = init_dvae(model_cfg, key)
-        params = shard_params(mesh, params)
+        self.model, params = init_dvae(model_cfg, self.base_key)
+        params = shard_params(self.mesh, params)
         tx = make_optimizer(train_cfg.optim)
-        self.state = TrainState.create(apply_fn=self.model.apply, params=params, tx=tx)
+        self.state = TrainState.create(apply_fn=self.model.apply, params=params,
+                                       tx=tx)
         self.step_fn = make_vae_train_step(self.model)
-        self.base_key = key
-        self.ckpt = CheckpointManager(train_cfg.checkpoint_dir,
-                                      keep_n=train_cfg.keep_n_checkpoints)
-        self._last_good = None   # host copy of (params, opt_state) for NaN rollback
-        self._host_step = 0      # host mirror of state.step: no device sync per step
 
         n = count_params(self.state.params)
         self.meter = ThroughputMeter(train_cfg.batch_size, train_cfg.log_every,
                                      flops_per_step=6.0 * n * train_cfg.batch_size *
                                      model_cfg.image_seq_len,
-                                     num_chips=jax.device_count())
+                                     num_chips=self.mesh.size)
 
     # -- single step -------------------------------------------------------
-    def train_step(self, images: np.ndarray):
+    def train_step(self, images: np.ndarray, _labels=None):
         step_num = self._host_step
         temp = anneal_temperature(self.anneal_cfg, step_num)
         key = jax.random.fold_in(self.base_key, step_num)
         images = shard_batch(self.mesh, images.astype(np.float32))
         self.state, metrics = self.step_fn(self.state, images, key,
                                            jnp.float32(temp))
-        self._host_step += 1
-        metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
+        metrics = self._finish_step(metrics)
         metrics["temperature"] = temp
-        rep = self.meter.step(step_num)
-        if rep:
-            metrics.update(rep)
         return metrics
-
-    # -- full loop with parity behaviors ----------------------------------
-    def fit(self, batches, *, steps: Optional[int] = None, log=print):
-        tc = self.train_cfg
-        meta = {"hparams": self.model_cfg.to_dict(), "train": tc.to_dict(),
-                "model_class": "DiscreteVAE"}
-        if tc.preflight_checkpoint:
-            self.ckpt.preflight(self.state, meta)
-        self._snapshot_good()
-        for images, _ in batches:
-            m = self.train_step(images)
-            step_num = self._host_step
-            if tc.nan_rollback and not math.isfinite(m["loss"]):
-                log(f"[step {step_num}] NaN loss — rolling back to last good state")
-                self._rollback()
-                continue
-            if step_num % tc.log_every == 0:
-                log(f"[step {step_num}] " +
-                    " ".join(f"{k}={v:.5g}" for k, v in m.items()))
-            if step_num % tc.save_every_steps == 0:
-                self.ckpt.save(step_num, self.state, meta)
-                self._snapshot_good()
-            if steps is not None and step_num >= steps:
-                break
-        return self.state
-
-    def _snapshot_good(self):
-        # NaN loss is observed AFTER apply_gradients has run, so the optimizer
-        # moments are poisoned too — snapshot and restore both (the reference
-        # fork reloads the whole checkpoint, vae.py:100-110)
-        live = (self.state.params, self.state.opt_state)
-        self._last_good = jax.device_get(live)
-        self._last_good_shardings = jax.tree.map(lambda x: x.sharding, live)
-
-    def _rollback(self):
-        if self._last_good is not None:
-            restored = jax.tree.map(jax.device_put, self._last_good,
-                                    self._last_good_shardings)
-            params, opt_state = restored
-            self.state = self.state.replace(params=params, opt_state=opt_state)
 
     # -- eval utilities ----------------------------------------------------
     def reconstruct(self, images: np.ndarray, hard: bool = True):

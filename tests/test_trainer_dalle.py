@@ -1,6 +1,7 @@
 """DalleTrainer + driver entry points on the 8-device CPU mesh."""
 
 import math
+import pathlib
 import sys
 
 import jax
@@ -70,7 +71,7 @@ def test_fit_checkpoint_resume(tmp_path, rng):
 
 
 def test_graft_entry_compiles():
-    sys.path.insert(0, "/root/repo")
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
     import __graft_entry__ as ge
     fn, args = ge.entry()
     # compile-check only (driver does the same); tiny eval via eval_shape
@@ -79,6 +80,6 @@ def test_graft_entry_compiles():
 
 
 def test_graft_dryrun_multichip():
-    sys.path.insert(0, "/root/repo")
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
     import __graft_entry__ as ge
     ge.dryrun_multichip(8)
