@@ -106,19 +106,26 @@ class Attention(nn.Module):
         shape = (-1, n, self.heads, self.dim_head)
         return [t.reshape(shape).transpose(0, 2, 1, 3) for t in (q, k, v)]
 
-    def __call__(self, x, *, key_mask=None, rotary=None, static_mask=None,
-                 np_mask=None, deterministic: bool = True):
+    def __call__(self, x, *, key_mask=None, rotary=None, np_mask=None,
+                 deterministic: bool = True):
+        """``np_mask`` is the ONE mask parameter (host-side numpy, compile-time
+        constant): the pallas path lowers it to block lists, the dense path
+        converts it to a jnp constant — a single source of truth so the two
+        backends can never disagree."""
         b, n, _ = x.shape
         q, k, v = self._split(self.to_qkv(x), n)
         if rotary is not None:
             rot = rotary[:n][None, None]
             q, k, v = (apply_rotary(rot, t) for t in (q, k, v))
-        if self.use_pallas and key_mask is None:
+        if self.use_pallas and key_mask is None and not self.is_initializing():
+            # (init uses the dense path: params are identical and eager pallas
+            # execution during un-jitted init is needlessly slow)
             from ..ops.flash_attention import flash_attention
             out = flash_attention(q, k, v, mask=np_mask, causal=self.causal)
         else:
+            static = None if np_mask is None else jnp.asarray(np_mask)
             out = attend(q, k, v, causal=self.causal, key_mask=key_mask,
-                         static_mask=static_mask, stable=self.stable)
+                         static_mask=static, stable=self.stable)
         out = out.transpose(0, 2, 1, 3).reshape(b, n, -1)
         return self.drop(self.to_out(out), deterministic=deterministic)
 
@@ -387,19 +394,64 @@ class Transformer(nn.Module):
 
     # -- training / full forward ------------------------------------------
     def __call__(self, x, key_mask=None, deterministic: bool = True):
-        """Sequential execution. Memory scaling for deep stacks comes from
-        rematerialization at the train-step level (jax.checkpoint over this
-        call) and the reversible path (models/reversible.py) — the TPU
-        equivalents of the reference's ReversibleSequence."""
+        """Sequential execution by default; ``cfg.reversible`` switches to the
+        O(1)-activation custom_vjp path (models/reversible.py) — the TPU
+        equivalent of the reference's ReversibleSequence. `jax.checkpoint` at
+        the train-step level is the complementary remat lever."""
         c = self.cfg
+        if c.reversible:
+            return self._call_reversible(x, key_mask, deterministic)
         for ind in range(c.depth):
             attn_l, ff_l, t = self.attn_layers[ind], self.ff_layers[ind], self.layer_types[ind]
             x = x + attn_l(x, key_mask=key_mask, rotary=self.rotary,
-                           static_mask=self._dense_mask(t),
                            np_mask=self.np_masks[t],
                            deterministic=deterministic)
             x = x + ff_l(x, deterministic=deterministic)
         return x
+
+    def _call_reversible(self, x, key_mask, deterministic: bool):
+        """Unbind each layer into (pure fn, params) pairs and run the
+        reversible coupling. Dropout requires per-recompute rng replay — not
+        supported on this path (reference replays RNG state, reversible.py:20-50;
+        here keys are explicit and the sequential path covers dropout)."""
+        from .reversible import run_reversible
+        c = self.cfg
+        if not deterministic and (c.attn_dropout > 0 or c.ff_dropout > 0):
+            raise NotImplementedError(
+                "reversible path requires deterministic execution (no dropout)")
+        if self.is_initializing():
+            # bound calls so flax creates the params; same coupled computation
+            x1 = x2 = x
+            for ind in range(c.depth):
+                x1 = x1 + self._apply_attn_layer(x2, ind, key_mask)
+                x2 = x2 + self._apply_ff_layer(x1, ind)
+            return (x1 + x2) / 2.0
+        # Unbind the WHOLE stack once: shared layers live in their first
+        # adopter's flax scope, so per-layer unbinding would lose their params.
+        # Each block fn takes the full variable tree; unused-leaf cotangents
+        # are symbolic zeros that XLA folds away.
+        tm, variables = self.unbind()
+        fns, params = [], []
+        for ind in range(c.depth):
+            def f(p, h, _ind=ind):
+                return tm.apply(p, h, _ind, key_mask,
+                                method=Transformer._apply_attn_layer)
+
+            def g(p, h, _ind=ind):
+                return tm.apply(p, h, _ind, method=Transformer._apply_ff_layer)
+
+            fns.append((f, g))
+            params.append((variables, variables))
+        return run_reversible(fns, params, x)
+
+    def _apply_attn_layer(self, h, ind: int, key_mask=None):
+        t = self.layer_types[ind]
+        return self.attn_layers[ind](h, key_mask=key_mask, rotary=self.rotary,
+                                     np_mask=self.np_masks[t],
+                                     deterministic=True)
+
+    def _apply_ff_layer(self, h, ind: int):
+        return self.ff_layers[ind](h, deterministic=True)
 
     # -- cached decode -----------------------------------------------------
     def init_cache(self, batch: int, max_seq: Optional[int] = None,

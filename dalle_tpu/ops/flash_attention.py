@@ -60,8 +60,11 @@ def build_block_lists(n_pad: int, block_q: int, block_k: int,
     nq, nk = n_pad // block_q, n_pad // block_k
     vis = np.zeros((n_pad, n_pad), dtype=bool)
     if mask is not None:
-        s = mask.shape[0]
-        vis[:s, :s] = mask
+        # the mask may be larger than the runtime sequence (e.g. built for
+        # seq_len+1 while training feeds seq_len after dropping the last
+        # token, reference dalle_pytorch.py:608-613) — trim to n_pad
+        s = min(mask.shape[0], n_pad)
+        vis[:s, :s] = mask[:s, :s]
     else:
         vis[:, :] = True
     if causal:
@@ -109,7 +112,10 @@ def _fwd_kernel(ids_ref, cnt_ref, q_ref, k_ref, v_ref, mask_ref,
         valid &= mask_ref[:, pl.ds(jb * block_k, block_k)] > 0
         s = jnp.where(valid, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
+        # for a fully-masked row m_new == NEG_INF and exp(s - m_new) would be
+        # exp(0) == 1 — force masked entries to 0 so l stays 0 and the
+        # empty-row guard below fires (valid scores never approach NEG_INF/2)
+        p = jnp.where(s > 0.5 * NEG_INF, jnp.exp(s - m_new), 0.0)
         corr = jnp.exp(m - m_new)
         l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc = acc * corr + jax.lax.dot_general(
@@ -232,8 +238,8 @@ def _make_flash_fn(n: int, n_pad: int, block_q: int, block_k: int,
     if mask_np is None:
         mask_pad[:, :] = 1
     else:
-        s = mask_np.shape[0]
-        mask_pad[:s, :s] = mask_np
+        s = min(mask_np.shape[0], n_pad)
+        mask_pad[:s, :s] = mask_np[:s, :s]
     # keep closure constants as NUMPY: jnp conversion inside a jit trace would
     # capture per-trace tracers in the lru-cached closure (leaked-tracer error)
     mask_c = mask_pad

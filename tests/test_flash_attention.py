@@ -131,3 +131,52 @@ def test_transformer_use_pallas_matches_dense():
     y_flash = m_flash.apply(params, x)
     np.testing.assert_allclose(np.asarray(y_flash), np.asarray(y_dense),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_fully_masked_row_inside_visible_block():
+    """A row whose every key is masked, inside a block other rows keep visible:
+    forward must output 0 for that row (dense path convention: uniform attention
+    over -inf rows differs, so compare via gradients being finite and other rows
+    matching dense)."""
+    n = 64
+    mask = np.tril(np.ones((n, n), dtype=bool))
+    mask[10, :] = False   # row 10 sees nothing
+    q, k, v = _qkv(n, seed=7)
+    out = flash_attention(q, k, v, mask=mask, causal=True,
+                          block_q=32, block_k=32)
+    # empty row → zero output, and it must not pollute its block's neighbors
+    np.testing.assert_allclose(np.asarray(out[:, :, 10]), 0.0, atol=1e-6)
+    ref = attend(q, k, v, causal=True, static_mask=jnp.asarray(mask))
+    keep = [i for i in range(n) if i != 10]
+    np.testing.assert_allclose(np.asarray(out[:, :, keep]),
+                               np.asarray(ref[:, :, keep]),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss(q, k, v):
+        o = flash_attention(q, k, v, mask=mask, causal=True,
+                            block_q=32, block_k=32)
+        return jnp.sum(jnp.sin(o))
+
+    grads = jax.grad(loss, (0, 1, 2))(q, k, v)
+    for g in grads:
+        assert bool(jnp.all(jnp.isfinite(g)))
+    # gradients for surviving rows must match the dense path
+
+    def loss_ref(q, k, v):
+        o = attend(q, k, v, causal=True, static_mask=jnp.asarray(mask))
+        keep_o = jnp.concatenate([o[:, :, :10], o[:, :, 11:]], axis=2)
+        return jnp.sum(jnp.sin(keep_o))
+
+    def loss_keep(q, k, v):
+        o = flash_attention(q, k, v, mask=mask, causal=True,
+                            block_q=32, block_k=32)
+        keep_o = jnp.concatenate([o[:, :, :10], o[:, :, 11:]], axis=2)
+        return jnp.sum(jnp.sin(keep_o))
+
+    g_ref = jax.grad(loss_ref, (0, 1, 2))(q, k, v)
+    g_fl = jax.grad(loss_keep, (0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_fl):
+        # dense grad for the empty q row is garbage-driven; exclude it
+        am, bm = np.array(a), np.array(b)
+        am[:, :, 10] = 0; bm[:, :, 10] = 0
+        np.testing.assert_allclose(bm, am, rtol=3e-5, atol=3e-5)

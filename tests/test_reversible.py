@@ -1,0 +1,131 @@
+"""Reversible execution: custom_vjp gradients must equal plain autodiff
+through the same coupled forward, and the Transformer's reversible path must
+stay consistent with itself under grad."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dalle_tpu.config import TransformerConfig
+from dalle_tpu.models.reversible import (reversible_forward_naive,
+                                         reversible_sequence, run_reversible)
+from dalle_tpu.models.transformer import Transformer
+
+
+def _toy_fns(depth, dim, key):
+    """Per-block (f, g) as tiny MLPs with explicit param pytrees."""
+    fns, params = [], []
+    for i in range(depth):
+        k1, k2, key = jax.random.split(key, 3)
+
+        def f(p, x):
+            return jnp.tanh(x @ p["w"]) * p["s"]
+
+        def g(p, x):
+            return jnp.sin(x @ p["w"]) + p["b"]
+
+        fns.append((f, g))
+        params.append((
+            {"w": jax.random.normal(k1, (dim, dim)) * 0.2,
+             "s": jnp.float32(0.5)},
+            {"w": jax.random.normal(k2, (dim, dim)) * 0.2,
+             "b": jnp.zeros((dim,))},
+        ))
+    return tuple(fns), tuple(params)
+
+
+def test_forward_equals_naive():
+    fns, params = _toy_fns(4, 8, jax.random.PRNGKey(0))
+    x1 = jax.random.normal(jax.random.PRNGKey(1), (2, 5, 8))
+    x2 = jax.random.normal(jax.random.PRNGKey(2), (2, 5, 8))
+    y_naive = reversible_forward_naive(fns, params, x1, x2)
+    y_cvjp = reversible_sequence(fns, params, x1, x2)
+    for a, b in zip(y_naive, y_cvjp):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), rtol=1e-6)
+
+
+def test_gradients_equal_naive_autodiff():
+    fns, params = _toy_fns(3, 8, jax.random.PRNGKey(3))
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 5, 8))
+
+    def loss_naive(params, x):
+        return jnp.sum(run_reversible(fns, params, x, naive=True) ** 2)
+
+    def loss_cvjp(params, x):
+        return jnp.sum(run_reversible(fns, params, x) ** 2)
+
+    gp_n, gx_n = jax.grad(loss_naive, argnums=(0, 1))(params, x)
+    gp_c, gx_c = jax.grad(loss_cvjp, argnums=(0, 1))(params, x)
+    np.testing.assert_allclose(np.asarray(gx_c), np.asarray(gx_n),
+                               rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(gp_n), jax.tree.leaves(gp_c)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def _tiny_cfg(**kw):
+    base = dict(dim=32, depth=2, heads=2, dim_head=16, seq_len=24,
+                image_fmap_size=4, attn_types=("full", "axial_row"),
+                rotary_emb=False)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def test_transformer_reversible_grads_match_naive_coupling():
+    """The flax-integrated custom_vjp path must produce the same outputs AND
+    grads as the identical coupled forward differentiated conventionally
+    (rebuilt from the per-layer apply methods — full-activation autodiff)."""
+    cfg = _tiny_cfg(reversible=True)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 25, 32))
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(1), x)
+
+    def loss_rev(p):
+        return jnp.sum(model.apply(p, x) ** 2)
+
+    def loss_naive(p):
+        x1 = x2 = x
+        for ind in range(cfg.depth):
+            x1 = x1 + model.apply(p, x2, ind, None,
+                                  method=Transformer._apply_attn_layer)
+            x2 = x2 + model.apply(p, x1, ind,
+                                  method=Transformer._apply_ff_layer)
+        return jnp.sum(((x1 + x2) / 2.0) ** 2)
+
+    np.testing.assert_allclose(float(loss_rev(params)),
+                               float(loss_naive(params)), rtol=1e-6)
+    g_rev = jax.grad(loss_rev)(params)
+    g_nai = jax.grad(loss_naive)(params)
+    for a, b in zip(jax.tree.leaves(g_nai), jax.tree.leaves(g_rev)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=5e-4, atol=1e-5)
+
+
+def test_transformer_reversible_vs_sequential_architectures_differ():
+    """Sanity: reversible is a different function than sequential (two-stream
+    coupling), so outputs should NOT match — guards against silently running
+    the sequential path."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 25, 32))
+    m_seq = Transformer(_tiny_cfg(reversible=False))
+    params = m_seq.init(jax.random.PRNGKey(1), x)
+    m_rev = Transformer(_tiny_cfg(reversible=True))
+    y_seq = m_seq.apply(params, x)
+    y_rev = m_rev.apply(params, x)
+    assert not np.allclose(np.asarray(y_seq), np.asarray(y_rev))
+
+
+def test_transformer_reversible_jits_and_shared_layers():
+    """Layer sharing under the reversible path: shared modules are the same
+    params used at several depths; grads must accumulate, jit must compile."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 25, 32))
+    cfg = _tiny_cfg(depth=4, attn_types=("full",), shared_attn_ids=(0, 1, 0, 1),
+                    shared_ff_ids=(0, 0, 0, 0), reversible=True)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(1), x)
+
+    @jax.jit
+    def loss(p):
+        return jnp.sum(model.apply(p, x) ** 2)
+
+    g = jax.grad(loss)(params)
+    assert all(bool(jnp.all(jnp.isfinite(l))) for l in jax.tree.leaves(g))
