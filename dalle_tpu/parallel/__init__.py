@@ -4,3 +4,4 @@ from .backend import (DistributedBackend, JaxBackend, DummyBackend, BACKENDS,
                       wrap_arg_parser, set_backend_from_args, using_backend)
 from .partition import (DEFAULT_RULES, make_param_shardings, shard_params,
                         spec_for, constrain)
+from .ring_attention import ring_attention, shard_seq

@@ -1,0 +1,92 @@
+"""Ring attention — sequence/context parallelism over the ``sp`` mesh axis.
+
+The reference has NO sequence parallelism (SURVEY.md §5.7): its sequence-
+scaling levers are sparse masks and reversible layers. For the TPU framework
+long-context is first-class: activations shard along the sequence dimension
+over the mesh's ``sp`` axis, each device holds its q chunk permanently, and
+k/v chunks rotate around the ring via `lax.ppermute` (one ICI hop per step)
+while a flash-style online softmax accumulates partial results — attention
+over sequences P× longer than one chip's memory, with communication fully
+overlappable with the chunk matmuls (XLA schedules the ppermute DMA against
+the einsums).
+
+Causality is enforced by *global* position comparison (chunk origin × chunk
+size + local offset), so the math is exact for any P. Chunks wholly in a
+query's future still traverse the ring but contribute only masked work — the
+standard trade for keeping the schedule static; a zigzag chunk assignment can
+rebalance this later.
+
+Collectives ride the mesh exactly like the scaling-book recipe: shard_map
+gives per-device code, ppermute lowers to ICI neighbor exchange.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _ring_body(q, k, v, *, axis: str, nper: int, causal: bool, scale: float):
+    """Per-device program: q stays, k/v rotate. q/k/v: (b, h, n_local, d)."""
+    P_size = jax.lax.psum(1, axis)
+    idx = jax.lax.axis_index(axis)
+    n_local = q.shape[2]
+    qf = q.astype(jnp.float32) * scale
+    qpos = idx * n_local + jnp.arange(n_local)                     # global q pos
+
+    acc = jnp.zeros(q.shape, jnp.float32)
+    m = jnp.full((*q.shape[:3], 1), -1e9, jnp.float32)
+    l = jnp.zeros((*q.shape[:3], 1), jnp.float32)
+    perm = [(i, (i + 1) % nper) for i in range(nper)]
+
+    k_cur, v_cur = k.astype(jnp.float32), v.astype(jnp.float32)
+    for t in range(nper):
+        src = (idx - t) % P_size            # ring origin of the current chunk
+        s = jnp.einsum("bhid,bhjd->bhij", qf, k_cur)
+        if causal:
+            kpos = src * n_local + jnp.arange(n_local)
+            vis = kpos[None, :] <= qpos[:, None]                   # (i, j)
+            s = jnp.where(vis[None, None], s, -1e9)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(s > -0.5e9, jnp.exp(s - m_new), 0.0)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + jnp.einsum("bhij,bhjd->bhid", p, v_cur)
+        m = m_new
+        if t + 1 < nper:
+            k_cur = jax.lax.ppermute(k_cur, axis, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis, perm)
+    safe_l = jnp.where(l > 0, l, 1.0)
+    return (acc / safe_l).astype(q.dtype)
+
+
+@functools.lru_cache(maxsize=16)
+def _make_ring_fn(mesh: Mesh, axis: str, causal: bool, nper: int, scale: float):
+    spec = P(None, None, axis, None)
+    body = functools.partial(_ring_body, axis=axis, nper=nper, causal=causal,
+                             scale=scale)
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec)
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                   mesh: Mesh, axis: str = "sp", causal: bool = True,
+                   scale: Optional[float] = None) -> jnp.ndarray:
+    """Sequence-parallel attention over (b, h, n, d) arrays whose sequence dim
+    is (or will be) sharded along ``mesh[axis]``. n must divide evenly."""
+    nper = mesh.shape[axis]
+    n = q.shape[2]
+    assert n % nper == 0, f"seq {n} must divide the {axis} axis ({nper})"
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    fn = _make_ring_fn(mesh, axis, causal, nper, float(scale))
+    return fn(q, k, v)
+
+
+def shard_seq(mesh: Mesh, x, axis: str = "sp"):
+    """Place (b, h, n, d) with the sequence dim sharded over ``axis``."""
+    return jax.device_put(x, NamedSharding(mesh, P(None, None, axis, None)))
