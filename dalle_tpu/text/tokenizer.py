@@ -1,0 +1,176 @@
+"""Text tokenizers — the L1 layer (SURVEY.md §2.3).
+
+Shared contract (reference dalle_pytorch/tokenizer.py:137-152, all four
+implementations): ``tokenize(texts, context_length=256, truncate_text=False)
+-> int32[b, context_length]`` with 0 as pad, plus ``encode``/``decode`` and
+``vocab_size``. Host-side only — token ids are the device boundary.
+
+Implementations:
+  * SimpleTokenizer — byte-level BPE (text/bpe.py), CLIP-merges-file
+    compatible, native C++ merge core when available. With no merges file it
+    degrades to byte-level (still a correct tokenizer, vocab 514).
+  * HugTokenizer — HuggingFace `tokenizers` JSON wrapper (tokenizer.py:158-192).
+  * ChineseTokenizer — HF transformers bert-base-chinese (tokenizer.py:196-228).
+  * YttmTokenizer — the reference wraps YouTokenToMe's C++ BPE
+    (tokenizer.py:232-266); here the native core IS in-framework, so this is
+    an alias over SimpleTokenizer with a yttm-model-style train/load flow.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from .bpe import BPE, load_merges, save_merges, train_bpe
+
+
+class SimpleTokenizer:
+    """Byte-level BPE with the reference contract. ``bpe_path`` accepts a
+    CLIP-format merges file; ``merges`` accepts an in-memory merge list."""
+
+    CLIP_MERGE_LIMIT = 49152 - 256 - 2  # reference tokenizer.py:58
+
+    def __init__(self, bpe_path: Optional[str] = None, merges=None,
+                 clip_compat: bool = False):
+        if bpe_path is not None:
+            limit = self.CLIP_MERGE_LIMIT if clip_compat else None
+            merges = load_merges(bpe_path, limit=limit)
+        self.bpe = BPE(list(merges or []))
+
+    @property
+    def vocab_size(self) -> int:
+        return self.bpe.vocab_size
+
+    def encode(self, text: str) -> List[int]:
+        return self.bpe.encode(text)
+
+    def decode(self, ids: Iterable[int]) -> str:
+        ids = [int(i) for i in np.asarray(list(ids)).reshape(-1) if int(i) != 0]
+        return self.bpe.decode(ids)
+
+    def tokenize(self, texts, context_length: int = 256,
+                 truncate_text: bool = False) -> np.ndarray:
+        """Pad/truncate to a fixed (b, context_length) int32 array, pad id 0
+        (reference tokenizer.py:137-152)."""
+        if isinstance(texts, str):
+            texts = [texts]
+        out = np.zeros((len(texts), context_length), dtype=np.int32)
+        for i, text in enumerate(texts):
+            ids = self.encode(text)
+            if len(ids) > context_length:
+                if not truncate_text:
+                    raise RuntimeError(
+                        f"Input {text!r} is too long for context length "
+                        f"{context_length}")
+                ids = ids[:context_length]
+            out[i, :len(ids)] = ids
+        return out
+
+    # -- training flow (yttm-style) ----------------------------------------
+    @classmethod
+    def train(cls, texts: Iterable[str], num_merges: int,
+              save_path: Optional[str] = None) -> "SimpleTokenizer":
+        merges = train_bpe(texts, num_merges)
+        if save_path:
+            save_merges(save_path, merges)
+        return cls(merges=merges)
+
+
+class YttmTokenizer(SimpleTokenizer):
+    """Name-compatible stand-in for the reference's YouTokenToMe wrapper
+    (tokenizer.py:232-266): same contract, BPE model loaded from a merges
+    file; the C++ merge core lives in-framework (text/native/)."""
+
+    def __init__(self, bpe_path: str):
+        if not Path(bpe_path).exists():
+            raise ValueError(f"BPE json path {bpe_path!r} does not exist")
+        super().__init__(bpe_path=str(bpe_path))
+
+
+class HugTokenizer:
+    """HuggingFace `tokenizers` JSON vocab wrapper (reference
+    tokenizer.py:158-192). Import is lazy — the dependency is optional."""
+
+    def __init__(self, bpe_path: str):
+        try:
+            from tokenizers import Tokenizer  # type: ignore
+        except ImportError as e:  # pragma: no cover
+            raise ImportError(
+                "HugTokenizer needs the `tokenizers` package") from e
+        path = Path(bpe_path)
+        if not path.exists():
+            raise ValueError(f"BPE json path {bpe_path!r} does not exist")
+        self.tokenizer = Tokenizer.from_file(str(path))
+        self.vocab_size = self.tokenizer.get_vocab_size()
+
+    def encode(self, text: str) -> List[int]:
+        return self.tokenizer.encode(text).ids
+
+    def decode(self, ids) -> str:
+        ids = [int(i) for i in np.asarray(list(ids)).reshape(-1) if int(i) != 0]
+        return self.tokenizer.decode(ids)
+
+    def tokenize(self, texts, context_length: int = 256,
+                 truncate_text: bool = False) -> np.ndarray:
+        if isinstance(texts, str):
+            texts = [texts]
+        out = np.zeros((len(texts), context_length), dtype=np.int32)
+        for i, text in enumerate(texts):
+            ids = self.encode(text)
+            if len(ids) > context_length:
+                if not truncate_text:
+                    raise RuntimeError(
+                        f"Input {text!r} is too long for context length "
+                        f"{context_length}")
+                ids = ids[:context_length]
+            out[i, :len(ids)] = ids
+        return out
+
+
+class ChineseTokenizer:
+    """bert-base-chinese via HF transformers (reference tokenizer.py:196-228).
+    Requires the pretrained vocab locally (no network egress here)."""
+
+    def __init__(self, model_name: str = "bert-base-chinese"):
+        try:
+            from transformers import BertTokenizer  # type: ignore
+        except ImportError as e:  # pragma: no cover
+            raise ImportError(
+                "ChineseTokenizer needs the `transformers` package") from e
+        self.tokenizer = BertTokenizer.from_pretrained(model_name)
+        self.vocab_size = self.tokenizer.vocab_size
+
+    def encode(self, text: str) -> List[int]:
+        return self.tokenizer.encode(text, add_special_tokens=False)
+
+    def decode(self, ids) -> str:
+        ids = [int(i) for i in np.asarray(list(ids)).reshape(-1) if int(i) != 0]
+        return self.tokenizer.decode(ids)
+
+    def tokenize(self, texts, context_length: int = 256,
+                 truncate_text: bool = False) -> np.ndarray:
+        if isinstance(texts, str):
+            texts = [texts]
+        out = np.zeros((len(texts), context_length), dtype=np.int32)
+        for i, text in enumerate(texts):
+            ids = self.encode(text)
+            if len(ids) > context_length:
+                if not truncate_text:
+                    raise RuntimeError(
+                        f"Input {text!r} is too long for context length "
+                        f"{context_length}")
+                ids = ids[:context_length]
+            out[i, :len(ids)] = ids
+        return out
+
+
+def get_tokenizer(kind: str = "simple", **kw):
+    """Registry mirroring the reference's CLI selection
+    (legacy/train_dalle.py:241-245)."""
+    kinds = {"simple": SimpleTokenizer, "yttm": YttmTokenizer,
+             "hug": HugTokenizer, "chinese": ChineseTokenizer}
+    if kind not in kinds:
+        raise ValueError(f"unknown tokenizer {kind!r}; options: {sorted(kinds)}")
+    return kinds[kind](**kw)
