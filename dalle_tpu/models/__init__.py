@@ -1,1 +1,5 @@
 from .dvae import DiscreteVAE, init_dvae
+from .vqgan import VQModel, VQGANEncoder, VQGANDecoder, init_vqgan
+from .gan import (GANLossConfig, NLayerDiscriminator, ActNorm, hinge_d_loss,
+                  vanilla_d_loss, adopt_weight, adaptive_disc_weight)
+from .lpips import LPIPS, init_lpips

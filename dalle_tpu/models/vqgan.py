@@ -1,0 +1,246 @@
+"""VQGAN autoencoder — the taming-transformers capability, rebuilt TPU-first.
+
+Reference: ``VQModel``/``GumbelVQ`` (dalle_pytorch/taming/models/vqgan.py:12-303)
+over the DDPM-style conv stacks (taming/modules/diffusionmodules/model.py:342-537:
+ResnetBlock :78-137, AttnBlock :140-192, Down/Upsample :38-76) and the quantizers
+(taming/modules/vqvae/quantize.py:110-329).
+
+TPU redesign notes:
+  * NHWC layout throughout (XLA:TPU native conv layout; reference is NCHW).
+  * The spatial self-attention block is phrased as two batched matmuls over the
+    flattened (h·w) axis so it lands on the MXU; at the configured
+    ``attn_resolutions`` (default 16×16 = 256 positions) dense attention is
+    exactly the right tool — no kernel needed.
+  * Quantizers are the pure-XLA ops in ``ops/quantize.py`` (NN lookup phrased as
+    one big matmul; straight-through via ``stop_gradient``).
+  * No Lightning, no optimizer_idx switches — training lives in
+    ``train/trainer_vqgan.py`` as two explicit jitted steps.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..config import VQGANConfig
+from ..ops.quantize import VQOutput, gumbel_quantize, vector_quantize
+
+
+def swish(x):
+    return x * jax.nn.sigmoid(x)
+
+
+def group_norm(name: str, channels: Optional[int] = None):
+    # GroupNorm(32, eps=1e-6) — taming model.py:34-35 ("Normalize"). For small
+    # test-sized channel counts, fall back to the largest divisor ≤ 32.
+    groups = 32
+    if channels is not None and channels % 32 != 0:
+        import math
+        groups = math.gcd(32, channels)
+    return nn.GroupNorm(num_groups=groups, epsilon=1e-6, name=name)
+
+
+class ResnetBlock(nn.Module):
+    """norm→swish→conv3x3, norm→swish→dropout→conv3x3, 1×1 nin shortcut when the
+    channel count changes (taming model.py:78-137; temb path unused by VQGAN)."""
+    out_ch: int
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        h = group_norm("norm1", x.shape[-1])(x)
+        h = swish(h)
+        h = nn.Conv(self.out_ch, (3, 3), padding=1, name="conv1")(h)
+        h = group_norm("norm2", h.shape[-1])(h)
+        h = swish(h)
+        h = nn.Dropout(self.dropout, deterministic=deterministic)(h)
+        h = nn.Conv(self.out_ch, (3, 3), padding=1, name="conv2")(h)
+        if x.shape[-1] != self.out_ch:
+            x = nn.Conv(self.out_ch, (1, 1), name="nin_shortcut")(x)
+        return x + h
+
+
+class AttnBlock(nn.Module):
+    """Single-head spatial self-attention over the h×w grid
+    (taming model.py:140-192), as two MXU matmuls on the flattened axis."""
+
+    @nn.compact
+    def __call__(self, x):
+        b, h, w, c = x.shape
+        hn = group_norm("norm", c)(x)
+        q = nn.Conv(c, (1, 1), name="q")(hn).reshape(b, h * w, c)
+        k = nn.Conv(c, (1, 1), name="k")(hn).reshape(b, h * w, c)
+        v = nn.Conv(c, (1, 1), name="v")(hn).reshape(b, h * w, c)
+        attn = jax.nn.softmax(jnp.einsum("bic,bjc->bij", q, k) * (c ** -0.5), axis=-1)
+        out = jnp.einsum("bij,bjc->bic", attn, v).reshape(b, h, w, c)
+        out = nn.Conv(c, (1, 1), name="proj_out")(out)
+        return x + out
+
+
+class Downsample(nn.Module):
+    """conv3x3 stride 2 with the reference's asymmetric (0,1) pad
+    (taming model.py:56-75)."""
+    ch: int
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Conv(self.ch, (3, 3), strides=(2, 2),
+                       padding=((0, 1), (0, 1)), name="conv")(x)
+
+
+class Upsample(nn.Module):
+    """nearest ×2 then conv3x3 (taming model.py:38-53)."""
+    ch: int
+
+    @nn.compact
+    def __call__(self, x):
+        b, h, w, c = x.shape
+        x = jax.image.resize(x, (b, h * 2, w * 2, c), method="nearest")
+        return nn.Conv(self.ch, (3, 3), padding=1, name="conv")(x)
+
+
+class VQGANEncoder(nn.Module):
+    """conv_in → [num_res_blocks × ResnetBlock (+Attn at attn_resolutions),
+    Downsample] per ch_mult level → mid(Res, Attn, Res) → norm/swish/conv_out
+    to z_channels (taming model.py:342-433)."""
+    cfg: VQGANConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        c = self.cfg
+        h = nn.Conv(c.ch, (3, 3), padding=1, name="conv_in")(x)
+        curr_res = c.resolution
+        for i_level, mult in enumerate(c.ch_mult):
+            for i_block in range(c.num_res_blocks):
+                h = ResnetBlock(c.ch * mult, c.dropout,
+                                name=f"down_{i_level}_block_{i_block}")(h, deterministic)
+                if curr_res in c.attn_resolutions:
+                    h = AttnBlock(name=f"down_{i_level}_attn_{i_block}")(h)
+            if i_level != len(c.ch_mult) - 1:
+                h = Downsample(h.shape[-1], name=f"down_{i_level}_downsample")(h)
+                curr_res //= 2
+        h = ResnetBlock(h.shape[-1], c.dropout, name="mid_block_1")(h, deterministic)
+        h = AttnBlock(name="mid_attn_1")(h)
+        h = ResnetBlock(h.shape[-1], c.dropout, name="mid_block_2")(h, deterministic)
+        h = group_norm("norm_out", h.shape[-1])(h)
+        h = swish(h)
+        out_ch = 2 * c.z_channels if c.double_z else c.z_channels
+        return nn.Conv(out_ch, (3, 3), padding=1, name="conv_out")(h)
+
+
+class VQGANDecoder(nn.Module):
+    """conv_in → mid(Res, Attn, Res) → [(num_res_blocks+1) × ResnetBlock
+    (+Attn), Upsample] per reversed ch_mult level → norm/swish/conv_out
+    (taming model.py:436-537)."""
+    cfg: VQGANConfig
+
+    @nn.compact
+    def __call__(self, z, deterministic: bool = True, return_pre_out: bool = False):
+        c = self.cfg
+        num_levels = len(c.ch_mult)
+        curr_res = c.resolution // 2 ** (num_levels - 1)
+        h = nn.Conv(c.ch * c.ch_mult[-1], (3, 3), padding=1, name="conv_in")(z)
+        h = ResnetBlock(h.shape[-1], c.dropout, name="mid_block_1")(h, deterministic)
+        h = AttnBlock(name="mid_attn_1")(h)
+        h = ResnetBlock(h.shape[-1], c.dropout, name="mid_block_2")(h, deterministic)
+        for i_level in reversed(range(num_levels)):
+            for i_block in range(c.num_res_blocks + 1):
+                h = ResnetBlock(c.ch * c.ch_mult[i_level], c.dropout,
+                                name=f"up_{i_level}_block_{i_block}")(h, deterministic)
+                if curr_res in c.attn_resolutions:
+                    h = AttnBlock(name=f"up_{i_level}_attn_{i_block}")(h)
+            if i_level != 0:
+                h = Upsample(h.shape[-1], name=f"up_{i_level}_upsample")(h)
+                curr_res *= 2
+        h = group_norm("norm_out", h.shape[-1])(h)
+        h = swish(h)
+        out = nn.Conv(c.out_ch, (3, 3), padding=1, name="conv_out")(h)
+        if return_pre_out:
+            # h is the conv_out input — the hook the adaptive GAN weight
+            # differentiates through (gan.py; taming vqgan.py:78-81 get_last_layer)
+            return out, h
+        return out
+
+
+class VQModel(nn.Module):
+    """The VQGAN autoencoder: encoder → quant_conv 1×1 → quantizer →
+    post_quant_conv 1×1 → decoder (taming/models/vqgan.py:12-74; GumbelVQ
+    variant :261-303). Images are NHWC floats in [−1, 1].
+
+    Methods (select with ``method=`` in ``.apply``):
+      * ``__call__(img)`` — (recon, vq_loss, indices); GumbelVQ needs a
+        ``'gumbel'`` rng and a ``temp``.
+      * ``encode(img)`` — VQOutput (quantized latents NHWC, indices, loss).
+      * ``get_codebook_indices(img)`` — (b, n) int32 raster-order token ids.
+      * ``decode_code(ids)`` — token ids → image (vqgan.py:66-69 +
+        dalle_pytorch/vae.py:207-217).
+    """
+    cfg: VQGANConfig
+
+    def setup(self):
+        c = self.cfg
+        self.encoder = VQGANEncoder(c, name="encoder")
+        self.decoder = VQGANDecoder(c, name="decoder")
+        self.codebook = nn.Embed(c.n_embed, c.embed_dim, name="codebook")
+        if c.quantizer == "gumbel":
+            # GumbelQuantize: 1×1 proj to n_embed logits (quantize.py:110-141)
+            self.quant_proj = nn.Conv(c.n_embed, (1, 1), name="quant_proj")
+        else:
+            self.quant_conv = nn.Conv(c.embed_dim, (1, 1), name="quant_conv")
+        self.post_quant_conv = nn.Conv(c.z_channels, (1, 1), name="post_quant_conv")
+
+    def quantize(self, h, temp: Optional[float] = None,
+                 deterministic: bool = True) -> VQOutput:
+        c = self.cfg
+        if c.quantizer == "gumbel":
+            logits = self.quant_proj(h)
+            hard = c.straight_through if not deterministic else True
+            key = (self.make_rng("gumbel") if not deterministic
+                   else jax.random.PRNGKey(0))
+            return gumbel_quantize(key, logits, self.codebook.embedding,
+                                   tau=1.0 if temp is None else temp,
+                                   hard=hard, kl_weight=c.gumbel_kl_weight)
+        z = self.quant_conv(h)
+        return vector_quantize(z, self.codebook.embedding, beta=c.beta)
+
+    def encode(self, img, temp: Optional[float] = None,
+               deterministic: bool = True) -> VQOutput:
+        h = self.encoder(img, deterministic)
+        return self.quantize(h, temp=temp, deterministic=deterministic)
+
+    def decode(self, quant, deterministic: bool = True, return_pre_out: bool = False):
+        return self.decoder(self.post_quant_conv(quant), deterministic,
+                            return_pre_out=return_pre_out)
+
+    def get_codebook_indices(self, img):
+        out = self.encode(img, deterministic=True)
+        b = out.indices.shape[0]
+        return out.indices.reshape(b, -1)
+
+    def decode_code(self, ids):
+        b, n = ids.shape
+        hw = int(n ** 0.5)
+        quant = self.codebook(ids).reshape(b, hw, hw, self.cfg.embed_dim)
+        return self.decode(quant)
+
+    def __call__(self, img, temp: Optional[float] = None,
+                 deterministic: bool = True):
+        q = self.encode(img, temp=temp, deterministic=deterministic)
+        recon = self.decode(q.quantized, deterministic)
+        return recon, q.loss, q.indices
+
+    @property
+    def fmap_size(self) -> int:
+        return self.cfg.resolution // 2 ** (len(self.cfg.ch_mult) - 1)
+
+
+def init_vqgan(cfg: VQGANConfig, key: jax.Array, batch: int = 1):
+    """Initialize params with a dummy batch. Returns (model, params)."""
+    model = VQModel(cfg)
+    img = jnp.zeros((batch, cfg.resolution, cfg.resolution, cfg.in_channels),
+                    jnp.float32)
+    params = model.init({"params": key, "gumbel": key}, img, deterministic=True)
+    return model, params

@@ -2,3 +2,5 @@ from .train_state import TrainState, make_optimizer, make_lr_schedule
 from .checkpoints import CheckpointManager
 from .metrics import ThroughputMeter, device_peak_tflops, count_params, profile_trace
 from .trainer_vae import VAETrainer, anneal_temperature, make_vae_train_step
+from .trainer_vqgan import (VQGANTrainer, GANTrainState, make_vqgan_train_step,
+                            LambdaWarmUpCosineScheduler)
