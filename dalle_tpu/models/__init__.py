@@ -3,3 +3,5 @@ from .vqgan import VQModel, VQGANEncoder, VQGANDecoder, init_vqgan
 from .gan import (GANLossConfig, NLayerDiscriminator, ActNorm, hinge_d_loss,
                   vanilla_d_loss, adopt_weight, adaptive_disc_weight)
 from .lpips import LPIPS, init_lpips
+from .mingpt import GPT, GPTConfig, GPTBlock, init_gpt, make_sampler
+from .cond_transformer import Net2NetTransformer, CoordStage, SOSProvider

@@ -1,0 +1,169 @@
+"""Net2Net conditional transformer — second-stage AR model over VQGAN codes.
+
+Reference: ``Net2NetTransformer`` (taming/models/cond_transformer.py:21-343):
+first-stage VQGAN codes conditioned on cond-stage codes (another VQGAN, a
+``CoordStage``, or an unconditional SOS token), a minGPT transformer over the
+concatenated sequence, ``pkeep`` token corruption during training, top-k AR
+sampling, and a permuter controlling generation order.
+
+TPU design: stages are frozen apply-fns over their own param trees (the
+functional analogue of the reference's ``.eval()`` + ``disabled_train``
+freezing, :54-78); the train forward is fully jittable (bernoulli corruption
+from an explicit key); sampling reuses the scan-based cached sampler in
+``mingpt.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.permuter import Permuter, identity
+from .mingpt import GPT, GPTConfig, make_sampler
+
+
+class CoordStage:
+    """Fake-vq coordinate conditioning stage (taming/modules/misc/coord.py:3-31):
+    area-downsample a [0,1] coord map, quantize into n_embed integer bins.
+    NHWC with a single channel."""
+
+    def __init__(self, n_embed: int, down_factor: int):
+        self.n_embed = n_embed
+        self.down_factor = down_factor
+
+    def encode(self, c: jnp.ndarray):
+        assert c.ndim == 4 and c.shape[-1] == 1
+        b, h, w, _ = c.shape
+        f = self.down_factor
+        # area interpolation == mean pooling for integer factors
+        c = c.reshape(b, h // f, f, w // f, f, 1).mean(axis=(2, 4))
+        c = jnp.clip(c, 0.0, 1.0) * self.n_embed
+        # the reference rounds to [0, n_embed] INCLUSIVE (coord.py:21-23) —
+        # n_embed+1 bins, with the top bin OOB for an n_embed vocab; clamp it
+        c_quant = jnp.minimum(jnp.round(c), self.n_embed - 1)
+        c_ind = c_quant.astype(jnp.int32).reshape(b, -1)
+        return c_quant, c_ind
+
+    def decode(self, c_quant: jnp.ndarray):
+        c = c_quant / self.n_embed
+        b, h, w, ch = c.shape
+        f = self.down_factor
+        return jax.image.resize(c, (b, h * f, w * f, ch), method="nearest")
+
+
+class SOSProvider:
+    """Unconditional stand-in: a constant start-of-sequence token
+    (cond_transformer.py SOSProvider + :68-74)."""
+
+    def __init__(self, sos_token: int):
+        self.sos_token = sos_token
+
+    def encode(self, c):
+        b = c.shape[0]
+        ids = jnp.full((b, 1), self.sos_token, jnp.int32)
+        return None, ids
+
+
+class Net2NetTransformer:
+    """Pairs a GPT with frozen first/cond stages.
+
+    ``first_stage_encode(x) -> (b, n) int32`` and
+    ``first_stage_decode(ids) -> images`` are closures over the frozen VQGAN
+    params (see ``from_vqgan``); ``cond_encode(c) -> (b, m) int32`` likewise.
+    """
+
+    def __init__(self, gpt: GPT, first_stage_encode: Callable,
+                 first_stage_decode: Callable, cond_encode: Callable,
+                 permuter: Optional[Permuter] = None, pkeep: float = 1.0,
+                 first_stage_vocab: Optional[int] = None):
+        self.gpt = gpt
+        self.first_stage_encode = first_stage_encode
+        self.first_stage_decode = first_stage_decode
+        self.cond_encode = cond_encode
+        self.permuter = permuter
+        self.pkeep = pkeep
+        # ids ≥ this are cond-stage vocabulary: never sampled into z positions
+        self.first_stage_vocab = first_stage_vocab
+        self._samplers = {}   # (steps, top_k, temperature) → jitted sampler
+
+    @classmethod
+    def from_vqgan(cls, gpt_cfg: GPTConfig, vq_model, vq_params, *,
+                   cond_encode: Callable, permuter: Optional[Permuter] = None,
+                   pkeep: float = 1.0, key: Optional[jax.Array] = None):
+        from .vqgan import VQModel
+        gpt = GPT(gpt_cfg)
+
+        def fs_encode(x):
+            return vq_model.apply(vq_params, x,
+                                  method=VQModel.get_codebook_indices)
+
+        def fs_decode(ids):
+            return vq_model.apply(vq_params, ids, method=VQModel.decode_code)
+
+        return cls(gpt, fs_encode, fs_decode, cond_encode, permuter, pkeep,
+                   first_stage_vocab=vq_model.cfg.n_embed)
+
+    # -- token plumbing ----------------------------------------------------
+    def encode_to_z(self, x) -> jnp.ndarray:
+        ids = jax.lax.stop_gradient(self.first_stage_encode(x))
+        if self.permuter is not None:
+            ids = self.permuter(ids)
+        return ids
+
+    def encode_to_c(self, c) -> jnp.ndarray:
+        out = self.cond_encode(c)
+        ids = out[-1] if isinstance(out, tuple) else out
+        return jax.lax.stop_gradient(ids.reshape(ids.shape[0], -1))
+
+    def decode_to_img(self, ids) -> jnp.ndarray:
+        if self.permuter is not None:
+            ids = self.permuter(ids, reverse=True)
+        return self.first_stage_decode(ids)
+
+    # -- training forward (cond_transformer.py:80-105) ---------------------
+    def forward(self, gpt_params, x, c, *, key: Optional[jax.Array] = None,
+                train: bool = True):
+        """Returns (logits over z positions, target z indices)."""
+        z_indices = self.encode_to_z(x)
+        c_indices = self.encode_to_c(c)
+        a_indices = z_indices
+        if train and self.pkeep < 1.0:
+            assert key is not None, "pkeep corruption needs an rng key"
+            kmask, krand = jax.random.split(key)
+            mask = jax.random.bernoulli(kmask, self.pkeep, z_indices.shape)
+            rand = jax.random.randint(krand, z_indices.shape, 0,
+                                      self.gpt.cfg.vocab_size, jnp.int32)
+            a_indices = jnp.where(mask, z_indices, rand)
+        cz = jnp.concatenate([c_indices, a_indices], axis=1)
+        logits = self.gpt.apply(gpt_params, cz[:, :-1], deterministic=not train)
+        # output i predicts p(z_i | z_<i, c): drop the cond positions
+        logits = logits[:, c_indices.shape[1] - 1:]
+        return logits, z_indices
+
+    def loss(self, gpt_params, x, c, *, key: Optional[jax.Array] = None,
+             train: bool = True):
+        logits, target = self.forward(gpt_params, x, c, key=key, train=train)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, target[..., None], axis=-1)
+        return jnp.mean(nll)
+
+    # -- sampling (cond_transformer.py:107-166, scan-based) ----------------
+    def sample(self, gpt_params, c_images, steps: int, key: jax.Array, *,
+               temperature: float = 1.0, top_k: Optional[int] = None,
+               z_prime: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        """Generate ``steps`` z tokens conditioned on ``c_images``; returns
+        decoded images. ``z_prime`` optionally primes the image prefix."""
+        c_indices = self.encode_to_c(c_images)
+        prompt = c_indices
+        if z_prime is not None:
+            prompt = jnp.concatenate([c_indices, z_prime], axis=1)
+        skey = (steps, top_k, temperature)
+        if skey not in self._samplers:
+            self._samplers[skey] = make_sampler(
+                self.gpt, steps, top_k=top_k, temperature=temperature,
+                vocab_limit=self.first_stage_vocab)
+        out = self._samplers[skey](gpt_params, prompt, key)
+        z_ids = out[:, c_indices.shape[1]:]
+        return self.decode_to_img(z_ids)

@@ -1,0 +1,219 @@
+"""minGPT-style decoder-only transformer — the taming second-stage AR model.
+
+Reference: taming/modules/transformer/mingpt.py — ``GPT`` (:125-212: token +
+learned position embeddings, pre-LN blocks with GELU MLPs, unbiased head),
+``CausalSelfAttention`` with an ``n_unmasked`` always-visible prefix (:42-95),
+and the sampling utilities ``sample``/``sample_with_past`` (:292-351).
+
+TPU redesign: the cached sampling loop is a ``lax.scan`` over a preallocated
+``KVCache`` pytree (ops/attention.py) — one compiled program for the whole
+generation instead of the reference's per-step Python loop with growing
+``layer_past`` concats. The n_unmasked prefix is folded into the static mask.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import ConfigBase
+from ..ops.attention import KVCache, attend, cached_attend
+from ..ops.sampling import gumbel_sample
+
+
+@dataclass(frozen=True)
+class GPTConfig(ConfigBase):
+    """mingpt.py GPTConfig/GPT1Config (:21-39) as a typed config."""
+    vocab_size: int = 512
+    block_size: int = 512
+    n_layer: int = 12
+    n_head: int = 8
+    n_embd: int = 256
+    embd_pdrop: float = 0.0
+    resid_pdrop: float = 0.0
+    attn_pdrop: float = 0.0
+    n_unmasked: int = 0
+
+
+def _prefix_causal_mask(n: int, n_unmasked: int) -> np.ndarray:
+    """Lower-triangular mask with the first ``n_unmasked`` key columns fully
+    visible (mingpt.py:57-61)."""
+    mask = np.tril(np.ones((n, n), bool))
+    if n_unmasked > 0:
+        mask[:, :n_unmasked] = True
+    return mask
+
+
+class GPTBlock(nn.Module):
+    """x += attn(ln1(x)); x += mlp(ln2(x)) with a 4× GELU MLP
+    (mingpt.py:98-122)."""
+    cfg: GPTConfig
+
+    def setup(self):
+        c = self.cfg
+        self.ln1 = nn.LayerNorm(name="ln1")
+        self.ln2 = nn.LayerNorm(name="ln2")
+        self.qkv = nn.Dense(3 * c.n_embd, name="qkv")
+        self.attn_out = nn.Dense(c.n_embd, name="attn_out")
+        self.mlp_in = nn.Dense(4 * c.n_embd, name="mlp_in")
+        self.mlp_out = nn.Dense(c.n_embd, name="mlp_out")
+        self.attn_drop = nn.Dropout(c.attn_pdrop)
+        self.resid_drop = nn.Dropout(c.resid_pdrop)
+
+    def _split_heads(self, t):
+        b, n, _ = t.shape
+        return t.reshape(b, n, self.cfg.n_head, -1).transpose(0, 2, 1, 3)
+
+    def __call__(self, x, mask: Optional[jnp.ndarray] = None,
+                 deterministic: bool = True):
+        h = self.ln1(x)
+        q, k, v = jnp.split(self.qkv(h), 3, axis=-1)
+        q, k, v = map(self._split_heads, (q, k, v))
+        out = attend(q, k, v, causal=mask is None, static_mask=mask)
+        b, nh, n, hd = out.shape
+        out = out.transpose(0, 2, 1, 3).reshape(b, n, nh * hd)
+        x = x + self.resid_drop(self.attn_out(out), deterministic=deterministic)
+        h = self.ln2(x)
+        h = self.mlp_out(jax.nn.gelu(self.mlp_in(h)))
+        return x + self.resid_drop(h, deterministic=deterministic)
+
+    def decode_step(self, x, cache: KVCache, length) -> Tuple[jnp.ndarray, KVCache]:
+        """Single-token cached step: x (b, 1, d)."""
+        h = self.ln1(x)
+        q, k, v = jnp.split(self.qkv(h), 3, axis=-1)
+        q, k, v = map(self._split_heads, (q, k, v))
+        cache = cache.append(k, v, length - 1)
+        out = cached_attend(q, cache, length)
+        b, nh, n, hd = out.shape
+        out = out.transpose(0, 2, 1, 3).reshape(b, n, nh * hd)
+        x = x + self.attn_out(out)
+        h = self.ln2(x)
+        return x + self.mlp_out(jax.nn.gelu(self.mlp_in(h))), cache
+
+
+class GPT(nn.Module):
+    """Token + learned positional embeddings → blocks → LayerNorm → unbiased
+    vocab head (mingpt.py:125-181). ``embeddings`` are optional pre-computed
+    vectors prepended to the token embeddings (:156-160)."""
+    cfg: GPTConfig
+
+    def setup(self):
+        c = self.cfg
+        self.tok_emb = nn.Embed(c.vocab_size, c.n_embd, name="tok_emb")
+        self.pos_emb = self.param(
+            "pos_emb", nn.initializers.normal(0.02), (1, c.block_size, c.n_embd))
+        self.drop = nn.Dropout(c.embd_pdrop)
+        self.blocks = [GPTBlock(c, name=f"block_{i}") for i in range(c.n_layer)]
+        self.ln_f = nn.LayerNorm(name="ln_f")
+        self.head = nn.Dense(c.vocab_size, use_bias=False, name="head")
+
+    def _mask(self, n: int):
+        return jnp.asarray(_prefix_causal_mask(self.cfg.block_size,
+                                               self.cfg.n_unmasked))[:n, :n]
+
+    def __call__(self, idx, embeddings: Optional[jnp.ndarray] = None,
+                 deterministic: bool = True):
+        x = self.tok_emb(idx)
+        if embeddings is not None:
+            x = jnp.concatenate([embeddings, x], axis=1)
+        n = x.shape[1]
+        assert n <= self.cfg.block_size, "sequence longer than block_size"
+        x = self.drop(x + self.pos_emb[:, :n], deterministic=deterministic)
+        mask = self._mask(n)
+        for blk in self.blocks:
+            x = blk(x, mask=mask, deterministic=deterministic)
+        return self.head(self.ln_f(x))
+
+    # -- cached decode (sample_with_past equivalent, mingpt.py:318-351) -----
+    def init_cache(self, batch: int) -> Tuple[KVCache, ...]:
+        c = self.cfg
+        return tuple(KVCache.init(batch, c.n_head, c.block_size,
+                                  c.n_embd // c.n_head) for _ in range(c.n_layer))
+
+    def decode_one(self, token, pos, cache):
+        """token: (b, 1) int32; pos: scalar position of this token.
+        Returns (logits (b, vocab), new cache)."""
+        x = self.tok_emb(token)
+        x = x + jax.lax.dynamic_slice_in_dim(self.pos_emb, pos, 1, axis=1)
+        new_cache = []
+        for blk, c in zip(self.blocks, cache):
+            x, c = blk.decode_step(x, c, pos + 1)
+            new_cache.append(c)
+        return self.head(self.ln_f(x))[:, 0], tuple(new_cache)
+
+    def prefill(self, idx, cache):
+        """Run the prompt through the cache one layer at a time (full-sequence
+        matmuls, not a scan): returns (logits of last position, cache, length)."""
+        x = self.tok_emb(idx)
+        n = x.shape[1]
+        x = x + self.pos_emb[:, :n]
+        mask = self._mask(n)
+        new_cache = []
+        for blk, c in zip(self.blocks, cache):
+            h = blk.ln1(x)
+            q, k, v = jnp.split(blk.qkv(h), 3, axis=-1)
+            q, k, v = map(blk._split_heads, (q, k, v))
+            c = c.append(k, v, 0)
+            out = attend(q, k, v, causal=False, static_mask=mask)
+            b, nh, nn_, hd = out.shape
+            out = out.transpose(0, 2, 1, 3).reshape(b, nn_, nh * hd)
+            x = x + blk.attn_out(out)
+            h2 = blk.ln2(x)
+            x = x + blk.mlp_out(jax.nn.gelu(blk.mlp_in(h2)))
+            new_cache.append(c)
+        return self.head(self.ln_f(x))[:, -1], tuple(new_cache), n
+
+
+def init_gpt(cfg: GPTConfig, key: jax.Array, batch: int = 1):
+    model = GPT(cfg)
+    idx = jnp.zeros((batch, min(4, cfg.block_size)), jnp.int32)
+    params = model.init({"params": key}, idx)
+    return model, params
+
+
+def make_sampler(model: GPT, steps: int, *, top_k: Optional[int] = None,
+                 temperature: float = 1.0, vocab_limit: Optional[int] = None):
+    """jit-once AR sampler: (params, prompt (b, n), key) → (b, n+steps).
+    The whole loop is one ``lax.scan`` over the preallocated cache — the
+    TPU-idiomatic ``sample_with_past`` (mingpt.py:318-351). ``vocab_limit``
+    masks ids ≥ limit so a GPT whose vocab also covers cond tokens can never
+    emit them into generated positions."""
+
+    @jax.jit
+    def sample(params, prompt, key):
+        batch, n_prompt = prompt.shape
+        assert n_prompt + steps <= model.cfg.block_size, (
+            f"prompt {n_prompt} + steps {steps} exceeds block_size "
+            f"{model.cfg.block_size}")
+        cache = model.init_cache(batch)
+        logits, cache, n0 = model.apply(params, prompt, cache,
+                                        method=GPT.prefill)
+
+        def pick(logits, k):
+            if vocab_limit is not None:
+                logits = jnp.where(jnp.arange(logits.shape[-1]) < vocab_limit,
+                                   logits, -jnp.inf)
+            if top_k is not None:
+                kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+                logits = jnp.where(logits < kth, -jnp.inf, logits)
+            return gumbel_sample(k, logits, temperature=temperature)
+
+        def body(carry, i):
+            logits, cache, key = carry
+            key, sub = jax.random.split(key)
+            tok = pick(logits, sub).astype(jnp.int32)
+            next_logits, cache = model.apply(params, tok[:, None], n0 + i,
+                                             cache, method=GPT.decode_one)
+            return (next_logits, cache, key), tok
+
+        (_, _, _), toks = jax.lax.scan(body, (logits, cache, key),
+                                       jnp.arange(steps))
+        return jnp.concatenate([prompt, toks.T], axis=1)
+
+    return sample

@@ -223,6 +223,9 @@ class VQModel(nn.Module):
     def decode_code(self, ids):
         b, n = ids.shape
         hw = int(n ** 0.5)
+        # a second-stage sampler's vocab may exceed n_embed (taming GPT vocab
+        # covers cond codes too); clamp instead of XLA's undefined OOB gather
+        ids = jnp.clip(ids, 0, self.cfg.n_embed - 1)
         quant = self.codebook(ids).reshape(b, hw, hw, self.cfg.embed_dim)
         return self.decode(quant)
 

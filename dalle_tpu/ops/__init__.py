@@ -3,3 +3,4 @@ from .quantize import gumbel_softmax, vector_quantize, gumbel_quantize, kl_to_un
 from .rotary import apply_rotary, dalle_pos_emb, rotate_half
 from .attention import attend, cached_attend, stable_softmax, KVCache
 from .attn_masks import build_mask, causal_mask, axial_mask, conv_like_mask, block_sparse_mask
+from .permuter import Permuter, PERMUTERS, make_permuter

@@ -4,6 +4,9 @@ from __future__ import annotations
 
 import math
 
+import jax
+import jax.numpy as jnp
+
 
 def exists(x) -> bool:
     return x is not None
@@ -29,3 +32,31 @@ def log2_int(n: int) -> int:
     l = int(math.log2(n))
     assert 2 ** l == n, f"{n} is not a power of 2"
     return l
+
+
+def kmeans(x, k: int, iters: int = 10, seed: int = 0):
+    """Plain k-means over (n, d) points — the pixel-clustering utility the
+    reference ships for conditional image GPTs (taming mingpt.py:356-415
+    ``KMeans``). Returns (centroids (k, d), assignments (n,)).
+
+    Pure jnp: the assignment step is one (n, k) matmul-shaped distance —
+    MXU-friendly at image-pixel scale."""
+    x = jnp.asarray(x)
+    n = x.shape[0]
+    key = jax.random.PRNGKey(seed)
+    centroids = x[jax.random.choice(key, n, (k,), replace=False)]
+
+    def dists(c):
+        return (jnp.sum(x ** 2, -1, keepdims=True) - 2 * x @ c.T
+                + jnp.sum(c ** 2, -1)[None, :])
+
+    def step(c, _):
+        assign = jnp.argmin(dists(c), axis=-1)
+        one_hot = jax.nn.one_hot(assign, k, dtype=x.dtype)
+        counts = one_hot.sum(0)
+        sums = one_hot.T @ x
+        new_c = jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1), c)
+        return new_c, None
+
+    centroids, _ = jax.lax.scan(step, centroids, None, length=iters)
+    return centroids, jnp.argmin(dists(centroids), axis=-1)

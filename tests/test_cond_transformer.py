@@ -1,0 +1,185 @@
+"""Permuter round-trips, minGPT forward/cached-sample equivalence, and the
+Net2Net conditional transformer (taming second-stage parity)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_tpu.config import VQGANConfig
+from dalle_tpu.models.cond_transformer import (CoordStage, Net2NetTransformer,
+                                               SOSProvider)
+from dalle_tpu.models.mingpt import GPT, GPTConfig, init_gpt, make_sampler
+from dalle_tpu.models.vqgan import init_vqgan
+from dalle_tpu.ops.permuter import PERMUTERS, make_permuter
+from dalle_tpu.utils.misc import kmeans
+
+
+class TestPermuters:
+    @pytest.mark.parametrize("kind", sorted(PERMUTERS))
+    def test_round_trip(self, kind):
+        # the reference's own self-test: p(p(x), reverse=True) == x
+        # (taming permuter.py:236-248)
+        p = make_permuter(kind, 8, 8)
+        x = np.arange(2 * 64).reshape(2, 64)
+        assert np.array_equal(p(p(x), reverse=True), x)
+        assert np.array_equal(p(p(x, reverse=True)), x)
+
+    def test_zcurve_visits_quadrants_hierarchically(self):
+        p = make_permuter("zcurve", 4, 4)
+        # first 4 tokens of a 4×4 z-curve are the top-left 2×2 block
+        first4 = set(p.idx[:4].tolist())
+        assert first4 == {0, 1, 4, 5}
+
+    def test_alternate_parsing_boustrophedon(self):
+        p = make_permuter("alternate_parsing", 2, 3)
+        assert p.idx.tolist() == [0, 1, 2, 5, 4, 3]
+
+    def test_embedding_axis_permute(self):
+        p = make_permuter("random", 4, 4)
+        x = np.random.RandomState(0).rand(2, 16, 8)
+        assert np.allclose(p(p(x, axis=-2), reverse=True, axis=-2), x)
+
+
+GPT_SMALL = GPTConfig(vocab_size=64, block_size=32, n_layer=2, n_head=2,
+                      n_embd=32)
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    return init_gpt(GPT_SMALL, jax.random.PRNGKey(0), batch=2)
+
+
+class TestMinGPT:
+    def test_forward_shape(self, gpt):
+        model, params = gpt
+        idx = jnp.zeros((2, 8), jnp.int32)
+        logits = model.apply(params, idx)
+        assert logits.shape == (2, 8, 64)
+
+    def test_causality(self, gpt):
+        model, params = gpt
+        idx = jnp.zeros((1, 8), jnp.int32)
+        base = model.apply(params, idx)
+        # changing a future token must not affect past logits
+        idx2 = idx.at[0, 5].set(3)
+        pert = model.apply(params, idx2)
+        assert jnp.allclose(base[0, :5], pert[0, :5], atol=1e-5)
+        assert not jnp.allclose(base[0, 5:], pert[0, 5:], atol=1e-5)
+
+    def test_prepended_embeddings(self, gpt):
+        model, params = gpt
+        idx = jnp.zeros((2, 4), jnp.int32)
+        emb = jnp.ones((2, 3, 32)) * 0.1
+        logits = model.apply(params, idx, embeddings=emb)
+        assert logits.shape == (2, 7, 64)
+
+    def test_n_unmasked_prefix_sees_future(self):
+        cfg = GPT_SMALL.replace(n_unmasked=4)
+        model, params = init_gpt(cfg, jax.random.PRNGKey(1), batch=1)
+        idx = jnp.zeros((1, 8), jnp.int32)
+        base = model.apply(params, idx)
+        # a change inside the unmasked prefix affects ALL positions
+        pert = model.apply(params, idx.at[0, 2].set(7))
+        assert not jnp.allclose(base[0, 0], pert[0, 0], atol=1e-6)
+
+    def test_cached_decode_matches_full_forward(self, gpt):
+        model, params = gpt
+        idx = jnp.array([[1, 2, 3, 4, 5, 6]], jnp.int32)
+        full = model.apply(params, idx)
+        cache = model.init_cache(1)
+        logits, cache, n0 = model.apply(params, idx[:, :3], cache,
+                                        method=GPT.prefill)
+        assert jnp.allclose(logits, full[0, 2], atol=1e-4)
+        for t in range(3, 6):
+            logits, cache = model.apply(params, idx[:, t:t + 1], t, cache,
+                                        method=GPT.decode_one)
+            assert jnp.allclose(logits[0], full[0, t], atol=1e-4), f"pos {t}"
+
+    def test_sampler_runs_and_respects_prompt(self, gpt):
+        model, params = gpt
+        sampler = make_sampler(model, steps=5, top_k=8)
+        prompt = jnp.array([[3, 1, 4]], jnp.int32)
+        out = sampler(params, prompt, jax.random.PRNGKey(0))
+        assert out.shape == (1, 8)
+        assert jnp.array_equal(out[:, :3], prompt)
+        assert ((out >= 0) & (out < 64)).all()
+
+
+class TestCoordStage:
+    def test_encode_decode(self):
+        cs = CoordStage(n_embed=16, down_factor=2)
+        c = jnp.linspace(0, 1, 1 * 8 * 8).reshape(1, 8, 8, 1)
+        quant, ids = cs.encode(c)
+        assert quant.shape == (1, 4, 4, 1)
+        assert ids.shape == (1, 16)
+        assert ids.max() <= 15  # clamped to n_embed-1 bins
+        dec = cs.decode(quant)
+        assert dec.shape == (1, 8, 8, 1)
+
+    def test_sos_provider(self):
+        sp = SOSProvider(sos_token=5)
+        _, ids = sp.encode(jnp.zeros((3, 4, 4, 1)))
+        assert ids.shape == (3, 1) and (ids == 5).all()
+
+
+VQ_TINY = VQGANConfig(embed_dim=8, n_embed=32, z_channels=8, resolution=16,
+                      ch=8, ch_mult=(1, 2), num_res_blocks=1,
+                      attn_resolutions=(8,))
+
+
+class TestNet2Net:
+    @pytest.fixture(scope="class")
+    def n2n(self):
+        vq_model, vq_params = init_vqgan(VQ_TINY, jax.random.PRNGKey(0))
+        # 8×8 latents = 64 z tokens; cond = coord stage on 16px maps → 64 tokens
+        cs = CoordStage(n_embed=15, down_factor=2)
+        gpt_cfg = GPTConfig(vocab_size=48, block_size=192, n_layer=2, n_head=2,
+                            n_embd=32)
+        n2n = Net2NetTransformer.from_vqgan(
+            gpt_cfg, vq_model, vq_params, cond_encode=cs.encode,
+            permuter=make_permuter("zcurve", 8, 8), pkeep=0.9)
+        gpt_params = n2n.gpt.init(jax.random.PRNGKey(1),
+                                  jnp.zeros((1, 4), jnp.int32))
+        return n2n, gpt_params
+
+    def test_forward_shapes_and_targets(self, n2n):
+        model, gpt_params = n2n
+        x = jnp.ones((2, 16, 16, 3)) * 0.1
+        c = jnp.linspace(0, 1, 2 * 16 * 16).reshape(2, 16, 16, 1)
+        logits, target = model.forward(gpt_params, x, c,
+                                       key=jax.random.PRNGKey(2), train=True)
+        assert target.shape == (2, 64)          # 8×8 first-stage codes
+        assert logits.shape == (2, 64, 48)      # one prediction per z position
+        loss = model.loss(gpt_params, x, c, key=jax.random.PRNGKey(3))
+        assert jnp.isfinite(loss)
+
+    def test_pkeep_zero_randomizes_inputs_not_targets(self, n2n):
+        model, gpt_params = n2n
+        model.pkeep = 0.0
+        x = jnp.ones((1, 16, 16, 3)) * 0.1
+        c = jnp.zeros((1, 16, 16, 1))
+        _, t1 = model.forward(gpt_params, x, c, key=jax.random.PRNGKey(1))
+        _, t2 = model.forward(gpt_params, x, c, key=jax.random.PRNGKey(2))
+        model.pkeep = 0.9
+        assert jnp.array_equal(t1, t2), "targets are the true codes, unmasked"
+
+    def test_sample_decodes_images(self, n2n):
+        model, gpt_params = n2n
+        c = jnp.linspace(0, 1, 1 * 16 * 16).reshape(1, 16, 16, 1)
+        imgs = model.sample(gpt_params, c, steps=64, key=jax.random.PRNGKey(0),
+                            top_k=8)
+        assert imgs.shape == (1, 16, 16, 3)
+        assert bool(jnp.isfinite(imgs).all())
+
+
+def test_kmeans_clusters():
+    rng = np.random.RandomState(0)
+    a = rng.randn(50, 3) + np.array([5, 0, 0])
+    b = rng.randn(50, 3) + np.array([-5, 0, 0])
+    pts = np.concatenate([a, b])
+    cents, assign = kmeans(pts, 2, iters=10)
+    assert cents.shape == (2, 3)
+    # the two blobs separate
+    assert len(set(np.asarray(assign[:50]).tolist())) == 1
+    assert assign[0] != assign[50]
