@@ -5,3 +5,6 @@ from .gan import (GANLossConfig, NLayerDiscriminator, ActNorm, hinge_d_loss,
 from .lpips import LPIPS, init_lpips
 from .mingpt import GPT, GPTConfig, GPTBlock, init_gpt, make_sampler
 from .cond_transformer import Net2NetTransformer, CoordStage, SOSProvider
+from .pretrained import (OpenAIDiscreteVAE, VQGanVAE, OpenAIEncoder,
+                         OpenAIDecoder, map_pixels, unmap_pixels, download,
+                         convert_vqgan_state, vqgan_config_from_yaml)
