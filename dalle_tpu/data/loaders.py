@@ -1,0 +1,165 @@
+"""Fork-style loaders: image folders, filename labels, word-level Token vocab.
+
+Reference: the fork's simplified data path — ``load_dataset`` (ImageFolder +
+Resize/CenterCrop/ToTensor, loader.py:14-22), ``load_labels`` (labels from
+filename stems split on ``_``, loader.py:53-75), and ``Token`` (ad-hoc
+word-level vocabulary with 0 as pad, dalle.py:15-49) — plus taming's
+``ImagePaths`` file-list dataset (taming/data/base.py:23-70: resize shorter
+side, center crop, [−1,1] floats).
+
+All host-side numpy; images come out NHWC float32.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
+
+
+def _load_image(path, image_size: int, *, center_crop: bool = True,
+                to_unit_interval: bool = True) -> np.ndarray:
+    """RGB convert → resize shorter side → center crop → float32 HWC."""
+    from PIL import Image
+    img = Image.open(path)
+    if img.mode != "RGB":
+        img = img.convert("RGB")
+    w, h = img.size
+    if center_crop:
+        scale = image_size / min(w, h)
+        img = img.resize((max(image_size, round(w * scale)),
+                          max(image_size, round(h * scale))), Image.BILINEAR)
+        w, h = img.size
+        left = (w - image_size) // 2
+        top = (h - image_size) // 2
+        img = img.crop((left, top, left + image_size, top + image_size))
+    else:
+        img = img.resize((image_size, image_size), Image.BILINEAR)
+    arr = np.asarray(img, np.float32) / 255.0
+    if not to_unit_interval:
+        arr = arr * 2.0 - 1.0
+    return arr
+
+
+class ImageFolderDataset:
+    """torchvision-ImageFolder equivalent (reference loader.py:14-22):
+    ``root/class_x/img.png`` → (image [0,1] HWC, class index). A flat folder
+    gets a single class."""
+
+    def __init__(self, root: str, image_size: int = 128):
+        self.image_size = image_size
+        root_p = Path(root)
+        classes = sorted(d.name for d in root_p.iterdir() if d.is_dir())
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples: List[Tuple[Path, int]] = []
+        if classes:
+            for c in classes:
+                for p in sorted((root_p / c).rglob("*")):
+                    if p.suffix.lower() in IMAGE_EXTS:
+                        self.samples.append((p, self.class_to_idx[c]))
+        else:
+            self.samples = [(p, 0) for p in sorted(root_p.iterdir())
+                            if p.suffix.lower() in IMAGE_EXTS]
+        if not self.samples:
+            raise ValueError(f"no images under {root}")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i: int):
+        path, cls = self.samples[i]
+        return _load_image(path, self.image_size), cls
+
+
+def load_labels(source, sep: str = "_") -> List[List[str]]:
+    """Word labels from filename stems split on ``sep`` (reference
+    loader.py:53-75): works on an ImageFolderDataset or a directory path."""
+    if isinstance(source, ImageFolderDataset):
+        stems = [p.stem for p, _ in source.samples]
+    else:
+        stems = []
+        for dirpath, _dirs, files in os.walk(str(source)):
+            for f in sorted(files):
+                p = Path(dirpath) / f
+                if p.suffix.lower() in IMAGE_EXTS:
+                    stems.append(p.stem)
+    return [s.split(sep) for s in stems]
+
+
+class Token:
+    """Word-level vocabulary over caption word-lists; id 0 is pad (reference
+    dalle.py:15-49). ``parse()`` → padded int array; ``caption_mask()`` → the
+    ``!= 0`` mask the reference feeds as attention key mask."""
+
+    def __init__(self, labels: Sequence[Sequence[str]]):
+        self._org = [list(l) for l in labels]
+        words = sorted({w for cap in self._org for w in cap})
+        self.pairs = {w: i for i, w in enumerate(words, start=1)}
+
+    @property
+    def num_pairs(self) -> int:
+        """Vocab size including pad (reference dalle.py:29-31)."""
+        return len(self.pairs) + 1
+
+    @property
+    def sequence_len(self) -> int:
+        return max(len(cap) for cap in self._org)
+
+    def parse(self, captions: Optional[Sequence[Sequence[str]]] = None,
+              seq_len: Optional[int] = None) -> np.ndarray:
+        """(n, seq_len) int32, 0-padded. Unlike the reference (which only
+        parses its construction corpus), arbitrary captions may be parsed;
+        unknown words raise."""
+        caps = self._org if captions is None else [list(c) for c in captions]
+        n = seq_len or self.sequence_len
+        out = np.zeros((len(caps), n), np.int32)
+        for i, cap in enumerate(caps):
+            ids = [self.pairs[w] for w in cap]
+            out[i, :len(ids)] = ids[:n]
+        return out
+
+    def caption_mask(self, captions=None, seq_len: Optional[int] = None
+                     ) -> np.ndarray:
+        return self.parse(captions, seq_len) != 0
+
+    def decode(self, ids: Iterable[int]) -> List[str]:
+        rev = {v: k for k, v in self.pairs.items()}
+        return [rev[int(i)] for i in ids if int(i) != 0]
+
+
+class ImagePaths:
+    """taming's file-list dataset (taming/data/base.py:23-70): explicit path
+    list → resized/center-cropped [−1,1] float images, with optional labels."""
+
+    def __init__(self, paths: Sequence[str], size: int = 256,
+                 labels: Optional[dict] = None):
+        self.paths = list(paths)
+        self.size = size
+        self.labels = labels or {}
+
+    def __len__(self):
+        return len(self.paths)
+
+    def __getitem__(self, i: int):
+        out = {"image": _load_image(self.paths[i], self.size,
+                                    to_unit_interval=False)}
+        for k, v in self.labels.items():
+            out[k] = v[i]
+        return out
+
+
+def batch_arrays(dataset, indices: Sequence[int]):
+    """Stack dataset[i] tuples/dicts into batched numpy arrays."""
+    items = [dataset[i] for i in indices]
+    first = items[0]
+    if isinstance(first, dict):
+        return {k: np.stack([it[k] for it in items])
+                if isinstance(first[k], np.ndarray) else [it[k] for it in items]
+                for k in first}
+    cols = list(zip(*items))
+    return tuple(np.stack(c) if isinstance(c[0], np.ndarray) else np.asarray(c)
+                 for c in cols)

@@ -1,0 +1,338 @@
+"""Native tar-shard streaming pipeline (WebDataset-equivalent, no wds dep).
+
+Reference: the WebDataset path in legacy/train_dalle.py:212-227 (directory
+glob / http ``pipe:curl`` / GCS ``pipe:gsutil`` shard sources) and :365-423
+(map / filter / ``warn_and_continue`` / batched by world-size / WebLoader with
+nominal-length slicing).
+
+TPU redesign: shards are split **per host** by ``jax.process_index`` (the SPMD
+analogue of wds' per-rank splitting), decoded on host threads, and prefetched
+into a bounded queue so the accelerator never waits on PIL/tar IO — the input
+side of the "feed a pod" requirement (SURVEY.md §7 hard parts). Everything is
+plain Python/numpy: tarfile streaming reads sequentially (no index pass), so
+shards can be pipes.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import io
+import itertools
+import json
+import queue
+import random
+import subprocess
+import tarfile
+import threading
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+IMAGE_EXTS = ("jpg", "jpeg", "png", "bmp", "webp")
+
+
+def expand_shards(urls) -> List[str]:
+    """Shard-list sources (reference train_dalle.py:212-227): a list, a
+    brace-range pattern ``shard-{000..009}.tar``, a glob, a directory, or a
+    ``pipe:`` command. Returns concrete shard URLs in order."""
+    if isinstance(urls, (list, tuple)):
+        out: List[str] = []
+        for u in urls:
+            out.extend(expand_shards(u))
+        return out
+    url = str(urls)
+    if url.startswith("pipe:"):
+        return [url]
+    if "{" in url and ".." in url:
+        head, rest = url.split("{", 1)
+        rng, tail = rest.split("}", 1)
+        lo, hi = rng.split("..")
+        width = len(lo)
+        return [f"{head}{i:0{width}d}{tail}" for i in range(int(lo), int(hi) + 1)]
+    import os
+    if os.path.isdir(url):
+        return sorted(_glob.glob(os.path.join(url, "*.tar")))
+    if any(ch in url for ch in "*?["):
+        return sorted(_glob.glob(url))
+    return [url]
+
+
+def split_shards_per_host(shards: Sequence[str],
+                          process_index: Optional[int] = None,
+                          process_count: Optional[int] = None) -> List[str]:
+    """Round-robin shard assignment per host — each host streams a disjoint
+    subset (the wds ``split_by_node`` equivalent for multi-host TPU)."""
+    import jax
+    pi = jax.process_index() if process_index is None else process_index
+    pc = jax.process_count() if process_count is None else process_count
+    return [s for i, s in enumerate(shards) if i % pc == pi]
+
+
+def _open_shard(url: str):
+    """Local path → file; ``pipe:CMD`` → the command's stdout (reference
+    train_dalle.py:218-224 uses ``pipe:curl``/``pipe:gsutil``)."""
+    if url.startswith("pipe:"):
+        proc = subprocess.Popen(url[5:], shell=True, stdout=subprocess.PIPE)
+        return proc.stdout
+    return open(url, "rb")
+
+
+def iter_tar_samples(url: str, handler: Callable[[Exception], bool]
+                     ) -> Iterator[Dict[str, bytes]]:
+    """Stream one tar shard, grouping members into samples by key (the path up
+    to the first dot, wds convention). Yields ``{"__key__": str, ext: bytes}``."""
+    try:
+        stream = _open_shard(url)
+        tf = tarfile.open(fileobj=stream, mode="r|*")
+    except Exception as e:              # noqa: BLE001 - shard-level skip
+        if handler(e):
+            return
+        raise
+    current: Dict[str, bytes] = {}
+    key = None
+    try:
+        for member in tf:
+            if not member.isfile():
+                continue
+            name = member.name
+            base, _, ext = name.partition(".")
+            if key is not None and base != key:
+                yield current
+                current = {}
+            key = base
+            current["__key__"] = key
+            current[ext.lower()] = tf.extractfile(member).read()
+        if current:
+            yield current
+    except Exception as e:              # noqa: BLE001 - mid-shard corruption
+        if not handler(e):
+            raise
+    finally:
+        tf.close()
+        stream.close()
+
+
+def warn_and_continue(e: Exception) -> bool:
+    """The wds handler the reference uses (train_dalle.py:384)."""
+    import sys
+    print(f"[webdataset] skipping after error: {e!r}", file=sys.stderr)
+    return True
+
+
+def reraise(e: Exception) -> bool:
+    return False
+
+
+def decode_sample(sample: Dict[str, bytes], image_size: Optional[int] = None
+                  ) -> Dict[str, object]:
+    """bytes → python values by extension: images → float32 [0,1] HWC numpy,
+    txt → str, json → object, cls → int."""
+    from PIL import Image
+    out: Dict[str, object] = {}
+    for k, v in sample.items():
+        if k == "__key__":
+            out[k] = v
+        elif k in IMAGE_EXTS:
+            img = Image.open(io.BytesIO(v)).convert("RGB")
+            if image_size is not None:
+                img = img.resize((image_size, image_size), Image.BILINEAR)
+            out[k] = np.asarray(img, np.float32) / 255.0
+        elif k in ("txt", "text", "caption"):
+            out[k] = v.decode("utf-8")
+        elif k == "json":
+            out[k] = json.loads(v)
+        elif k == "cls":
+            out[k] = int(v)
+        else:
+            out[k] = v
+    return out
+
+
+class WebDataset:
+    """Composable shard pipeline: shards → samples → decode → map/filter →
+    shuffle buffer → batches, with per-host shard splitting and a prefetch
+    thread. Mirrors the reference's wds chain (train_dalle.py:365-423)."""
+
+    def __init__(self, urls, *, handler: Callable = warn_and_continue,
+                 shuffle_shards: bool = False, split_by_host: bool = True,
+                 seed: int = 0, repeat: bool = False):
+        self.shards = expand_shards(urls)
+        if split_by_host:
+            try:
+                self.shards = split_shards_per_host(self.shards)
+            except Exception:
+                pass  # jax not initialized yet — single-host
+        self.handler = handler
+        self.shuffle_shards = shuffle_shards
+        self.seed = seed
+        self.repeat = repeat
+        self._ops: List = []
+
+    # -- chainable stages (each returns self) ------------------------------
+    def decode(self, image_size: Optional[int] = None):
+        self._ops.append(("map", lambda s: decode_sample(s, image_size)))
+        return self
+
+    def map(self, fn: Callable):
+        self._ops.append(("map", fn))
+        return self
+
+    def select(self, pred: Callable):
+        self._ops.append(("filter", pred))
+        return self
+
+    def map_dict(self, **fns):
+        def apply(s):
+            for k, fn in fns.items():
+                if k in s:
+                    s[k] = fn(s[k])
+            return s
+        return self.map(apply)
+
+    def to_tuple(self, *keys):
+        self._ops.append(("map", lambda s: tuple(s[k] for k in keys)))
+        return self
+
+    def shuffle(self, buffer_size: int):
+        self._ops.append(("shuffle", buffer_size))
+        return self
+
+    def batched(self, batch_size: int, partial: bool = False):
+        self._ops.append(("batch", (batch_size, partial)))
+        return self
+
+    # -- iteration ---------------------------------------------------------
+    def _raw(self) -> Iterator:
+        if not self.shards:
+            raise ValueError("shard list is empty — check the url/glob "
+                             "(and per-host splitting with few shards)")
+        epoch = 0
+        while True:
+            shards = list(self.shards)
+            if self.shuffle_shards:
+                random.Random(self.seed + epoch).shuffle(shards)
+            for url in shards:
+                yield from iter_tar_samples(url, self.handler)
+            epoch += 1
+            if not self.repeat:
+                return
+
+    def __iter__(self) -> Iterator:
+        it: Iterator = self._raw()
+        rng = random.Random(self.seed)
+        for kind, arg in self._ops:
+            if kind == "map":
+                it = _safe_map(it, arg, self.handler)
+            elif kind == "filter":
+                it = filter(arg, it)   # not a genexp: binds arg now, not lazily
+            elif kind == "shuffle":
+                it = _buffer_shuffle(it, arg, rng)
+            elif kind == "batch":
+                it = _batch(it, *arg)
+        return it
+
+    def prefetch(self, max_queue: int = 8) -> Iterator:
+        """Run the pipeline on a daemon thread; consumer pulls from a bounded
+        queue — decode/IO overlaps device step time."""
+        return _Prefetcher(self, max_queue)
+
+
+def _safe_map(it, fn, handler):
+    for s in it:
+        try:
+            yield fn(s)
+        except Exception as e:          # noqa: BLE001 - sample-level skip
+            if not handler(e):
+                raise
+
+
+def _buffer_shuffle(it, size: int, rng: random.Random):
+    buf: List = []
+    for s in it:
+        buf.append(s)
+        if len(buf) >= size:
+            i = rng.randrange(len(buf))
+            buf[i], buf[-1] = buf[-1], buf[i]
+            yield buf.pop()
+    rng.shuffle(buf)
+    yield from buf
+
+
+def _collate(batch: List):
+    if isinstance(batch[0], tuple):
+        return tuple(_collate([b[i] for b in batch])
+                     for i in range(len(batch[0])))
+    if isinstance(batch[0], np.ndarray):
+        return np.stack(batch)
+    if isinstance(batch[0], (int, float)):
+        return np.asarray(batch)
+    return batch
+
+
+def _batch(it, batch_size: int, partial: bool):
+    buf: List = []
+    for s in it:
+        buf.append(s)
+        if len(buf) == batch_size:
+            yield _collate(buf)
+            buf = []
+    if buf and partial:
+        yield _collate(buf)
+
+
+class _Prefetcher:
+    _DONE = object()
+
+    def __init__(self, ds: Iterable, max_queue: int):
+        self.q: "queue.Queue" = queue.Queue(maxsize=max_queue)
+        self.error: Optional[BaseException] = None
+
+        def run():
+            try:
+                for item in ds:
+                    self.q.put(item)
+            except BaseException as e:  # noqa: BLE001 - surfaced to consumer
+                self.error = e
+            finally:
+                self.q.put(self._DONE)
+
+        self.thread = threading.Thread(target=run, daemon=True)
+        self.thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self.q.get()
+        if item is self._DONE:
+            if self.error is not None:
+                raise self.error
+            raise StopIteration
+        return item
+
+
+def write_shards(samples: Iterable[Dict[str, bytes]], pattern: str,
+                 samples_per_shard: int = 1000) -> List[str]:
+    """Pack ``{"__key__", ext: bytes}`` samples into tar shards — the test/
+    tooling counterpart of the reader (the reference relies on external
+    tarp/wds tooling)."""
+    paths: List[str] = []
+    it = iter(samples)
+    for shard_idx in itertools.count():
+        chunk = list(itertools.islice(it, samples_per_shard))
+        if not chunk:
+            break
+        path = pattern.format(shard_idx)
+        with tarfile.open(path, "w") as tf:
+            for s in chunk:
+                key = s["__key__"]
+                for ext, data in s.items():
+                    if ext == "__key__":
+                        continue
+                    if isinstance(data, str):
+                        data = data.encode("utf-8")
+                    info = tarfile.TarInfo(f"{key}.{ext}")
+                    info.size = len(data)
+                    tf.addfile(info, io.BytesIO(data))
+        paths.append(path)
+    return paths

@@ -57,26 +57,23 @@ def download(url: str, filename: Optional[str] = None, root: str = CACHE_PATH,
     filename = filename or os.path.basename(url)
     path = os.path.join(root, filename)
     is_root = backend is None or backend.is_local_root_worker()
-    if is_root:
+    err: Optional[Exception] = None
+    if is_root and not os.path.exists(path):
         os.makedirs(root, exist_ok=True)
+        try:
+            urllib.request.urlretrieve(url, path + ".tmp")
+            os.replace(path + ".tmp", path)
+        except Exception as e:      # noqa: BLE001 - surfaced after the barrier
+            err = e
+    # every process passes the barrier exactly once, regardless of cache state
+    # (a cache-hit early-return would deadlock hosts with cold caches)
+    if backend is not None:
+        backend.local_barrier()
     if os.path.exists(path):
         return path
-    if not is_root:
-        backend.local_barrier()
-        if os.path.exists(path):
-            return path
-        raise FileNotFoundError(f"root worker failed to download {url}")
-    try:
-        urllib.request.urlretrieve(url, path + ".tmp")
-        os.replace(path + ".tmp", path)
-    except Exception as e:
-        raise FileNotFoundError(
-            f"cannot fetch {url} (offline?). Place the file manually at "
-            f"{path} and retry.") from e
-    finally:
-        if backend is not None:
-            backend.local_barrier()
-    return path
+    raise FileNotFoundError(
+        f"cannot fetch {url} (offline?). Place the file manually at {path} "
+        f"and retry.") from err
 
 
 def _t(x) -> np.ndarray:
@@ -342,10 +339,9 @@ def convert_vqgan_state(state: Dict[str, Any], params, cfg: VQGANConfig):
     for cand in ("quantize.embedding.weight", "quantize.embed.weight"):
         if cand in state:
             tree["codebook"]["embedding"] = _t(state[cand])
+    _conv_pair(tree["quant_conv"], state, "quant_conv")
     if cfg.quantizer == "gumbel":
         _conv_pair(tree["quant_proj"], state, "quantize.proj")
-    else:
-        _conv_pair(tree["quant_conv"], state, "quant_conv")
     _conv_pair(tree["post_quant_conv"], state, "post_quant_conv")
     return jax.tree_util.tree_map(jnp.asarray, p)
 

@@ -185,25 +185,26 @@ class VQModel(nn.Module):
         self.encoder = VQGANEncoder(c, name="encoder")
         self.decoder = VQGANDecoder(c, name="decoder")
         self.codebook = nn.Embed(c.n_embed, c.embed_dim, name="codebook")
+        # both variants keep the 1×1 quant_conv (GumbelVQ inherits it from
+        # VQModel: encode = encoder → quant_conv → quantize, vqgan.py:55-59)
+        self.quant_conv = nn.Conv(c.embed_dim, (1, 1), name="quant_conv")
         if c.quantizer == "gumbel":
             # GumbelQuantize: 1×1 proj to n_embed logits (quantize.py:110-141)
             self.quant_proj = nn.Conv(c.n_embed, (1, 1), name="quant_proj")
-        else:
-            self.quant_conv = nn.Conv(c.embed_dim, (1, 1), name="quant_conv")
         self.post_quant_conv = nn.Conv(c.z_channels, (1, 1), name="post_quant_conv")
 
     def quantize(self, h, temp: Optional[float] = None,
                  deterministic: bool = True) -> VQOutput:
         c = self.cfg
+        z = self.quant_conv(h)
         if c.quantizer == "gumbel":
-            logits = self.quant_proj(h)
+            logits = self.quant_proj(z)
             hard = c.straight_through if not deterministic else True
             key = (self.make_rng("gumbel") if not deterministic
                    else jax.random.PRNGKey(0))
             return gumbel_quantize(key, logits, self.codebook.embedding,
                                    tau=1.0 if temp is None else temp,
                                    hard=hard, kl_weight=c.gumbel_kl_weight)
-        z = self.quant_conv(h)
         return vector_quantize(z, self.codebook.embedding, beta=c.beta)
 
     def encode(self, img, temp: Optional[float] = None,

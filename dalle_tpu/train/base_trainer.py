@@ -36,13 +36,16 @@ class BaseTrainer:
                                       keep_n=train_cfg.keep_n_checkpoints)
         self._last_good = None   # host copy of (params, opt_state) for rollback
         self._host_step = 0      # host mirror of state.step: no device sync
+        # per-instance extras merged into checkpoint metadata, e.g. vae
+        # identity for DALLE ckpts (reference legacy/train_dalle.py:535-582)
+        self.extra_meta: dict = {}
 
     # subclasses implement train_step(*batch) -> metrics dict ---------------
 
     def _meta(self) -> dict:
         return {"hparams": self.model_cfg.to_dict(),
                 "train": self.train_cfg.to_dict(),
-                "model_class": self.model_class}
+                "model_class": self.model_class, **self.extra_meta}
 
     def restore(self, step: Optional[int] = None):
         """Resume model/opt/step from the checkpoint dir (reference

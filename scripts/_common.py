@@ -1,0 +1,91 @@
+"""Shared CLI plumbing: VAE reconstitution and checkpoint-params loading.
+
+Reference: legacy/train_dalle.py:249-299 — the VAE precedence chain
+(resume-embedded params > ``--vae_path`` trained dVAE > ``--taming`` VQGAN >
+OpenAI pretrained) — and legacy/generate.py:82-106 (rebuild exact model from
+checkpoint-embedded hparams + vae_class_name).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_dvae_adapter(ckpt_dir: str):
+    """Restore a scripts/train_vae.py checkpoint into a DiscreteVAEAdapter."""
+    import jax
+    from dalle_tpu.config import DVAEConfig, OptimConfig, TrainConfig
+    from dalle_tpu.models.dvae import init_dvae
+    from dalle_tpu.models.wrapper import DiscreteVAEAdapter
+    from dalle_tpu.train.checkpoints import CheckpointManager
+    from dalle_tpu.train.train_state import TrainState, make_optimizer
+
+    mgr = CheckpointManager(ckpt_dir)
+    meta = mgr.load_metadata()
+    if meta is None or meta.get("model_class") != "DiscreteVAE":
+        raise ValueError(f"{ckpt_dir} is not a DiscreteVAE checkpoint "
+                         f"(model_class={meta and meta.get('model_class')})")
+    cfg = DVAEConfig.from_dict(meta["hparams"])
+    optim = OptimConfig.from_dict(meta.get("train", {}).get("optim", {})) \
+        if meta.get("train") else OptimConfig()
+    model, params = init_dvae(cfg, jax.random.PRNGKey(0))
+    template = TrainState.create(apply_fn=model.apply, params=params,
+                                 tx=make_optimizer(optim))
+    state, _ = mgr.restore(template)
+    mgr.close()
+    return DiscreteVAEAdapter(model, state.params)
+
+
+def build_vae_from_args(args, backend=None):
+    """The reference's VAE precedence chain for CLIs (train_dalle.py:264-299).
+    Returns a VAEAdapter."""
+    if getattr(args, "vae_path", None):
+        return load_dvae_adapter(args.vae_path)
+    if getattr(args, "taming", False) or getattr(args, "vqgan_model_path", None):
+        from dalle_tpu.models.pretrained import VQGanVAE
+        return VQGanVAE.from_pretrained(
+            vqgan_model_path=getattr(args, "vqgan_model_path", None),
+            vqgan_config_path=getattr(args, "vqgan_config_path", None),
+            backend=backend)
+    if getattr(args, "untrained_vae", False):
+        # smoke-test path: random dVAE, no pretrained weights needed
+        import jax
+        from dalle_tpu.config import DVAEConfig
+        from dalle_tpu.models.dvae import init_dvae
+        from dalle_tpu.models.wrapper import DiscreteVAEAdapter
+        cfg = DVAEConfig(image_size=args.image_size,
+                         num_tokens=getattr(args, "untrained_vae_tokens", 512),
+                         codebook_dim=64,
+                         num_layers=getattr(args, "untrained_vae_layers", 2),
+                         hidden_dim=32)
+        model, params = init_dvae(cfg, jax.random.PRNGKey(0))
+        return DiscreteVAEAdapter(model, params)
+    from dalle_tpu.models.pretrained import OpenAIDiscreteVAE
+    return OpenAIDiscreteVAE.from_pretrained(backend=backend)
+
+
+def add_vae_args(parser):
+    grp = parser.add_argument_group("vae")
+    grp.add_argument("--vae_path", type=str, default=None,
+                     help="checkpoint dir from scripts/train_vae.py")
+    grp.add_argument("--taming", action="store_true",
+                     help="use the pretrained taming VQGAN")
+    grp.add_argument("--vqgan_model_path", type=str, default=None)
+    grp.add_argument("--vqgan_config_path", type=str, default=None)
+    grp.add_argument("--untrained_vae", action="store_true",
+                     help="random dVAE (smoke tests; no download needed)")
+    grp.add_argument("--untrained_vae_tokens", type=int, default=512)
+    grp.add_argument("--untrained_vae_layers", type=int, default=2)
+    return parser
+
+
+def save_image_grid(images, path):
+    """images (b, H, W, C) float [0,1] → one PNG per row dir-less save."""
+    import numpy as np
+    from PIL import Image
+    arr = (np.asarray(images) * 255).clip(0, 255).astype("uint8")
+    for i, im in enumerate(arr):
+        Image.fromarray(im).save(path.format(i))

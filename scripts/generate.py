@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Generate images from a trained DALL·E checkpoint.
+
+Reference: legacy/generate.py — load checkpoint, rebuild the VAE by class name
+(:93-100), batched ``generate_images`` with top-k filtering (:125-127), JPEG
+outputs in one directory per prompt (:133-140), ``--gentxt`` caption completion
+(:115-117), multiple prompts split on ``|`` (:112).
+
+Example:
+  python scripts/generate.py --dalle_path ./dalle_ckpt --untrained_vae \
+      --image_size 64 --text "red circle|blue square" --num_images 2
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _common import add_vae_args, build_vae_from_args, save_image_grid  # noqa: E402
+
+
+def build_parser():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dalle_path", type=str, required=True,
+                    help="checkpoint dir from scripts/train_dalle.py")
+    ap.add_argument("--text", type=str, required=True,
+                    help="prompt(s), split on |")
+    ap.add_argument("--num_images", type=int, default=4)
+    ap.add_argument("--batch_size", type=int, default=4)
+    ap.add_argument("--top_k_thres", type=float, default=0.9,
+                    help="top-k fraction kept (reference generate.py:125)")
+    ap.add_argument("--temperature", type=float, default=1.0)
+    ap.add_argument("--cond_scale", type=float, default=1.0,
+                    help="classifier-free guidance scale")
+    ap.add_argument("--gentxt", action="store_true",
+                    help="complete the caption with generate_texts first")
+    ap.add_argument("--outputs_dir", type=str, default="./outputs")
+    ap.add_argument("--tokenizer", type=str, default="simple")
+    ap.add_argument("--bpe_path", type=str, default=None)
+    ap.add_argument("--image_size", type=int, default=128)
+    ap.add_argument("--seed", type=int, default=0)
+    add_vae_args(ap)
+    from dalle_tpu.parallel import wrap_arg_parser
+    return wrap_arg_parser(ap)
+
+
+def load_dalle(ckpt_dir: str, backend):
+    """Rebuild the exact model from checkpoint-embedded hparams (reference
+    generate.py:82-106)."""
+    import jax
+    from dalle_tpu.config import DalleConfig, OptimConfig
+    from dalle_tpu.models.dalle import init_dalle
+    from dalle_tpu.train.checkpoints import CheckpointManager
+    from dalle_tpu.train.train_state import TrainState, make_optimizer
+
+    mgr = CheckpointManager(ckpt_dir)
+    meta = mgr.load_metadata()
+    if meta is None or meta.get("model_class") != "DALLE":
+        raise ValueError(f"{ckpt_dir} is not a DALLE checkpoint")
+    cfg = DalleConfig.from_dict(meta["hparams"])
+    optim = OptimConfig.from_dict(meta.get("train", {}).get("optim", {}))
+    model, params = init_dalle(cfg, jax.random.PRNGKey(0))
+    template = TrainState.create(apply_fn=model.apply, params=params,
+                                 tx=make_optimizer(optim))
+    state, _ = mgr.restore(template)
+    mgr.close()
+    return model, state.params, meta
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    import jax
+    import numpy as np
+    from dalle_tpu.models.wrapper import DalleWithVae
+    from dalle_tpu.parallel import set_backend_from_args
+    from dalle_tpu.text.tokenizer import get_tokenizer
+
+    backend = set_backend_from_args(args).initialize()
+    tok_kw = {"bpe_path": args.bpe_path} if args.bpe_path else {}
+    tokenizer = get_tokenizer(args.tokenizer, **tok_kw)
+    model, params, meta = load_dalle(args.dalle_path, backend)
+
+    vae = build_vae_from_args(args, backend)
+    want = meta.get("vae_class_name")
+    if want and want != type(vae).__name__:
+        # the reference hard-errors on class mismatch (generate.py:100)
+        raise ValueError(f"checkpoint was trained with {want}, got "
+                         f"{type(vae).__name__} — pass the matching vae flags")
+    dv = DalleWithVae(model, params, vae)
+    cfg = model.cfg
+    key = jax.random.PRNGKey(args.seed)
+
+    prompts = [t.strip() for t in args.text.split("|") if t.strip()]
+    for prompt in prompts:
+        text_str = prompt
+        if args.gentxt:
+            tkey, key = jax.random.split(key)
+            prime = tokenizer.tokenize([prompt], cfg.text_seq_len,
+                                       truncate_text=True)
+            prime = prime[:, :max(1, int((prime != 0).sum()))]
+            out_ids = dv.generate_texts(tkey, np.asarray(prime))
+            text_str = tokenizer.decode(np.asarray(out_ids)[0])
+            print(f"gentxt: {prompt!r} → {text_str!r}")
+        text = tokenizer.tokenize([text_str], cfg.text_seq_len,
+                                  truncate_text=True)
+        outdir = os.path.join(args.outputs_dir,
+                              text_str.replace(" ", "_")[:64])
+        os.makedirs(outdir, exist_ok=True)
+        made = 0
+        while made < args.num_images:
+            n = min(args.batch_size, args.num_images - made)
+            bkey, key = jax.random.split(key)
+            batch_text = np.repeat(text, n, axis=0)
+            imgs = dv.generate_images(
+                batch_text, bkey, filter_thres=args.top_k_thres,
+                temperature=args.temperature, cond_scale=args.cond_scale)
+            save_image_grid(np.asarray(imgs),
+                            os.path.join(outdir, f"img_{made}_{{}}.png"))
+            made += n
+        print(f"wrote {made} images for {text_str!r} → {outdir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

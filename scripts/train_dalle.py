@@ -1,0 +1,217 @@
+#!/usr/bin/env python
+"""Train DALL·E on TPU (or the CPU mesh).
+
+Reference: legacy/train_dalle.py (SURVEY.md §3.1): tokenizer selection, the
+VAE precedence chain, folder/WebDataset data, resume, checkpoint rotation,
+periodic in-training sampling. One process per host; data parallelism comes
+from the mesh.
+
+Examples:
+  python scripts/sampler.py --outdir /tmp/shapes --count 256 --image_size 64
+  python scripts/train_dalle.py --image_text_folder /tmp/shapes \
+      --untrained_vae --image_size 64 --dim 128 --depth 2 --epochs 1 \
+      --batch_size 8 --text_seq_len 32
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _common import add_vae_args, build_vae_from_args, save_image_grid  # noqa: E402
+
+
+def build_parser():
+    ap = argparse.ArgumentParser(description=__doc__)
+    data = ap.add_argument_group("data")
+    data.add_argument("--image_text_folder", type=str, default=None,
+                      help="folder pairing images with .txt captions "
+                           "(or filename captions via --text_from_filename)")
+    data.add_argument("--wds", type=str, default=None,
+                      help="tar shard spec: dir, glob, brace range, or pipe:")
+    data.add_argument("--synthetic", action="store_true")
+    data.add_argument("--text_from_filename", action="store_true")
+    data.add_argument("--image_size", type=int, default=128)
+
+    tok = ap.add_argument_group("tokenizer")
+    tok.add_argument("--tokenizer", type=str, default="simple",
+                     choices=["simple", "yttm", "hug", "chinese"])
+    tok.add_argument("--bpe_path", type=str, default=None)
+
+    model = ap.add_argument_group("model")
+    model.add_argument("--dim", type=int, default=512)
+    model.add_argument("--depth", type=int, default=2)
+    model.add_argument("--heads", type=int, default=8)
+    model.add_argument("--dim_head", type=int, default=64)
+    model.add_argument("--text_seq_len", type=int, default=256)
+    model.add_argument("--num_text_tokens", type=int, default=None,
+                       help="default: tokenizer vocab size")
+    model.add_argument("--attn_types", type=str, default="full",
+                       help="comma list: full,axial_row,axial_col,conv_like,sparse")
+    model.add_argument("--reversible", action="store_true")
+    model.add_argument("--stable", action="store_true")
+    model.add_argument("--shift_tokens", action="store_true")
+    model.add_argument("--no_rotary", action="store_true")
+    model.add_argument("--loss_img_weight", type=float, default=7.0)
+    model.add_argument("--attn_dropout", type=float, default=0.0)
+    model.add_argument("--ff_dropout", type=float, default=0.0)
+    add_vae_args(ap)
+
+    train = ap.add_argument_group("training")
+    train.add_argument("--epochs", type=int, default=20)
+    train.add_argument("--batch_size", type=int, default=16)
+    train.add_argument("--learning_rate", type=float, default=3e-4)
+    train.add_argument("--clip_grad_norm", type=float, default=0.5)
+    train.add_argument("--ga_steps", type=int, default=1)
+    train.add_argument("--null_cond_prob", type=float, default=0.0)
+    train.add_argument("--output_dir", type=str, default="./dalle_ckpt")
+    train.add_argument("--save_every_n_steps", type=int, default=1000)
+    train.add_argument("--keep_n_checkpoints", type=int, default=None)
+    train.add_argument("--sample_every_steps", type=int, default=0)
+    train.add_argument("--sample_dir", type=str, default="./dalle_samples")
+    train.add_argument("--resume", action="store_true")
+    train.add_argument("--seed", type=int, default=42)
+    train.add_argument("--steps", type=int, default=None)
+    train.add_argument("--no_preflight", action="store_true")
+    train.add_argument("--flops_profiler", action="store_true",
+                       help="profile at step 200 then exit (ref :492-499)")
+
+    from dalle_tpu.parallel import wrap_arg_parser
+    wrap_arg_parser(ap)
+    return ap
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if not (args.image_text_folder or args.wds or args.synthetic):
+        print("error: provide --image_text_folder, --wds or --synthetic",
+              file=sys.stderr)
+        return 2
+
+    import numpy as np
+    from dalle_tpu.config import DalleConfig, OptimConfig, TrainConfig
+    from dalle_tpu.models.wrapper import DalleWithVae, dalle_config_for_vae
+    from dalle_tpu.parallel import set_backend_from_args
+    from dalle_tpu.text.tokenizer import get_tokenizer
+    from dalle_tpu.train.trainer_dalle import DalleTrainer
+
+    backend = set_backend_from_args(args).initialize()
+    backend.check_batch_size(args.batch_size)
+    is_root = backend.is_root_worker()
+
+    tok_kw = {"bpe_path": args.bpe_path} if args.bpe_path else {}
+    tokenizer = get_tokenizer(args.tokenizer, **tok_kw)
+    vae = build_vae_from_args(args, backend)
+    assert vae.image_size == args.image_size, (
+        f"--image_size {args.image_size} != vae.image_size {vae.image_size}")
+
+    num_text_tokens = args.num_text_tokens or max(tokenizer.vocab_size, 256)
+    if num_text_tokens < tokenizer.vocab_size:
+        print(f"error: --num_text_tokens {num_text_tokens} < tokenizer vocab "
+              f"{tokenizer.vocab_size} (ids would index out of range)",
+              file=sys.stderr)
+        return 2
+    model_cfg = dalle_config_for_vae(
+        vae, num_text_tokens=num_text_tokens, text_seq_len=args.text_seq_len,
+        dim=args.dim, depth=args.depth, heads=args.heads,
+        dim_head=args.dim_head, attn_types=tuple(args.attn_types.split(",")),
+        reversible=args.reversible, stable=args.stable,
+        shift_tokens=args.shift_tokens, rotary_emb=not args.no_rotary,
+        loss_img_weight=args.loss_img_weight, attn_dropout=args.attn_dropout,
+        ff_dropout=args.ff_dropout)
+    train_cfg = TrainConfig(
+        batch_size=args.batch_size, epochs=args.epochs, seed=args.seed,
+        checkpoint_dir=args.output_dir,
+        save_every_steps=args.save_every_n_steps,
+        keep_n_checkpoints=args.keep_n_checkpoints,
+        preflight_checkpoint=not args.no_preflight,
+        sample_every_steps=args.sample_every_steps,
+        profile_step=200 if args.flops_profiler else 0,
+        optim=OptimConfig(learning_rate=args.learning_rate,
+                          grad_clip_norm=args.clip_grad_norm,
+                          grad_accum_steps=args.ga_steps))
+
+    trainer = DalleTrainer(model_cfg, train_cfg, backend=backend,
+                           null_cond_prob=args.null_cond_prob)
+    trainer.extra_meta = {
+        "vae_class_name": type(vae).__name__,
+        "vae_hparams": getattr(getattr(vae, "model", None), "cfg", None)
+        and vae.model.cfg.to_dict()}
+    if args.resume:
+        meta = trainer.restore()
+        if is_root:
+            print(f"resumed at step {trainer._host_step}"
+                  f" (ckpt model_class={meta and meta.get('model_class')})")
+
+    # -- data → (text ids, image ids) batches ------------------------------
+    def encode_batch(images, captions):
+        text = tokenizer.tokenize(list(captions), args.text_seq_len,
+                                  truncate_text=True)
+        ids = np.asarray(vae.get_codebook_indices(np.asarray(images)))
+        return text, ids
+
+    if args.synthetic:
+        from dalle_tpu.data.synthetic import ShapesDataset, batch_iterator
+        ds = ShapesDataset(image_size=args.image_size)
+        raw = batch_iterator(ds, args.batch_size, seed=args.seed,
+                             epochs=args.epochs)
+        batches = (encode_batch(imgs, caps) for imgs, caps in raw)
+    elif args.wds:
+        from dalle_tpu.data.webdataset import WebDataset
+        wds = (WebDataset(args.wds, shuffle_shards=True, repeat=True,
+                          seed=args.seed)
+               .decode(image_size=args.image_size)
+               .map(lambda s: (next(s[k] for k in ("jpg", "jpeg", "png")
+                                    if k in s),
+                               next(s[k] for k in ("txt", "text", "caption")
+                                    if k in s)))
+               .shuffle(256)
+               .batched(args.batch_size))
+        batches = ((encode_batch(np.stack(imgs), caps)
+                    for imgs, caps in wds.prefetch()))
+    else:
+        from dalle_tpu.data.text_image import TextImageDataset
+        ds = TextImageDataset(args.image_text_folder,
+                              image_size=args.image_size, shuffle=True,
+                              seed=args.seed,
+                              text_from_filename=args.text_from_filename)
+        raw = ds.batches(args.batch_size, epochs=args.epochs)
+        batches = (encode_batch(imgs, caps) for imgs, caps in raw)
+
+    # periodic in-training sampling (reference :639-649)
+    sample_fn = None
+    if args.sample_every_steps:
+        import jax
+        os.makedirs(args.sample_dir, exist_ok=True)
+        sample_text = tokenizer.tokenize(["sample"], args.text_seq_len,
+                                         truncate_text=True)
+
+        def sample_fn(step):
+            dv = DalleWithVae(trainer.model, trainer.state.params, vae)
+            imgs = dv.generate_images(sample_text, jax.random.PRNGKey(step))
+            save_image_grid(imgs, os.path.join(
+                args.sample_dir, f"step{step}_{{}}.png"))
+            if is_root:
+                print(f"[step {step}] wrote sample to {args.sample_dir}")
+
+    if is_root:
+        print(f"DALLE: {trainer.num_params / 1e6:.1f}M params; "
+              f"mesh {dict(trainer.mesh.shape)}; vae {type(vae).__name__}")
+    log = print if is_root else (lambda *a, **k: None)
+
+    steps = args.steps
+    if args.flops_profiler:
+        steps = 201  # profile at 200 then stop (reference :656-657)
+    trainer.fit(batches, steps=steps, log=log, sample_fn=sample_fn)
+
+    final = int(trainer.state.step)
+    if trainer.ckpt.latest_step() != final:
+        trainer.ckpt.save(final, trainer.state, trainer._meta())
+    if is_root:
+        print(f"done at step {final}; checkpoints in {args.output_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

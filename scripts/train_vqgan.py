@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Train a VQGAN image tokenizer (taming-stack parity) on TPU or the CPU mesh.
+
+Reference: the taming Lightning harness (taming/main.py) driving
+``VQModel``/``GumbelVQ`` with ``VQLPIPSWithDiscriminator`` — here a plain CLI
+over ``VQGANTrainer`` (two-optimizer adversarial training in one jitted step).
+LR follows taming's accumulate×ngpu×bs×base_lr rule (main.py:530-541) unless
+--absolute_lr is passed.
+
+Example:
+  python scripts/train_vqgan.py --image_folder /tmp/shapes --resolution 64 \
+      --ch 32 --ch_mult 1,2 --n_embed 256 --epochs 1 --batch_size 8 \
+      --disc_start 1000
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_parser():
+    ap = argparse.ArgumentParser(description=__doc__)
+    data = ap.add_argument_group("data")
+    data.add_argument("--image_folder", type=str, default=None)
+    data.add_argument("--synthetic", action="store_true")
+
+    model = ap.add_argument_group("model")
+    model.add_argument("--resolution", type=int, default=256)
+    model.add_argument("--n_embed", type=int, default=1024)
+    model.add_argument("--embed_dim", type=int, default=256)
+    model.add_argument("--z_channels", type=int, default=256)
+    model.add_argument("--ch", type=int, default=128)
+    model.add_argument("--ch_mult", type=str, default="1,1,2,2,4")
+    model.add_argument("--num_res_blocks", type=int, default=2)
+    model.add_argument("--attn_resolutions", type=str, default="16")
+    model.add_argument("--dropout", type=float, default=0.0)
+    model.add_argument("--gumbel", action="store_true",
+                       help="GumbelVQ variant (taming vqgan.py:261-303)")
+
+    loss = ap.add_argument_group("loss")
+    loss.add_argument("--disc_start", type=int, default=10000)
+    loss.add_argument("--disc_weight", type=float, default=0.8)
+    loss.add_argument("--disc_num_layers", type=int, default=3)
+    loss.add_argument("--disc_ndf", type=int, default=64)
+    loss.add_argument("--disc_loss", type=str, default="hinge",
+                      choices=["hinge", "vanilla"])
+    loss.add_argument("--codebook_weight", type=float, default=1.0)
+    loss.add_argument("--perceptual_weight", type=float, default=1.0)
+    loss.add_argument("--use_actnorm", action="store_true")
+
+    train = ap.add_argument_group("training")
+    train.add_argument("--epochs", type=int, default=20)
+    train.add_argument("--batch_size", type=int, default=16)
+    train.add_argument("--base_lr", type=float, default=4.5e-6,
+                       help="scaled by batch size (taming main.py:530-541)")
+    train.add_argument("--absolute_lr", type=float, default=None)
+    train.add_argument("--output_dir", type=str, default="./vqgan_ckpt")
+    train.add_argument("--save_every_steps", type=int, default=1000)
+    train.add_argument("--keep_n_checkpoints", type=int, default=None)
+    train.add_argument("--resume", action="store_true")
+    train.add_argument("--seed", type=int, default=42)
+    train.add_argument("--steps", type=int, default=None)
+    train.add_argument("--no_preflight", action="store_true")
+
+    from dalle_tpu.parallel import wrap_arg_parser
+    wrap_arg_parser(ap)
+    return ap
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if not (args.image_folder or args.synthetic):
+        print("error: provide --image_folder or --synthetic", file=sys.stderr)
+        return 2
+
+    import numpy as np
+    from dalle_tpu.config import OptimConfig, TrainConfig, VQGANConfig
+    from dalle_tpu.models.gan import GANLossConfig
+    from dalle_tpu.parallel import set_backend_from_args
+    from dalle_tpu.train.trainer_vqgan import VQGANTrainer
+
+    backend = set_backend_from_args(args).initialize()
+    backend.check_batch_size(args.batch_size)
+    is_root = backend.is_root_worker()
+
+    lr = args.absolute_lr or args.base_lr * args.batch_size
+    model_cfg = VQGANConfig(
+        resolution=args.resolution, n_embed=args.n_embed,
+        embed_dim=args.embed_dim, z_channels=args.z_channels, ch=args.ch,
+        ch_mult=tuple(int(x) for x in args.ch_mult.split(",")),
+        num_res_blocks=args.num_res_blocks,
+        attn_resolutions=tuple(int(x) for x in args.attn_resolutions.split(",")),
+        dropout=args.dropout, quantizer="gumbel" if args.gumbel else "vq")
+    loss_cfg = GANLossConfig(
+        disc_start=args.disc_start, disc_weight=args.disc_weight,
+        disc_num_layers=args.disc_num_layers, disc_ndf=args.disc_ndf,
+        disc_loss=args.disc_loss, codebook_weight=args.codebook_weight,
+        perceptual_weight=args.perceptual_weight, use_actnorm=args.use_actnorm)
+    train_cfg = TrainConfig(
+        batch_size=args.batch_size, epochs=args.epochs, seed=args.seed,
+        checkpoint_dir=args.output_dir, save_every_steps=args.save_every_steps,
+        keep_n_checkpoints=args.keep_n_checkpoints,
+        preflight_checkpoint=not args.no_preflight,
+        # taming: Adam(lr, betas=(0.5, 0.9)) for both nets (vqgan.py:121-131)
+        optim=OptimConfig(learning_rate=lr, beta1=0.5, beta2=0.9,
+                          grad_clip_norm=0.0))
+
+    trainer = VQGANTrainer(model_cfg, train_cfg, loss_cfg=loss_cfg,
+                           backend=backend)
+    if args.resume:
+        trainer.restore()
+
+    # images in [-1, 1] (taming data convention, taming/data/base.py:45-50)
+    if args.synthetic:
+        from dalle_tpu.data.synthetic import ShapesDataset, batch_iterator
+        ds = ShapesDataset(image_size=args.resolution)
+        raw = batch_iterator(ds, args.batch_size, seed=args.seed,
+                             epochs=args.epochs)
+        batches = ((imgs * 2.0 - 1.0,) for imgs, _caps in raw)
+    else:
+        from dalle_tpu.data.loaders import ImageFolderDataset, batch_arrays
+        ds = ImageFolderDataset(args.image_folder, image_size=args.resolution)
+        rng = np.random.RandomState(args.seed)
+
+        def folder_batches():
+            for _ in range(args.epochs):
+                order = rng.permutation(len(ds))
+                for s in range(0, len(order) - args.batch_size + 1,
+                               args.batch_size):
+                    imgs, _ = batch_arrays(ds, order[s:s + args.batch_size])
+                    yield (imgs * 2.0 - 1.0,)
+        batches = folder_batches()
+
+    if is_root:
+        print(f"VQGAN {'gumbel' if args.gumbel else 'vq'}: "
+              f"{model_cfg.to_json()}")
+    log = print if is_root else (lambda *a, **k: None)
+    trainer.fit(batches, steps=args.steps, log=log)
+
+    final = int(trainer.state.step)
+    if trainer.ckpt.latest_step() != final:
+        trainer.ckpt.save(final, trainer.state, trainer._meta())
+    if is_root:
+        print(f"done at step {final}; checkpoints in {args.output_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
