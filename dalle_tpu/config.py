@@ -244,6 +244,9 @@ class TransformerConfig(ConfigBase):
     shared_ff_ids: Optional[Tuple[int, ...]] = None
     optimize_for_inference: bool = False  # sparse→dense+static-mask swap
     use_pallas: bool = False              # pallas flash-attention on the full path
+    # f32 attention softmax is the safe default; False keeps scores bf16 —
+    # the dominant HBM tensor (big train-throughput win, tiny numeric delta)
+    attn_softmax_f32: bool = True
 
 
 @dataclass(frozen=True)
@@ -270,6 +273,7 @@ class DalleConfig(ConfigBase):
     reversible: bool = False
     use_remat: bool = True
     use_pallas: bool = False
+    attn_softmax_f32: bool = True
     sparse_block_size: int = 128
     sparse_attn_kernel: int = 5
     # filled from the vae at model build time
@@ -300,6 +304,7 @@ class DalleConfig(ConfigBase):
             sandwich_norm=self.sandwich_norm, shift_tokens=self.shift_tokens,
             rotary_emb=self.rotary_emb, shared_attn_ids=self.shared_attn_ids,
             shared_ff_ids=self.shared_ff_ids, use_pallas=self.use_pallas,
+            attn_softmax_f32=self.attn_softmax_f32,
             sparse_block_size=self.sparse_block_size, sparse_attn_kernel=self.sparse_attn_kernel,
         )
 
@@ -377,6 +382,11 @@ class TrainConfig(ConfigBase):
     epochs: int = 20
     seed: int = 42
     log_every: int = 10
+    # fetch step metrics to host every N steps. 1 = every step (exact NaN
+    # detection, but the device_get syncs the pipeline each step); larger
+    # values let steps queue back-to-back on the chip — NaN rollback then
+    # triggers up to N-1 steps late, still restoring the last good snapshot
+    metrics_every: int = 1
     save_every_steps: int = 1000
     keep_n_checkpoints: Optional[int] = None
     checkpoint_dir: str = "./checkpoints"

@@ -69,20 +69,22 @@ def split_shards_per_host(shards: Sequence[str],
 
 
 def _open_shard(url: str):
-    """Local path → file; ``pipe:CMD`` → the command's stdout (reference
-    train_dalle.py:218-224 uses ``pipe:curl``/``pipe:gsutil``)."""
+    """Local path → (file, None); ``pipe:CMD`` → (the command's stdout, proc)
+    so the child can be reaped (reference train_dalle.py:218-224 uses
+    ``pipe:curl``/``pipe:gsutil``)."""
     if url.startswith("pipe:"):
         proc = subprocess.Popen(url[5:], shell=True, stdout=subprocess.PIPE)
-        return proc.stdout
-    return open(url, "rb")
+        return proc.stdout, proc
+    return open(url, "rb"), None
 
 
 def iter_tar_samples(url: str, handler: Callable[[Exception], bool]
                      ) -> Iterator[Dict[str, bytes]]:
     """Stream one tar shard, grouping members into samples by key (the path up
     to the first dot, wds convention). Yields ``{"__key__": str, ext: bytes}``."""
+    proc = None
     try:
-        stream = _open_shard(url)
+        stream, proc = _open_shard(url)
         tf = tarfile.open(fileobj=stream, mode="r|*")
     except Exception as e:              # noqa: BLE001 - shard-level skip
         if handler(e):
@@ -94,8 +96,10 @@ def iter_tar_samples(url: str, handler: Callable[[Exception], bool]
         for member in tf:
             if not member.isfile():
                 continue
-            name = member.name
-            base, _, ext = name.partition(".")
+            dirpart, _, fname = member.name.lstrip("./").rpartition("/")
+            base, _, ext = fname.partition(".")
+            if dirpart:
+                base = dirpart + "/" + base
             if key is not None and base != key:
                 yield current
                 current = {}
@@ -110,6 +114,8 @@ def iter_tar_samples(url: str, handler: Callable[[Exception], bool]
     finally:
         tf.close()
         stream.close()
+        if proc is not None:
+            proc.wait()   # reap: no zombie per pipe: shard
 
 
 def warn_and_continue(e: Exception) -> bool:
@@ -155,7 +161,9 @@ class WebDataset:
 
     def __init__(self, urls, *, handler: Callable = warn_and_continue,
                  shuffle_shards: bool = False, split_by_host: bool = True,
-                 seed: int = 0, repeat: bool = False):
+                 seed: int = 0, repeat=False):
+        """``repeat``: False = one pass, True = loop forever, an int = that
+        many epochs over the shard list."""
         self.shards = expand_shards(urls)
         if split_by_host:
             try:
@@ -214,7 +222,9 @@ class WebDataset:
             for url in shards:
                 yield from iter_tar_samples(url, self.handler)
             epoch += 1
-            if not self.repeat:
+            if self.repeat is True:
+                continue
+            if not self.repeat or epoch >= int(self.repeat):
                 return
 
     def __iter__(self) -> Iterator:
@@ -286,11 +296,19 @@ class _Prefetcher:
     def __init__(self, ds: Iterable, max_queue: int):
         self.q: "queue.Queue" = queue.Queue(maxsize=max_queue)
         self.error: Optional[BaseException] = None
+        self._stop = False
 
         def run():
             try:
                 for item in ds:
-                    self.q.put(item)
+                    while not self._stop:  # bounded put so close() can unblock
+                        try:
+                            self.q.put(item, timeout=0.5)
+                            break
+                        except queue.Full:
+                            continue
+                    if self._stop:
+                        return
             except BaseException as e:  # noqa: BLE001 - surfaced to consumer
                 self.error = e
             finally:
@@ -298,6 +316,19 @@ class _Prefetcher:
 
         self.thread = threading.Thread(target=run, daemon=True)
         self.thread.start()
+
+    def close(self):
+        """Release the producer thread (and its open shard/pipe handles) when
+        the consumer stops early, e.g. fit(steps=N) mid-stream."""
+        self._stop = True
+        try:
+            while True:
+                self.q.get_nowait()
+        except queue.Empty:
+            pass
+
+    def __del__(self):
+        self.close()
 
     def __iter__(self):
         return self

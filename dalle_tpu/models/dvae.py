@@ -149,8 +149,9 @@ class DiscreteVAE(nn.Module):
         if not return_loss:
             return out
 
-        # recon loss on *normalized* target, as the reference does (:236)
-        diff = img_n - out
+        # recon loss on *normalized* target, as the reference does (:236);
+        # reductions in f32 so a bf16 compute path keeps a clean loss signal
+        diff = img_n.astype(jnp.float32) - out.astype(jnp.float32)
         if c.smooth_l1_loss:
             a = jnp.abs(diff)
             recon = jnp.mean(jnp.where(a < 1.0, 0.5 * diff ** 2, a - 0.5))
@@ -158,7 +159,7 @@ class DiscreteVAE(nn.Module):
             recon = jnp.mean(diff ** 2)
 
         b, h, w, n = logits.shape
-        kl = kl_to_uniform(logits.reshape(b, h * w, n))
+        kl = kl_to_uniform(logits.reshape(b, h * w, n).astype(jnp.float32))
         loss = recon + kl * c.kl_div_loss_weight
 
         if not return_recons:

@@ -149,3 +149,20 @@ def adaptive_disc_weight(nll_of_recon, g_of_recon, h_last, conv_out_params,
                 (jnp.linalg.norm(g_grad.reshape(-1)) + 1e-4))
     d_weight = jnp.clip(d_weight, 0.0, 1e4)
     return jax.lax.stop_gradient(d_weight) * disc_weight
+
+
+def bce_loss(logits, targets):
+    """Per-pixel sigmoid BCE, summed over pixels and averaged over batch —
+    ``BCELoss`` (taming/modules/losses/segmentation.py:4-11)."""
+    per = jax.nn.softplus(logits) - logits * targets
+    return jnp.sum(per) / logits.shape[0]
+
+
+def bce_with_quant_loss(logits, targets, codebook_loss,
+                        codebook_weight: float = 1.0):
+    """``BCELossWithQuant`` (segmentation.py:14-22): BCE + weighted codebook
+    term — the loss of the VQSegmentationModel variant (taming vqgan.py:159-222).
+    Returns (total, dict of parts)."""
+    bce = bce_loss(logits, targets)
+    total = bce + codebook_weight * jnp.mean(codebook_loss)
+    return total, {"bce_loss": bce, "quant_loss": jnp.mean(codebook_loss)}

@@ -94,6 +94,7 @@ class Attention(nn.Module):
     causal: bool = True
     stable: bool = False
     use_pallas: bool = False
+    softmax_f32: bool = True
 
     def setup(self):
         inner = self.heads * self.dim_head
@@ -125,7 +126,8 @@ class Attention(nn.Module):
         else:
             static = None if np_mask is None else jnp.asarray(np_mask)
             out = attend(q, k, v, causal=self.causal, key_mask=key_mask,
-                         static_mask=static, stable=self.stable)
+                         static_mask=static, stable=self.stable,
+                         softmax_f32=self.softmax_f32)
         out = out.transpose(0, 2, 1, 3).reshape(b, n, -1)
         return self.drop(self.to_out(out), deterministic=deterministic)
 
@@ -362,6 +364,7 @@ class Transformer(nn.Module):
                 attn = Attention(c.dim, c.heads, c.dim_head, c.attn_dropout,
                                  causal=c.causal, stable=c.stable,
                                  use_pallas=c.use_pallas,
+                                 softmax_f32=c.attn_softmax_f32,
                                  name=f"attn_{aid}")
                 shared_attn[aid] = (attn, t)
             if fid in shared_ff:

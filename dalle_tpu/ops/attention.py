@@ -37,6 +37,7 @@ def attend(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
            key_mask: Optional[jnp.ndarray] = None,      # (b, j) True=valid
            static_mask: Optional[jnp.ndarray] = None,   # (i, j) True=may attend
            stable: bool = False,
+           softmax_f32: bool = True,
            scale: Optional[float] = None) -> jnp.ndarray:
     """Dense attention. q: (b,h,i,d), k/v: (b,h,j,d) → (b,h,i,d).
 
@@ -48,20 +49,27 @@ def attend(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     q = q * scale
     dots = jnp.einsum("bhid,bhjd->bhij", q, k)
     i, j = dots.shape[-2], dots.shape[-1]
+    # fill in the score dtype: an f32 constant would silently promote a bf16
+    # score tensor back to full width
+    neg = jnp.asarray(NEG_INF, dots.dtype)
 
     if key_mask is not None:
-        dots = jnp.where(key_mask[:, None, None, :], dots, NEG_INF)
+        dots = jnp.where(key_mask[:, None, None, :], dots, neg)
     if causal:
         qpos = jnp.arange(i) + (j - i)
         kpos = jnp.arange(j)
-        dots = jnp.where(kpos[None, :] <= qpos[:, None], dots, NEG_INF)
+        dots = jnp.where(kpos[None, :] <= qpos[:, None], dots, neg)
     if static_mask is not None:
         # queries occupy key positions j-i..j-1 (same alignment as the causal
         # branch above), so index mask rows by key position, not from the end
-        dots = jnp.where(static_mask[j - i:j, :j], dots, NEG_INF)
+        dots = jnp.where(static_mask[j - i:j, :j], dots, neg)
 
     softmax = stable_softmax if stable else jax.nn.softmax
-    attn = softmax(dots.astype(jnp.float32), axis=-1).astype(v.dtype)
+    # f32 softmax is the safe default; bf16 keeps the (i, j) score tensor in
+    # half width — it is the dominant HBM tensor of the whole model (the
+    # softmax is still max-subtracted internally, so it cannot overflow)
+    sm_dtype = jnp.float32 if softmax_f32 else dots.dtype
+    attn = softmax(dots.astype(sm_dtype), axis=-1).astype(v.dtype)
     return jnp.einsum("bhij,bhjd->bhid", attn, v)
 
 

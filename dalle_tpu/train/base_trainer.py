@@ -54,8 +54,38 @@ class BaseTrainer:
         self._host_step = int(self.state.step)
         return meta
 
+    def install_signal_checkpoint(self, log=print):
+        """SIGUSR1 → checkpoint at the next step boundary (taming's "melk"
+        handler, taming/main.py:544-557 — the signal only sets a flag; the
+        save happens between steps where the state is consistent)."""
+        import signal
+
+        def handler(_sig, _frame):
+            self._signal_save = True
+            log("SIGUSR1: will checkpoint at the next step boundary")
+
+        self._signal_save = False
+        signal.signal(signal.SIGUSR1, handler)
+
+    def _maybe_profile(self, step_num: int, m: dict, log):
+        """jax.profiler trace + MFU line at ``profile_step`` — the stand-in for
+        the reference's DeepSpeed flops profile at step 200
+        (legacy/train_dalle.py:492-499,656-657)."""
+        tc = self.train_cfg
+        if not tc.profile_step or step_num != tc.profile_step:
+            return
+        import jax
+        logdir = f"{tc.checkpoint_dir}/profile_step{step_num}"
+        with jax.profiler.trace(logdir):
+            m2 = self.train_step(*self._last_batch)
+        rep = self.meter._last_report or {}
+        log(f"[profile] step {step_num}: trace → {logdir}; "
+            + " ".join(f"{k}={v:.5g}" for k, v in {**m, **m2, **rep}.items()
+                       if isinstance(v, (int, float))))
+
     def fit(self, batches, *, steps: Optional[int] = None, log=print,
-            sample_fn: Optional[Callable[[int], None]] = None):
+            sample_fn: Optional[Callable[[int], None]] = None,
+            metrics_writer=None):
         """Epoch-agnostic loop over ``batches`` (iterable of tuples fed to
         ``train_step``) with the reference's parity behaviors."""
         tc = self.train_cfg
@@ -64,22 +94,28 @@ class BaseTrainer:
             self.ckpt.preflight(self.state, meta)
         self._snapshot_good()
         for batch in batches:
+            self._last_batch = batch
             m = self.train_step(*batch)
             step_num = self._host_step
-            nan = tc.nan_rollback and not math.isfinite(m["loss"])
+            nan = bool(m) and tc.nan_rollback and not math.isfinite(m["loss"])
             if nan:
                 log(f"[step {step_num}] NaN loss — rolling back to last good state")
                 self._rollback()
             else:
-                if step_num % tc.log_every == 0:
+                if m and step_num % tc.log_every == 0:
                     log(f"[step {step_num}] " +
                         " ".join(f"{k}={v:.5g}" for k, v in m.items()))
-                if step_num % tc.save_every_steps == 0:
+                if m and metrics_writer is not None:
+                    metrics_writer.log(step_num, m)
+                if step_num % tc.save_every_steps == 0 or \
+                        getattr(self, "_signal_save", False):
                     self.ckpt.save(step_num, self.state, meta)
                     self._snapshot_good()
+                    self._signal_save = False
                 if getattr(tc, "sample_every_steps", 0) and sample_fn and \
                         step_num % tc.sample_every_steps == 0:
                     sample_fn(step_num)
+                self._maybe_profile(step_num, m, log)
             # the steps budget must bound the loop even when steps go NaN
             if steps is not None and step_num >= steps:
                 break
@@ -103,8 +139,19 @@ class BaseTrainer:
     def _finish_step(self, metrics) -> dict:
         """Post-step bookkeeping: advance the host step, pull metrics, attach
         the throughput report keyed on the POST-increment step so it lands in
-        the same metrics dict fit() logs at ``log_every`` boundaries."""
+        the same metrics dict fit() logs at ``log_every`` boundaries.
+
+        With ``metrics_every > 1`` the device_get (a host↔device sync that
+        stalls the step pipeline) only happens every N steps; other steps
+        return an empty dict and fit() skips their NaN check / logging."""
         self._host_step += 1
+        every = max(getattr(self.train_cfg, "metrics_every", 1), 1)
+        # always fetch on save boundaries: a checkpoint/_snapshot_good must
+        # never capture a state whose loss was not NaN-checked
+        save_boundary = (self._host_step % self.train_cfg.save_every_steps == 0
+                         or getattr(self, "_signal_save", False))
+        if self._host_step % every != 0 and not save_boundary:
+            return {}
         metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
         rep = self.meter.step(self._host_step)
         if rep:

@@ -44,21 +44,26 @@ class ThroughputMeter:
         self.flops_per_step = flops_per_step
         self.num_chips = max(num_chips, 1)
         self._t0 = time.perf_counter()
+        self._last_step = 0
         self._last_report = None
 
     def step(self, step_num: int):
-        if step_num % self.interval != 0 or step_num == 0:
+        """Call at any (possibly irregular) step numbers — e.g. only at
+        ``metrics_every`` boundaries; rates use the ACTUAL steps elapsed."""
+        if step_num - self._last_step < self.interval or step_num == 0:
             return None
         now = time.perf_counter()
         dt = now - self._t0
+        n_steps = step_num - self._last_step
         self._t0 = now
-        sps = self.batch * self.interval / dt
-        rep = {"sample_per_sec": sps, "step_time_s": dt / self.interval}
+        self._last_step = step_num
+        sps = self.batch * n_steps / dt
+        rep = {"sample_per_sec": sps, "step_time_s": dt / n_steps}
         if self.tokens_per_sample:
             rep["tokens_per_sec"] = sps * self.tokens_per_sample
             rep["tokens_per_sec_per_chip"] = sps * self.tokens_per_sample / self.num_chips
         if self.flops_per_step:
-            achieved = self.flops_per_step * self.interval / dt
+            achieved = self.flops_per_step * n_steps / dt
             peak = device_peak_tflops() * 1e12 * self.num_chips
             rep["mfu"] = achieved / peak
         self._last_report = rep
@@ -82,3 +87,42 @@ def profile_trace(logdir: str, fn, *args):
         out = fn(*args)
         jax.block_until_ready(out)
     return out
+
+
+class MetricsLogger:
+    """Experiment-metrics sink: JSONL on disk, mirrored to wandb when the
+    package+login are available — the reference's L6 observability layer
+    (wandb.init/log at legacy/train_dalle.py:463-476,659-660) without a hard
+    dependency on the external service."""
+
+    def __init__(self, path: Optional[str] = None, use_wandb: bool = False,
+                 project: str = "dalle-tpu", config: Optional[dict] = None,
+                 run_name: Optional[str] = None):
+        self._fh = open(path, "a") if path else None
+        self._wandb = None
+        if use_wandb:
+            try:
+                import wandb
+                self._wandb = wandb.init(project=project, name=run_name,
+                                         config=config or {}, resume="allow")
+            except Exception as e:   # offline / not installed: degrade to jsonl
+                print(f"[metrics] wandb unavailable ({e!r}); jsonl only")
+
+    def log(self, step: int, metrics: dict):
+        import json
+        import time as _time
+        rec = {"step": step, "time": _time.time(),
+               **{k: v for k, v in metrics.items()
+                  if isinstance(v, (int, float, str))}}
+        if self._fh is not None:
+            self._fh.write(json.dumps(rec) + "\n")
+            self._fh.flush()
+        if self._wandb is not None:
+            self._wandb.log({k: v for k, v in rec.items() if k != "step"},
+                            step=step)
+
+    def close(self):
+        if self._fh is not None:
+            self._fh.close()
+        if self._wandb is not None:
+            self._wandb.finish()

@@ -83,3 +83,23 @@ def make_optimizer(cfg: OptimConfig) -> optax.GradientTransformation:
     if cfg.grad_accum_steps > 1:
         tx = optax.MultiSteps(tx, every_k_schedule=cfg.grad_accum_steps)
     return tx
+
+
+def compute_dtype(precision) -> Any:
+    """PrecisionConfig.compute → jnp dtype (None when already float32)."""
+    name = getattr(precision, "compute", "float32")
+    if name in ("float32", "f32", None):
+        return None
+    return jnp.dtype(name)
+
+
+def cast_floating(tree, dtype):
+    """Cast float leaves to ``dtype`` (params stay f32 in the optimizer; the
+    cast copy feeds the forward — standard TPU mixed precision, replacing the
+    reference's Apex AMP / DeepSpeed fp16 engine)."""
+    if dtype is None:
+        return tree
+    return jax.tree.map(
+        lambda x: x.astype(dtype)
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+        else x, tree)

@@ -27,14 +27,17 @@ from ..models.dalle import DALLE, init_dalle
 from ..parallel import shard_batch, shard_params
 from .base_trainer import BaseTrainer
 from .metrics import ThroughputMeter, count_params, transformer_train_flops
-from .train_state import TrainState, make_optimizer
+from .train_state import (TrainState, cast_floating, compute_dtype,
+                          make_optimizer)
 
 
 def make_dalle_train_step(model: DALLE, *, null_cond_prob: float = 0.0,
-                          use_dropout: bool = False):
+                          use_dropout: bool = False, dtype=None):
     """Returns step(state, text, image_ids, key) -> (state, metrics). jit-once
     with the state donated; ``null_cond_prob``/``use_dropout`` are compile-time
-    (they select rng wiring)."""
+    (they select rng wiring). ``dtype`` (e.g. bf16) is the compute precision:
+    params are cast inside the step, master copies stay f32 — the TPU-native
+    replacement for the DeepSpeed fp16 engine (SURVEY.md §2.9 Apex AMP row)."""
 
     def loss_fn(params, text, image_ids, key):
         rngs = {}
@@ -42,7 +45,8 @@ def make_dalle_train_step(model: DALLE, *, null_cond_prob: float = 0.0,
             rngs["cfg"] = jax.random.fold_in(key, 0)
         if use_dropout:
             rngs["dropout"] = jax.random.fold_in(key, 1)
-        loss, aux = model.apply(params, text, image_ids, return_loss=True,
+        loss, aux = model.apply(cast_floating(params, dtype), text, image_ids,
+                                return_loss=True,
                                 null_cond_prob=null_cond_prob,
                                 deterministic=not use_dropout,
                                 rngs=rngs or None)
@@ -79,7 +83,8 @@ class DalleTrainer(BaseTrainer):
                                        tx=tx)
         use_dropout = (model_cfg.attn_dropout > 0 or model_cfg.ff_dropout > 0)
         self.step_fn = make_dalle_train_step(
-            self.model, null_cond_prob=null_cond_prob, use_dropout=use_dropout)
+            self.model, null_cond_prob=null_cond_prob, use_dropout=use_dropout,
+            dtype=compute_dtype(train_cfg.precision))
 
         n = count_params(self.state.params)
         self.num_params = n

@@ -29,7 +29,8 @@ from ..models.dvae import DiscreteVAE, init_dvae
 from ..parallel import shard_batch, shard_params
 from .base_trainer import BaseTrainer
 from .metrics import ThroughputMeter, count_params
-from .train_state import TrainState, make_optimizer
+from .train_state import (TrainState, cast_floating, compute_dtype,
+                          make_optimizer)
 
 
 def anneal_temperature(cfg: AnnealConfig, global_step: int) -> float:
@@ -37,14 +38,17 @@ def anneal_temperature(cfg: AnnealConfig, global_step: int) -> float:
                cfg.temp_min)
 
 
-def make_vae_train_step(model: DiscreteVAE):
+def make_vae_train_step(model: DiscreteVAE, dtype=None):
     """Returns step(state, images, key, temp) -> (state, metrics). jit-once;
-    the state is donated so params/moments update in place in HBM."""
+    the state is donated so params/moments update in place in HBM. ``dtype``
+    selects the compute precision (params cast per-step; masters stay f32)."""
 
     def loss_fn(params, images, key, temp):
+        if dtype is not None:
+            images = images.astype(dtype)
         loss, recons = model.apply(
-            params, images, temp=temp, return_loss=True, return_recons=True,
-            rngs={"gumbel": key})
+            cast_floating(params, dtype), images, temp=temp, return_loss=True,
+            return_recons=True, rngs={"gumbel": key})
         return loss, recons
 
     @partial(jax.jit, donate_argnums=(0,))
@@ -79,7 +83,8 @@ class VAETrainer(BaseTrainer):
         tx = make_optimizer(train_cfg.optim)
         self.state = TrainState.create(apply_fn=self.model.apply, params=params,
                                        tx=tx)
-        self.step_fn = make_vae_train_step(self.model)
+        self.step_fn = make_vae_train_step(
+            self.model, dtype=compute_dtype(train_cfg.precision))
 
         n = count_params(self.state.params)
         self.meter = ThroughputMeter(train_cfg.batch_size, train_cfg.log_every,
@@ -96,7 +101,8 @@ class VAETrainer(BaseTrainer):
         self.state, metrics = self.step_fn(self.state, images, key,
                                            jnp.float32(temp))
         metrics = self._finish_step(metrics)
-        metrics["temperature"] = temp
+        if metrics:   # empty when metrics_every skips the host sync this step
+            metrics["temperature"] = temp
         return metrics
 
     # -- eval utilities ----------------------------------------------------

@@ -43,7 +43,7 @@ from ..models.vqgan import VQModel, init_vqgan
 from ..parallel import shard_batch, shard_params
 from .base_trainer import BaseTrainer
 from .metrics import ThroughputMeter, count_params
-from .train_state import make_optimizer
+from .train_state import cast_floating, compute_dtype, make_optimizer
 
 
 class LambdaWarmUpCosineScheduler:
@@ -92,7 +92,8 @@ class GANTrainState:
 
 
 def make_vqgan_train_step(model: VQModel, disc: NLayerDiscriminator,
-                          lpips: Optional[LPIPS], loss_cfg: GANLossConfig):
+                          lpips: Optional[LPIPS], loss_cfg: GANLossConfig,
+                          dtype=None):
     """Returns step(state, images, key, temp) -> (state, metrics) implementing
     both optimizer updates of vqperceptual.py:76-136 in one XLA program."""
     lc = loss_cfg
@@ -107,15 +108,20 @@ def make_vqgan_train_step(model: VQModel, disc: NLayerDiscriminator,
                    key, temp, step):
         # training pass: dropout active, gumbel sampling live (when configured)
         rngs = {"gumbel": key, "dropout": jax.random.fold_in(key, 1)}
-        q = model.apply(gen_params, images, temp=temp, deterministic=False,
+        gen_c = cast_floating(gen_params, dtype)
+        images_c = images if dtype is None else images.astype(dtype)
+        q = model.apply(gen_c, images_c, temp=temp, deterministic=False,
                         method=VQModel.encode, rngs=rngs)
-        recon, h_last = model.apply(gen_params, q.quantized, False, True,
+        recon, h_last = model.apply(gen_c, q.quantized, False, True,
                                     method=VQModel.decode, rngs=rngs)
 
         def nll_of(r):
-            rec = lc.pixelloss_weight * jnp.abs(images - r)
+            # loss reductions in f32 regardless of the compute dtype
+            rec = lc.pixelloss_weight * jnp.abs(
+                images.astype(jnp.float32) - r.astype(jnp.float32))
             p = perceptual(lpips_params, images, r)
-            return jnp.mean(rec) + lc.perceptual_weight * jnp.mean(p)
+            return jnp.mean(rec) + lc.perceptual_weight * jnp.mean(
+                p.astype(jnp.float32))
 
         def g_of(r):
             logits_fake, _ = disc.apply(
@@ -125,7 +131,7 @@ def make_vqgan_train_step(model: VQModel, disc: NLayerDiscriminator,
 
         nll = nll_of(recon)
         g_loss = g_of(recon)
-        conv_out = gen_params["params"]["decoder"]["conv_out"]
+        conv_out = gen_c["params"]["decoder"]["conv_out"]
         d_weight = adaptive_disc_weight(nll_of, g_of, h_last, conv_out,
                                         lc.disc_weight)
         disc_factor = adopt_weight(lc.disc_factor, step, lc.disc_start)
@@ -221,8 +227,9 @@ class VQGANTrainer(BaseTrainer):
             gen_params=gen_params, disc_params=disc_params,
             lpips_params=lpips_params, batch_stats=batch_stats,
             gen_tx=gen_tx, disc_tx=disc_tx)
-        self.step_fn = make_vqgan_train_step(self.model, self.disc, self.lpips,
-                                             self.loss_cfg)
+        self.step_fn = make_vqgan_train_step(
+            self.model, self.disc, self.lpips, self.loss_cfg,
+            dtype=compute_dtype(train_cfg.precision))
         # GumbelVQ temperature schedule, stepped per train step
         # (taming vqgan.py:279-303)
         self.temp_scheduler = temp_scheduler
@@ -245,7 +252,7 @@ class VQGANTrainer(BaseTrainer):
         self.state, metrics = self.step_fn(self.state, images, key,
                                            jnp.float32(temp))
         metrics = self._finish_step(metrics)
-        if self.temp_scheduler is not None:
+        if metrics and self.temp_scheduler is not None:
             metrics["temperature"] = temp
         return metrics
 

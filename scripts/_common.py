@@ -14,29 +14,41 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def load_dvae_adapter(ckpt_dir: str):
-    """Restore a scripts/train_vae.py checkpoint into a DiscreteVAEAdapter."""
+def load_model_checkpoint(ckpt_dir: str, expect_class: str, config_cls,
+                          init_fn):
+    """Generic checkpoint reconstitution from embedded metadata (reference
+    legacy/generate.py:82-106): validate model_class, rebuild the model from
+    ``hparams``, restore params. Returns (model, params, meta)."""
     import jax
-    from dalle_tpu.config import DVAEConfig, OptimConfig, TrainConfig
-    from dalle_tpu.models.dvae import init_dvae
-    from dalle_tpu.models.wrapper import DiscreteVAEAdapter
+    from dalle_tpu.config import OptimConfig
     from dalle_tpu.train.checkpoints import CheckpointManager
     from dalle_tpu.train.train_state import TrainState, make_optimizer
 
     mgr = CheckpointManager(ckpt_dir)
     meta = mgr.load_metadata()
-    if meta is None or meta.get("model_class") != "DiscreteVAE":
-        raise ValueError(f"{ckpt_dir} is not a DiscreteVAE checkpoint "
+    if meta is None or meta.get("model_class") != expect_class:
+        raise ValueError(f"{ckpt_dir} is not a {expect_class} checkpoint "
                          f"(model_class={meta and meta.get('model_class')})")
-    cfg = DVAEConfig.from_dict(meta["hparams"])
+    cfg = config_cls.from_dict(meta["hparams"])
     optim = OptimConfig.from_dict(meta.get("train", {}).get("optim", {})) \
         if meta.get("train") else OptimConfig()
-    model, params = init_dvae(cfg, jax.random.PRNGKey(0))
+    model, params = init_fn(cfg, jax.random.PRNGKey(0))
     template = TrainState.create(apply_fn=model.apply, params=params,
                                  tx=make_optimizer(optim))
     state, _ = mgr.restore(template)
     mgr.close()
-    return DiscreteVAEAdapter(model, state.params)
+    return model, state.params, meta
+
+
+def load_dvae_adapter(ckpt_dir: str):
+    """Restore a scripts/train_vae.py checkpoint into a DiscreteVAEAdapter."""
+    from dalle_tpu.config import DVAEConfig
+    from dalle_tpu.models.dvae import init_dvae
+    from dalle_tpu.models.wrapper import DiscreteVAEAdapter
+
+    model, params, _ = load_model_checkpoint(ckpt_dir, "DiscreteVAE",
+                                             DVAEConfig, init_dvae)
+    return DiscreteVAEAdapter(model, params)
 
 
 def build_vae_from_args(args, backend=None):

@@ -17,7 +17,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from _common import add_vae_args, build_vae_from_args, save_image_grid  # noqa: E402
+from _common import (add_vae_args, build_vae_from_args,  # noqa: E402
+                     load_model_checkpoint, save_image_grid)
 
 
 def build_parser():
@@ -48,24 +49,10 @@ def build_parser():
 def load_dalle(ckpt_dir: str, backend):
     """Rebuild the exact model from checkpoint-embedded hparams (reference
     generate.py:82-106)."""
-    import jax
-    from dalle_tpu.config import DalleConfig, OptimConfig
+    from dalle_tpu.config import DalleConfig
     from dalle_tpu.models.dalle import init_dalle
-    from dalle_tpu.train.checkpoints import CheckpointManager
-    from dalle_tpu.train.train_state import TrainState, make_optimizer
 
-    mgr = CheckpointManager(ckpt_dir)
-    meta = mgr.load_metadata()
-    if meta is None or meta.get("model_class") != "DALLE":
-        raise ValueError(f"{ckpt_dir} is not a DALLE checkpoint")
-    cfg = DalleConfig.from_dict(meta["hparams"])
-    optim = OptimConfig.from_dict(meta.get("train", {}).get("optim", {}))
-    model, params = init_dalle(cfg, jax.random.PRNGKey(0))
-    template = TrainState.create(apply_fn=model.apply, params=params,
-                                 tx=make_optimizer(optim))
-    state, _ = mgr.restore(template)
-    mgr.close()
-    return model, state.params, meta
+    return load_model_checkpoint(ckpt_dir, "DALLE", DalleConfig, init_dalle)
 
 
 def main(argv=None):

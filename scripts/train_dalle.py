@@ -159,7 +159,7 @@ def main(argv=None):
         batches = (encode_batch(imgs, caps) for imgs, caps in raw)
     elif args.wds:
         from dalle_tpu.data.webdataset import WebDataset
-        wds = (WebDataset(args.wds, shuffle_shards=True, repeat=True,
+        wds = (WebDataset(args.wds, shuffle_shards=True, repeat=args.epochs,
                           seed=args.seed)
                .decode(image_size=args.image_size)
                .map(lambda s: (next(s[k] for k in ("jpg", "jpeg", "png")

@@ -1,0 +1,121 @@
+"""Aux-subsystem parity: signal-triggered checkpoint, profiler step, metrics
+logger, segmentation BCE losses (SURVEY.md §5)."""
+
+import json
+import os
+import signal
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_tpu.config import DVAEConfig, MeshConfig, OptimConfig, TrainConfig
+from dalle_tpu.models.gan import bce_loss, bce_with_quant_loss
+from dalle_tpu.train.metrics import MetricsLogger
+from dalle_tpu.train.trainer_vae import VAETrainer
+
+SMALL = DVAEConfig(image_size=16, num_tokens=32, codebook_dim=16, num_layers=2,
+                   hidden_dim=8, num_resnet_blocks=0)
+
+
+def _trainer(tmp_path, **tc_kw):
+    tc_kw.setdefault("optim", OptimConfig(learning_rate=1e-3))
+    tc = TrainConfig(batch_size=8, log_every=1000, save_every_steps=10_000,
+                     checkpoint_dir=str(tmp_path / "ckpt"),
+                     preflight_checkpoint=False, mesh=MeshConfig(dp=8),
+                     **tc_kw)
+    return VAETrainer(SMALL, tc)
+
+
+def _batches(n):
+    rng = np.random.RandomState(0)
+    img = rng.rand(8, 16, 16, 3).astype(np.float32)
+    return [(img,) for _ in range(n)]
+
+
+def test_sigusr1_triggers_checkpoint(tmp_path):
+    tr = _trainer(tmp_path)
+    tr.install_signal_checkpoint(log=lambda *_: None)
+    assert tr.ckpt.latest_step() is None
+    os.kill(os.getpid(), signal.SIGUSR1)   # flag is set; save at next boundary
+    tr.fit(_batches(2), steps=2, log=lambda *_: None)
+    assert tr.ckpt.latest_step() == 1      # saved at the first step boundary
+
+
+def test_profile_step_writes_trace(tmp_path):
+    tr = _trainer(tmp_path, profile_step=2)
+    lines = []
+    tr.fit(_batches(3), steps=3, log=lines.append)
+    prof_dir = str(tmp_path / "ckpt" / "profile_step2")
+    assert os.path.isdir(prof_dir)
+    assert any(f for _r, _d, f in os.walk(prof_dir) if f), "empty trace dir"
+    assert any("[profile]" in l for l in lines)
+
+
+def test_metrics_logger_jsonl(tmp_path):
+    path = str(tmp_path / "metrics.jsonl")
+    ml = MetricsLogger(path)
+    ml.log(1, {"loss": 0.5, "ignored": object()})
+    ml.log(2, {"loss": 0.25, "note": "ok"})
+    ml.close()
+    recs = [json.loads(l) for l in open(path)]
+    assert [r["step"] for r in recs] == [1, 2]
+    assert recs[1]["loss"] == 0.25 and recs[1]["note"] == "ok"
+    assert "ignored" not in recs[0]
+
+
+def test_metrics_logger_wired_into_fit(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    tr = _trainer(tmp_path)
+    tr.fit(_batches(3), steps=3, log=lambda *_: None,
+           metrics_writer=MetricsLogger(path))
+    recs = [json.loads(l) for l in open(path)]
+    assert len(recs) == 3 and "loss" in recs[0]
+
+
+def test_bce_losses():
+    logits = jnp.array([[10.0, -10.0], [10.0, -10.0]])
+    targets = jnp.array([[1.0, 0.0], [1.0, 0.0]])
+    assert float(bce_loss(logits, targets)) == pytest.approx(0.0, abs=1e-3)
+    # wrong predictions are strongly penalized
+    assert float(bce_loss(-logits, targets)) > 5.0
+    total, parts = bce_with_quant_loss(logits, targets, jnp.float32(0.3),
+                                       codebook_weight=2.0)
+    assert float(total) == pytest.approx(float(parts["bce_loss"]) + 0.6, abs=1e-4)
+
+
+def test_metrics_every_skips_host_sync(tmp_path):
+    from dalle_tpu.config import DVAEConfig
+    tr = _trainer(tmp_path, metrics_every=3)
+    out = [tr.train_step(*b) for b in _batches(6)]
+    # only steps 3 and 6 fetch metrics; others return {}
+    assert [bool(m) for m in out] == [False, False, True, False, False, True]
+    assert "loss" in out[2]
+
+
+def test_bf16_compute_trains_and_keeps_f32_masters(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    tr = _trainer(tmp_path, optim=OptimConfig(learning_rate=3e-3))
+    first = None
+    for b in _batches(40):
+        m = tr.train_step(*b)
+        if m:
+            first = first if first is not None else m["loss"]
+            last = m["loss"]
+    assert last < first                      # descends under bf16 compute
+    dtypes = {x.dtype for x in jax.tree.leaves(tr.state.params)}
+    assert dtypes == {jnp.dtype("float32")}  # master params stay f32
+
+
+def test_attend_softmax_dtype_flag():
+    import jax
+    import jax.numpy as jnp
+    from dalle_tpu.ops.attention import attend
+    q = jax.random.normal(jax.random.PRNGKey(0), (2, 2, 16, 8), jnp.bfloat16)
+    a32 = attend(q, q, q, causal=True, softmax_f32=True)
+    a16 = attend(q, q, q, causal=True, softmax_f32=False)
+    assert a32.dtype == a16.dtype == jnp.bfloat16
+    # numerically close; not identical (different accumulation width)
+    diff = jnp.abs(a32.astype(jnp.float32) - a16.astype(jnp.float32)).max()
+    assert float(diff) < 0.05

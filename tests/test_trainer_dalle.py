@@ -8,7 +8,8 @@ import jax
 import numpy as np
 import pytest
 
-from dalle_tpu.config import DalleConfig, MeshConfig, OptimConfig, TrainConfig
+from dalle_tpu.config import (DalleConfig, MeshConfig, OptimConfig,
+                              PrecisionConfig, TrainConfig)
 from dalle_tpu.parallel.mesh import build_mesh
 from dalle_tpu.train.trainer_dalle import DalleTrainer
 
@@ -43,8 +44,11 @@ def test_sharded_step_matches_single_device(tmp_path, rng):
                            ("single", MeshConfig())]:
         mesh = (build_mesh(mesh_cfg) if name == "multi"
                 else build_mesh(mesh_cfg, devices=jax.devices()[:1]))
+        # f32 compute: this test checks that sharding does not change the
+        # math, so keep precision noise out of the comparison
         tc = TrainConfig(batch_size=8, checkpoint_dir=str(tmp_path / name),
-                         preflight_checkpoint=False, mesh=mesh_cfg)
+                         preflight_checkpoint=False, mesh=mesh_cfg,
+                         precision=PrecisionConfig(compute="float32"))
         tr = DalleTrainer(TINY, tc, mesh=mesh)
         results[name] = [tr.train_step(text, ids)["loss"] for _ in range(3)]
     np.testing.assert_allclose(results["multi"], results["single"],
