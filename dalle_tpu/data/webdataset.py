@@ -96,7 +96,7 @@ def iter_tar_samples(url: str, handler: Callable[[Exception], bool]
         for member in tf:
             if not member.isfile():
                 continue
-            dirpart, _, fname = member.name.lstrip("./").rpartition("/")
+            dirpart, _, fname = member.name.removeprefix("./").rpartition("/")
             base, _, ext = fname.partition(".")
             if dirpart:
                 base = dirpart + "/" + base
@@ -312,7 +312,14 @@ class _Prefetcher:
             except BaseException as e:  # noqa: BLE001 - surfaced to consumer
                 self.error = e
             finally:
-                self.q.put(self._DONE)
+                # bounded: a close()d consumer will never drain the queue, so
+                # an unconditional put could block this thread forever
+                while not self._stop:
+                    try:
+                        self.q.put(self._DONE, timeout=0.5)
+                        break
+                    except queue.Full:
+                        continue
 
         self.thread = threading.Thread(target=run, daemon=True)
         self.thread.start()
@@ -324,8 +331,8 @@ class _Prefetcher:
         try:
             while True:
                 self.q.get_nowait()
-        except queue.Empty:
-            pass
+        except Exception:   # queue.Empty — broad because __del__ may run at
+            pass            # interpreter shutdown when the module is torn down
 
     def __del__(self):
         self.close()

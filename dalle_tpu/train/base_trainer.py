@@ -67,21 +67,18 @@ class BaseTrainer:
         self._signal_save = False
         signal.signal(signal.SIGUSR1, handler)
 
-    def _maybe_profile(self, step_num: int, m: dict, log):
-        """jax.profiler trace + MFU line at ``profile_step`` — the stand-in for
-        the reference's DeepSpeed flops profile at step 200
-        (legacy/train_dalle.py:492-499,656-657)."""
-        tc = self.train_cfg
-        if not tc.profile_step or step_num != tc.profile_step:
-            return
-        import jax
-        logdir = f"{tc.checkpoint_dir}/profile_step{step_num}"
-        with jax.profiler.trace(logdir):
-            m2 = self.train_step(*self._last_batch)
-        rep = self.meter._last_report or {}
-        log(f"[profile] step {step_num}: trace → {logdir}; "
-            + " ".join(f"{k}={v:.5g}" for k, v in {**m, **m2, **rep}.items()
-                       if isinstance(v, (int, float))))
+    def _fetch_pending_metrics(self) -> dict:
+        """Host-fetch the most recent step's device metrics (used when a save
+        boundary lands on a metrics-skipped step: nothing may be checkpointed
+        without a NaN check)."""
+        if getattr(self, "_pending_metrics", None) is None:
+            return {}
+        metrics = {k: float(v) for k, v in
+                   jax.device_get(self._pending_metrics).items()}
+        rep = self.meter.step(self._host_step)
+        if rep:
+            metrics.update(rep)
+        return metrics
 
     def fit(self, batches, *, steps: Optional[int] = None, log=print,
             sample_fn: Optional[Callable[[int], None]] = None,
@@ -94,9 +91,23 @@ class BaseTrainer:
             self.ckpt.preflight(self.state, meta)
         self._snapshot_good()
         for batch in batches:
-            self._last_batch = batch
-            m = self.train_step(*batch)
+            # profile the REAL next step at profile_step — no hidden extra
+            # update (the reference's flops profile also wraps a live step,
+            # legacy/train_dalle.py:492-499)
+            if tc.profile_step and self._host_step + 1 == tc.profile_step:
+                logdir = f"{tc.checkpoint_dir}/profile_step{tc.profile_step}"
+                with jax.profiler.trace(logdir):
+                    m = self.train_step(*batch)
+                log(f"[profile] step {self._host_step}: trace → {logdir}")
+            else:
+                m = self.train_step(*batch)
             step_num = self._host_step
+            # latch the signal flag ONCE per iteration; a save decision must
+            # see the same value the metrics-fetch decision does
+            want_save = (step_num % tc.save_every_steps == 0 or
+                         getattr(self, "_signal_save", False))
+            if not m and want_save:
+                m = self._fetch_pending_metrics()
             nan = bool(m) and tc.nan_rollback and not math.isfinite(m["loss"])
             if nan:
                 log(f"[step {step_num}] NaN loss — rolling back to last good state")
@@ -107,15 +118,13 @@ class BaseTrainer:
                         " ".join(f"{k}={v:.5g}" for k, v in m.items()))
                 if m and metrics_writer is not None:
                     metrics_writer.log(step_num, m)
-                if step_num % tc.save_every_steps == 0 or \
-                        getattr(self, "_signal_save", False):
+                if want_save:
                     self.ckpt.save(step_num, self.state, meta)
                     self._snapshot_good()
                     self._signal_save = False
                 if getattr(tc, "sample_every_steps", 0) and sample_fn and \
                         step_num % tc.sample_every_steps == 0:
                     sample_fn(step_num)
-                self._maybe_profile(step_num, m, log)
             # the steps budget must bound the loop even when steps go NaN
             if steps is not None and step_num >= steps:
                 break
@@ -145,12 +154,10 @@ class BaseTrainer:
         stalls the step pipeline) only happens every N steps; other steps
         return an empty dict and fit() skips their NaN check / logging."""
         self._host_step += 1
+        self._pending_metrics = metrics   # fit() fetches these on demand at
+                                          # save boundaries (NaN-check gate)
         every = max(getattr(self.train_cfg, "metrics_every", 1), 1)
-        # always fetch on save boundaries: a checkpoint/_snapshot_good must
-        # never capture a state whose loss was not NaN-checked
-        save_boundary = (self._host_step % self.train_cfg.save_every_steps == 0
-                         or getattr(self, "_signal_save", False))
-        if self._host_step % every != 0 and not save_boundary:
+        if self._host_step % every != 0:
             return {}
         metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
         rep = self.meter.step(self._host_step)
