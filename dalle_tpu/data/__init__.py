@@ -1,1 +1,7 @@
 from .synthetic import ShapesDataset, batch_iterator, render, SHAPES, COLORS, SCALES
+from .text_image import TextImageDataset
+from .webdataset import WebDataset, expand_shards, write_shards, warn_and_continue
+from .loaders import ImageFolderDataset, ImagePaths, Token, load_labels, batch_arrays
+from .taming_datasets import (NumpyPaths, CustomTrain, CustomTest, ImageNetTrain,
+                              ImageNetValidation, CocoCaptions, ADE20k, SFLCKR,
+                              FacesHQ)

@@ -1,0 +1,211 @@
+"""taming-transformers dataset family — file-based TPU-host equivalents.
+
+Reference: ``dalle_pytorch/taming/data/`` — ``ImagePaths``/``NumpyPaths``
+(base.py:23-89), custom file-list train/test (custom.py), ImageNet with synset
+subdirs (imagenet.py), COCO images+captions/segmentation (coco.py),
+CelebAHQ/FFHQ ("FacesHQ", faceshq.py), ADE20k (ade20k.py), SFLCKR (sflckr.py).
+
+Redesign notes: the reference's versions embed *download/untar* logic (dead
+code in-package — its absolute ``taming.*`` imports don't resolve, SURVEY.md
+§2.7) and albumentations transforms. Here each dataset is a thin host-side
+index over **already-extracted local files** with the same item contract:
+``{"image": float32 HWC in [−1, 1], ...extras}``. No network, no torch.
+Batching goes through ``loaders.batch_arrays`` or the WebDataset pipeline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .loaders import ImagePaths, _load_image
+
+IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".webp")
+
+
+class NumpyPaths(ImagePaths):
+    """.npy image arrays (HWC uint8) instead of encoded files
+    (taming/data/base.py:73-89)."""
+
+    def __getitem__(self, i: int):
+        arr = np.load(self.paths[i])
+        if arr.ndim == 2:
+            arr = np.stack([arr] * 3, axis=-1)
+        arr = arr.astype(np.float32)
+        if arr.max() > 1.0:
+            arr = arr / 255.0
+        # resize via PIL for parity with the image path
+        from PIL import Image
+        img = Image.fromarray((arr * 255).astype(np.uint8))
+        img = img.resize((self.size, self.size), Image.BILINEAR)
+        out = {"image": np.asarray(img, np.float32) / 127.5 - 1.0}
+        for k, v in self.labels.items():
+            out[k] = v[i]
+        return out
+
+
+def _read_list(path: str) -> List[str]:
+    with open(path) as f:
+        return [l.strip() for l in f if l.strip()]
+
+
+class CustomBase:
+    """File-list dataset (taming/data/custom.py): a txt file of image paths."""
+
+    def __init__(self, size: int, images_list_file: str):
+        self.data = ImagePaths(_read_list(images_list_file), size=size)
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i: int):
+        return self.data[i]
+
+
+class CustomTrain(CustomBase):
+    def __init__(self, size: int, training_images_list_file: str):
+        super().__init__(size, training_images_list_file)
+
+
+class CustomTest(CustomBase):
+    def __init__(self, size: int, test_images_list_file: str):
+        super().__init__(size, test_images_list_file)
+
+
+class ImageNetBase:
+    """Synset-subdir layout ``root/nXXXXXXXX/*.JPEG`` → items with
+    ``class_label``/``human_label`` (taming/data/imagenet.py semantics without
+    the download/untar machinery — point ``root`` at an extracted tree)."""
+
+    def __init__(self, root: str, size: int = 256,
+                 synset_to_human: Optional[Dict[str, str]] = None):
+        self.size = size
+        root_p = Path(root)
+        synsets = sorted(d.name for d in root_p.iterdir() if d.is_dir())
+        if not synsets:
+            raise ValueError(f"no synset subdirectories under {root}")
+        self.synset_to_idx = {s: i for i, s in enumerate(synsets)}
+        self.synset_to_human = synset_to_human or {}
+        self.items: List[tuple] = []
+        for s in synsets:
+            for p in sorted((root_p / s).iterdir()):
+                if p.suffix.lower() in IMAGE_EXTS + (".jpeg",):
+                    self.items.append((p, s))
+
+    def __len__(self):
+        return len(self.items)
+
+    def __getitem__(self, i: int):
+        path, synset = self.items[i]
+        img = _load_image(path, self.size, to_unit_interval=False)
+        return {"image": img, "class_label": self.synset_to_idx[synset],
+                "synset": synset,
+                "human_label": self.synset_to_human.get(synset, synset)}
+
+
+class ImageNetTrain(ImageNetBase):
+    pass
+
+
+class ImageNetValidation(ImageNetBase):
+    pass
+
+
+class CocoCaptions:
+    """COCO-style images + captions json (taming/data/coco.py capability:
+    items carry image + caption; segmentation variant below). ``annotations``
+    is a COCO ``captions_*.json`` file."""
+
+    def __init__(self, images_root: str, annotations: str, size: int = 256):
+        self.size = size
+        self.root = Path(images_root)
+        with open(annotations) as f:
+            ann = json.load(f)
+        files = {im["id"]: im["file_name"] for im in ann["images"]}
+        caps: Dict[int, List[str]] = {}
+        for a in ann["annotations"]:
+            caps.setdefault(a["image_id"], []).append(a["caption"])
+        self.items = [(files[i], caps.get(i, [""])) for i in sorted(files)
+                      if (self.root / files[i]).exists()]
+
+    def __len__(self):
+        return len(self.items)
+
+    def __getitem__(self, i: int):
+        fname, captions = self.items[i]
+        img = _load_image(self.root / fname, self.size, to_unit_interval=False)
+        # random-caption-per-access, like TextImageDataset (loader.py:77-81)
+        cap = captions[np.random.randint(len(captions))]
+        return {"image": img, "caption": cap, "all_captions": captions}
+
+
+class SegmentationPairs:
+    """Image + per-pixel label-map pairs — the shared shape of the reference's
+    ADE20k (ade20k.py) and SFLCKR (sflckr.py) datasets: parallel directories
+    of images and PNG segmentation masks matched by stem."""
+
+    def __init__(self, images_root: str, masks_root: str, size: int = 256,
+                 n_labels: int = 151):
+        self.size = size
+        self.n_labels = n_labels
+        imgs = {p.stem: p for p in Path(images_root).rglob("*")
+                if p.suffix.lower() in IMAGE_EXTS}
+        masks = {p.stem: p for p in Path(masks_root).rglob("*.png")}
+        keys = sorted(imgs.keys() & masks.keys())
+        if not keys:
+            raise ValueError("no image/mask stem matches")
+        self.pairs = [(imgs[k], masks[k]) for k in keys]
+
+    def __len__(self):
+        return len(self.pairs)
+
+    def __getitem__(self, i: int):
+        from PIL import Image
+        img_p, mask_p = self.pairs[i]
+        img = _load_image(img_p, self.size, to_unit_interval=False)
+        mask = Image.open(mask_p).resize((self.size, self.size), Image.NEAREST)
+        seg = np.asarray(mask, np.int32)
+        if seg.ndim == 3:
+            seg = seg[..., 0]
+        onehot = np.eye(self.n_labels, dtype=np.float32)[
+            np.clip(seg, 0, self.n_labels - 1)]
+        return {"image": img, "segmentation": onehot, "mask": seg}
+
+
+class ADE20k(SegmentationPairs):
+    """151-class scene parsing (taming/data/ade20k.py)."""
+
+
+class SFLCKR(SegmentationPairs):
+    """Landscape segmentation conditioning (taming/data/sflckr.py)."""
+
+    def __init__(self, images_root, masks_root, size=256, n_labels=182):
+        super().__init__(images_root, masks_root, size, n_labels)
+
+
+class FacesHQ:
+    """CelebAHQ + FFHQ concatenated (taming/data/faceshq.py FacesHQTrain):
+    two file lists with a ``class`` flag distinguishing the sources."""
+
+    def __init__(self, celeba_list: Optional[str] = None,
+                 ffhq_list: Optional[str] = None, size: int = 256):
+        paths: List[str] = []
+        labels: List[int] = []
+        for cls, lst in enumerate((celeba_list, ffhq_list)):
+            if lst:
+                p = _read_list(lst)
+                paths.extend(p)
+                labels.extend([cls] * len(p))
+        if not paths:
+            raise ValueError("provide at least one of celeba_list/ffhq_list")
+        self.data = ImagePaths(paths, size=size, labels={"class": labels})
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i: int):
+        return self.data[i]

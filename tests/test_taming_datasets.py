@@ -1,0 +1,93 @@
+"""taming dataset family: item contracts over synthetic local file trees."""
+
+import json
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from dalle_tpu.data.taming_datasets import (ADE20k, CocoCaptions, CustomTest,
+                                            CustomTrain, FacesHQ,
+                                            ImageNetTrain, NumpyPaths)
+
+
+def _png(path, size=(20, 14), color=(120, 30, 30)):
+    path.parent.mkdir(parents=True, exist_ok=True)
+    Image.new("RGB", size, color).save(path)
+
+
+class TestCustom:
+    def test_file_list(self, tmp_path):
+        for i in range(3):
+            _png(tmp_path / f"im{i}.png")
+        lst = tmp_path / "train.txt"
+        lst.write_text("\n".join(str(tmp_path / f"im{i}.png") for i in range(3)))
+        ds = CustomTrain(size=8, training_images_list_file=str(lst))
+        assert len(ds) == 3
+        item = ds[0]
+        assert item["image"].shape == (8, 8, 3)
+        assert -1.0 <= item["image"].min() and item["image"].max() <= 1.0
+        assert len(CustomTest(8, str(lst))) == 3
+
+
+def test_numpy_paths(tmp_path):
+    arr = (np.random.RandomState(0).rand(12, 12, 3) * 255).astype(np.uint8)
+    np.save(tmp_path / "a.npy", arr)
+    ds = NumpyPaths([str(tmp_path / "a.npy")], size=8)
+    item = ds[0]
+    assert item["image"].shape == (8, 8, 3)
+    assert item["image"].min() >= -1.0 and item["image"].max() <= 1.0
+
+
+def test_imagenet_synsets(tmp_path):
+    for s, n in (("n01440764", 2), ("n01443537", 1)):
+        for i in range(n):
+            _png(tmp_path / s / f"{s}_{i}.JPEG".replace("JPEG", "jpeg"))
+    ds = ImageNetTrain(str(tmp_path), size=8,
+                       synset_to_human={"n01440764": "tench"})
+    assert len(ds) == 3
+    item = ds[0]
+    assert item["class_label"] == 0 and item["human_label"] == "tench"
+    assert item["image"].shape == (8, 8, 3)
+
+
+def test_coco_captions(tmp_path):
+    imgs = tmp_path / "images"
+    _png(imgs / "0001.jpg")
+    _png(imgs / "0002.jpg")
+    ann = {"images": [{"id": 1, "file_name": "0001.jpg"},
+                      {"id": 2, "file_name": "0002.jpg"}],
+           "annotations": [{"image_id": 1, "caption": "a red thing"},
+                           {"image_id": 1, "caption": "another view"},
+                           {"image_id": 2, "caption": "a second image"}]}
+    (tmp_path / "captions.json").write_text(json.dumps(ann))
+    ds = CocoCaptions(str(imgs), str(tmp_path / "captions.json"), size=8)
+    assert len(ds) == 2
+    item = ds[0]
+    assert item["caption"] in item["all_captions"]
+    assert len(ds[0]["all_captions"]) == 2
+
+
+def test_ade20k_segmentation(tmp_path):
+    _png(tmp_path / "img" / "scene1.jpg")
+    mask = Image.fromarray(np.full((10, 10), 7, np.uint8))
+    (tmp_path / "ann").mkdir()
+    mask.save(tmp_path / "ann" / "scene1.png")
+    ds = ADE20k(str(tmp_path / "img"), str(tmp_path / "ann"), size=8)
+    item = ds[0]
+    assert item["segmentation"].shape == (8, 8, 151)
+    assert (item["mask"] == 7).all()
+    assert item["segmentation"][0, 0, 7] == 1.0
+
+
+def test_faceshq_mix(tmp_path):
+    for i in range(2):
+        _png(tmp_path / f"celeb{i}.png")
+        _png(tmp_path / f"ffhq{i}.png")
+    cl = tmp_path / "celeba.txt"
+    fl = tmp_path / "ffhq.txt"
+    cl.write_text("\n".join(str(tmp_path / f"celeb{i}.png") for i in range(2)))
+    fl.write_text("\n".join(str(tmp_path / f"ffhq{i}.png") for i in range(2)))
+    ds = FacesHQ(str(cl), str(fl), size=8)
+    assert len(ds) == 4
+    assert ds[0]["class"] == 0 and ds[3]["class"] == 1
