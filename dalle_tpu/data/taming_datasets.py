@@ -22,9 +22,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from .loaders import ImagePaths, _load_image
-
-IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".webp")
+from .loaders import IMAGE_EXTS, ImagePaths, _load_image
 
 
 class NumpyPaths(ImagePaths):
@@ -35,14 +33,20 @@ class NumpyPaths(ImagePaths):
         arr = np.load(self.paths[i])
         if arr.ndim == 2:
             arr = np.stack([arr] * 3, axis=-1)
-        arr = arr.astype(np.float32)
-        if arr.max() > 1.0:
-            arr = arr / 255.0
-        # resize via PIL for parity with the image path
+        # dtype decides the scale (a max()>1 heuristic mis-scales dark uint8)
+        if np.issubdtype(arr.dtype, np.integer):
+            u8 = arr.astype(np.uint8)
+        else:
+            u8 = (np.clip(arr, 0.0, 1.0) * 255).astype(np.uint8)
+        # shorter-side resize + center crop through the SAME loader as the
+        # file path, so .npy and encoded images are pixel-identical
         from PIL import Image
-        img = Image.fromarray((arr * 255).astype(np.uint8))
-        img = img.resize((self.size, self.size), Image.BILINEAR)
-        out = {"image": np.asarray(img, np.float32) / 127.5 - 1.0}
+        import io
+        buf = io.BytesIO()
+        Image.fromarray(u8).save(buf, format="PNG")
+        buf.seek(0)
+        img = _load_image(buf, self.size, to_unit_interval=False)
+        out = {"image": img}
         for k, v in self.labels.items():
             out[k] = v[i]
         return out
@@ -93,7 +97,7 @@ class ImageNetBase:
         self.items: List[tuple] = []
         for s in synsets:
             for p in sorted((root_p / s).iterdir()):
-                if p.suffix.lower() in IMAGE_EXTS + (".jpeg",):
+                if p.suffix.lower() in IMAGE_EXTS:
                     self.items.append((p, s))
 
     def __len__(self):

@@ -152,10 +152,11 @@ def adaptive_disc_weight(nll_of_recon, g_of_recon, h_last, conv_out_params,
 
 
 def bce_loss(logits, targets):
-    """Per-pixel sigmoid BCE, summed over pixels and averaged over batch —
-    ``BCELoss`` (taming/modules/losses/segmentation.py:4-11)."""
+    """Sigmoid BCE, MEAN over all elements — torch
+    ``binary_cross_entropy_with_logits`` default, as ``BCELoss`` uses it
+    (taming/modules/losses/segmentation.py:4-11)."""
     per = jax.nn.softplus(logits) - logits * targets
-    return jnp.sum(per) / logits.shape[0]
+    return jnp.mean(per)
 
 
 def bce_with_quant_loss(logits, targets, codebook_loss,

@@ -24,6 +24,9 @@ def gumbel_softmax(key: jax.Array, logits: jnp.ndarray, tau: float,
     """Differentiable sample from a categorical relaxation (torch F.gumbel_softmax
     semantics, used by the dVAE at dalle_pytorch.py:229)."""
     g = jax.random.gumbel(key, logits.shape, dtype=logits.dtype)
+    # tau arrives as a traced f32 scalar; dividing in f32 would silently
+    # promote a bf16 compute path back to full width
+    tau = jnp.asarray(tau, logits.dtype)
     y_soft = jax.nn.softmax((logits + g) / tau, axis=axis)
     if not hard:
         return y_soft

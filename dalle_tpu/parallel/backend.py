@@ -248,6 +248,11 @@ class DummyBackend(DistributedBackend):
 BACKENDS = {
     JaxBackend.BACKEND_NAME.lower(): JaxBackend,
     DummyBackend.BACKEND_NAME.lower(): DummyBackend,
+    # reference CLI names (distributed_utils.py:22-26): the GPU engines don't
+    # exist on TPU — both map onto the jax mesh backend, which covers their
+    # used surface (allreduce/barrier/rank queries/distribute)
+    "deepspeed": JaxBackend,
+    "horovod": JaxBackend,
 }
 
 is_distributed: Optional[bool] = None
@@ -258,7 +263,8 @@ def wrap_arg_parser(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument(
         "--distributed_backend", "--distr_backend", type=str, default=None,
         help=f"which distributed backend to use: {list(BACKENDS)}")
-    for cls in BACKENDS.values():
+    # aliases map several names onto one class — add each class's flags once
+    for cls in dict.fromkeys(BACKENDS.values()):
         cls().wrap_arg_parser(parser)
     return parser
 
@@ -269,6 +275,10 @@ def set_backend_from_args(args) -> DistributedBackend:
     name = (getattr(args, "distributed_backend", None) or "dummy").lower()
     if name not in BACKENDS:
         raise ValueError(f"unknown distributed backend {name!r}; options: {list(BACKENDS)}")
+    if (BACKENDS[name] is JaxBackend
+            and name != JaxBackend.BACKEND_NAME.lower()):
+        print(f"[distributed] backend {name!r} is a GPU engine; using the "
+              f"TPU-native jax mesh backend (same collective surface)")
     backend = BACKENDS[name]()
     if not backend.has_backend():
         raise ModuleNotFoundError(f"backend {name} is not available")

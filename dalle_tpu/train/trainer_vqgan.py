@@ -37,13 +37,15 @@ import optax
 
 from ..config import TrainConfig, VQGANConfig
 from ..models.gan import (GANLossConfig, NLayerDiscriminator, adaptive_disc_weight,
-                          adopt_weight, hinge_d_loss, vanilla_d_loss)
+                          adopt_weight, bce_with_quant_loss, hinge_d_loss,
+                          vanilla_d_loss)
 from ..models.lpips import LPIPS, init_lpips
 from ..models.vqgan import VQModel, init_vqgan
 from ..parallel import shard_batch, shard_params
 from .base_trainer import BaseTrainer
 from .metrics import ThroughputMeter, count_params
-from .train_state import cast_floating, compute_dtype, make_optimizer
+from .train_state import (TrainState, cast_floating, compute_dtype,
+                          make_optimizer)
 
 
 class LambdaWarmUpCosineScheduler:
@@ -188,18 +190,68 @@ def make_vqgan_train_step(model: VQModel, disc: NLayerDiscriminator,
     return step
 
 
+def make_vq_simple_train_step(model: VQModel, loss_cfg: GANLossConfig,
+                              mode: str, dtype=None):
+    """Single-optimizer VQ variants (taming vqgan.py:159-258):
+    ``nodisc`` — L1 recon + codebook loss (VQNoDiscModel);
+    ``segmentation`` — BCE over label-map logits + codebook loss
+    (VQSegmentationModel with BCELossWithQuant)."""
+    lc = loss_cfg
+
+    def loss_fn(params, images, targets, key, temp):
+        rngs = {"gumbel": key, "dropout": jax.random.fold_in(key, 1)}
+        p = cast_floating(params, dtype)
+        x = images if dtype is None else images.astype(dtype)
+        recon, qloss, _ = model.apply(p, x, temp=temp, deterministic=False,
+                                      rngs=rngs)
+        recon32 = recon.astype(jnp.float32)
+        if mode == "segmentation":
+            loss, parts = bce_with_quant_loss(recon32, targets, qloss,
+                                              lc.codebook_weight)
+            return loss, {"nll_loss": parts["bce_loss"], "quant_loss": qloss}
+        rec = jnp.mean(jnp.abs(targets - recon32)) * lc.pixelloss_weight
+        return rec + lc.codebook_weight * qloss, {"nll_loss": rec,
+                                                  "quant_loss": qloss}
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(state: TrainState, images, targets, key, temp):
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, images, targets, key, temp)
+        state = state.apply_gradients(grads)
+        return state, {"loss": loss, **aux}
+
+    return step
+
+
 class VQGANTrainer(BaseTrainer):
     model_class = "VQModel"
 
     def __init__(self, model_cfg: VQGANConfig, train_cfg: TrainConfig,
                  loss_cfg: Optional[GANLossConfig] = None, mesh=None,
                  backend=None, disc_optim=None,
-                 temp_scheduler: Optional[Callable[[int], float]] = None):
+                 temp_scheduler: Optional[Callable[[int], float]] = None,
+                 loss_mode: str = "gan"):
+        """``loss_mode``: "gan" (VQModel/GumbelVQ adversarial training),
+        "nodisc" (VQNoDiscModel), or "segmentation" (VQSegmentationModel —
+        set cfg.out_ch to the label count)."""
         super().__init__(train_cfg, mesh=mesh, backend=backend)
         self.model_cfg = model_cfg
         self.loss_cfg = loss_cfg or GANLossConfig()
+        assert loss_mode in ("gan", "nodisc", "segmentation"), loss_mode
+        self.loss_mode = loss_mode
 
         self.model, gen_params = init_vqgan(model_cfg, self.base_key)
+        if loss_mode != "gan":
+            gen_params = shard_params(self.mesh, gen_params)
+            tx = make_optimizer(train_cfg.optim)
+            self.state = TrainState.create(apply_fn=self.model.apply,
+                                           params=gen_params, tx=tx)
+            self.step_fn = make_vq_simple_train_step(
+                self.model, self.loss_cfg, loss_mode,
+                dtype=compute_dtype(train_cfg.precision))
+            self.disc = self.lpips = None
+            self._finish_init(temp_scheduler)
+            return
         self.disc = NLayerDiscriminator(ndf=self.loss_cfg.disc_ndf,
                                         n_layers=self.loss_cfg.disc_num_layers,
                                         use_actnorm=self.loss_cfg.use_actnorm)
@@ -230,25 +282,36 @@ class VQGANTrainer(BaseTrainer):
         self.step_fn = make_vqgan_train_step(
             self.model, self.disc, self.lpips, self.loss_cfg,
             dtype=compute_dtype(train_cfg.precision))
+        self._finish_init(temp_scheduler)
+
+    def _finish_init(self, temp_scheduler):
+        """Shared tail for both modes: temperature schedule + meter."""
         # GumbelVQ temperature schedule, stepped per train step
         # (taming vqgan.py:279-303)
         self.temp_scheduler = temp_scheduler
-        if self.temp_scheduler is None and model_cfg.quantizer == "gumbel":
+        if self.temp_scheduler is None and self.model_cfg.quantizer == "gumbel":
             self.temp_scheduler = LambdaWarmUpCosineScheduler(
-                0, 1e-6, 1.0, 1.0, train_cfg.optim.total_steps)
-
-        n = count_params(self.state.params["gen"])
+                0, 1e-6, 1.0, 1.0, self.train_cfg.optim.total_steps)
+        n = count_params(self._gen_params)
         self.meter = ThroughputMeter(
-            train_cfg.batch_size, train_cfg.log_every,
-            flops_per_step=6.0 * n * train_cfg.batch_size,
+            self.train_cfg.batch_size, self.train_cfg.log_every,
+            flops_per_step=6.0 * n * self.train_cfg.batch_size,
             num_chips=self.mesh.size)
 
-    def train_step(self, images: np.ndarray, _labels=None):
+    def train_step(self, images: np.ndarray, targets=None):
+        """``targets``: segmentation one-hots for loss_mode="segmentation";
+        defaults to the images themselves for "nodisc"."""
         step_num = self._host_step
         temp = (self.temp_scheduler(step_num) if self.temp_scheduler is not None
                 else 1.0)
         key = jax.random.fold_in(self.base_key, step_num)
         images = shard_batch(self.mesh, images.astype(np.float32))
+        if self.loss_mode != "gan":
+            t = images if targets is None else shard_batch(
+                self.mesh, np.asarray(targets, np.float32))
+            self.state, metrics = self.step_fn(self.state, images, t, key,
+                                               jnp.float32(temp))
+            return self._finish_step(metrics)
         self.state, metrics = self.step_fn(self.state, images, key,
                                            jnp.float32(temp))
         metrics = self._finish_step(metrics)
@@ -257,11 +320,16 @@ class VQGANTrainer(BaseTrainer):
         return metrics
 
     # -- eval utilities ----------------------------------------------------
+    @property
+    def _gen_params(self):
+        return (self.state.params if self.loss_mode != "gan"
+                else self.state.params["gen"])
+
     def reconstruct(self, images: np.ndarray):
-        recon, _, _ = self.model.apply(self.state.params["gen"],
-                                       jnp.asarray(images), deterministic=True)
+        recon, _, _ = self.model.apply(self._gen_params, jnp.asarray(images),
+                                       deterministic=True)
         return recon
 
     def get_codebook_indices(self, images: np.ndarray):
-        return self.model.apply(self.state.params["gen"], jnp.asarray(images),
+        return self.model.apply(self._gen_params, jnp.asarray(images),
                                 method=VQModel.get_codebook_indices)

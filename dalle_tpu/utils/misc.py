@@ -60,3 +60,26 @@ def kmeans(x, k: int, iters: int = 10, seed: int = 0):
 
     centroids, _ = jax.lax.scan(step, centroids, None, length=iters)
     return centroids, jnp.argmin(dists(centroids), axis=-1)
+
+
+def get_obj_from_str(string: str, reload: bool = False):
+    """Resolve a dotted ``module.Class`` path (reference
+    dalle_pytorch/vae.py:144-148)."""
+    import importlib
+    module, cls = string.rsplit(".", 1)
+    mod = importlib.import_module(module)
+    if reload:
+        importlib.reload(mod)
+    return getattr(mod, cls)
+
+
+def instantiate_from_config(config: dict):
+    """taming-style config-as-constructor: ``{"target": "pkg.Cls",
+    "params": {...}}`` (reference vae.py:138-142; taming/main.py:113-116).
+    Reference taming targets are remapped onto this package's equivalents."""
+    if "target" not in config:
+        raise KeyError("expected a 'target' key")
+    # taming yaml targets (taming.models.vqgan.*) have torch ctor signatures;
+    # those configs go through models.pretrained.vqgan_config_from_yaml, which
+    # owns the schema translation — this helper is the generic DI mechanism
+    return get_obj_from_str(config["target"])(**config.get("params", {}))

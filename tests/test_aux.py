@@ -119,3 +119,22 @@ def test_attend_softmax_dtype_flag():
     # numerically close; not identical (different accumulation width)
     diff = jnp.abs(a32.astype(jnp.float32) - a16.astype(jnp.float32)).max()
     assert float(diff) < 0.05
+
+
+def test_target_string_instantiation():
+    from dalle_tpu.utils.misc import get_obj_from_str, instantiate_from_config
+    cls = get_obj_from_str("dalle_tpu.config.DVAEConfig")
+    cfg = instantiate_from_config({"target": "dalle_tpu.config.DVAEConfig",
+                                   "params": {"image_size": 64}})
+    assert isinstance(cfg, cls) and cfg.image_size == 64
+    with pytest.raises(KeyError):
+        instantiate_from_config({"params": {}})
+
+
+def test_backend_name_aliases():
+    import argparse
+    from dalle_tpu.parallel.backend import BACKENDS, set_backend_from_args
+    assert "deepspeed" in BACKENDS and "horovod" in BACKENDS
+    ns = argparse.Namespace(distributed_backend="deepspeed")
+    b = set_backend_from_args(ns)
+    assert type(b).__name__ == "JaxBackend"

@@ -181,3 +181,35 @@ class TestTrainer:
         changed = jax.tree.map(lambda a, b: bool(np.any(a != b)), before, after)
         assert any(jax.tree.leaves(changed)), "disc params must update"
         assert np.isfinite(m["disc_loss"]) and np.isfinite(m["d_weight"])
+
+
+class TestVariantModes:
+    def test_nodisc_mode_trains(self, tmp_path):
+        tc = TrainConfig(batch_size=8, log_every=1000, save_every_steps=10_000,
+                         checkpoint_dir=str(tmp_path / "ck"),
+                         preflight_checkpoint=False, mesh=MeshConfig(dp=8),
+                         optim=OptimConfig(learning_rate=2e-3, grad_clip_norm=0.0))
+        tr = VQGANTrainer(SMALL, tc, loss_mode="nodisc")
+        imgs = np.random.RandomState(0).rand(8, 32, 32, 3).astype("float32") * 2 - 1
+        first = tr.train_step(imgs)["nll_loss"]
+        for _ in range(10):
+            m = tr.train_step(imgs)
+        assert m["nll_loss"] < first
+        ids = tr.get_codebook_indices(imgs[:2])
+        assert ids.shape == (2, 256)
+
+    def test_segmentation_mode(self, tmp_path):
+        # VQSegmentationModel: out_ch = n_labels, BCE-with-quant loss
+        cfg = SMALL.replace(out_ch=8)
+        tc = TrainConfig(batch_size=8, log_every=1000, save_every_steps=10_000,
+                         checkpoint_dir=str(tmp_path / "ck"),
+                         preflight_checkpoint=False, mesh=MeshConfig(dp=8),
+                         optim=OptimConfig(learning_rate=2e-3, grad_clip_norm=0.0))
+        tr = VQGANTrainer(cfg, tc, loss_mode="segmentation")
+        rng = np.random.RandomState(0)
+        imgs = rng.rand(8, 32, 32, 3).astype("float32") * 2 - 1
+        seg = np.eye(8, dtype="float32")[rng.randint(0, 8, (8, 32, 32))]
+        first = tr.train_step(imgs, seg)["nll_loss"]
+        for _ in range(10):
+            m = tr.train_step(imgs, seg)
+        assert m["nll_loss"] < first
