@@ -21,11 +21,12 @@ import numpy as np
 IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
 
 
-def _load_image(path, image_size: int, *, center_crop: bool = True,
+def _finish_pil(img, image_size: int, *, center_crop: bool = True,
                 to_unit_interval: bool = True) -> np.ndarray:
-    """RGB convert → resize shorter side → center crop → float32 HWC."""
+    """Shared tail: RGB convert → shorter-side resize → center crop →
+    float32 HWC in [0,1] or [−1,1]. Accepts an open PIL image so array
+    sources (NumpyPaths) skip any codec round trip."""
     from PIL import Image
-    img = Image.open(path)
     if img.mode != "RGB":
         img = img.convert("RGB")
     w, h = img.size
@@ -43,6 +44,13 @@ def _load_image(path, image_size: int, *, center_crop: bool = True,
     if not to_unit_interval:
         arr = arr * 2.0 - 1.0
     return arr
+
+
+def _load_image(path, image_size: int, *, center_crop: bool = True,
+                to_unit_interval: bool = True) -> np.ndarray:
+    from PIL import Image
+    return _finish_pil(Image.open(path), image_size, center_crop=center_crop,
+                       to_unit_interval=to_unit_interval)
 
 
 class ImageFolderDataset:

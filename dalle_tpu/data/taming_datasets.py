@@ -22,7 +22,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from .loaders import IMAGE_EXTS, ImagePaths, _load_image
+from .loaders import IMAGE_EXTS, ImagePaths, _finish_pil, _load_image
 
 
 class NumpyPaths(ImagePaths):
@@ -33,19 +33,22 @@ class NumpyPaths(ImagePaths):
         arr = np.load(self.paths[i])
         if arr.ndim == 2:
             arr = np.stack([arr] * 3, axis=-1)
-        # dtype decides the scale (a max()>1 heuristic mis-scales dark uint8)
         if np.issubdtype(arr.dtype, np.integer):
-            u8 = arr.astype(np.uint8)
+            # scale by the dtype's full range (uint8 passes through; uint16
+            # must not wrap modulo 256)
+            info = np.iinfo(arr.dtype)
+            u8 = (arr.astype(np.float64) * (255.0 / info.max)).astype(np.uint8)
         else:
-            u8 = (np.clip(arr, 0.0, 1.0) * 255).astype(np.uint8)
-        # shorter-side resize + center crop through the SAME loader as the
-        # file path, so .npy and encoded images are pixel-identical
+            # floats: [0,1] unless values exceed 1 → assume a 0-255 store
+            f = arr.astype(np.float64)
+            if f.max() > 1.0:
+                f = f / 255.0
+            u8 = (np.clip(f, 0.0, 1.0) * 255).astype(np.uint8)
+        # shorter-side resize + center crop through the SAME tail as the file
+        # path — no codec round trip
         from PIL import Image
-        import io
-        buf = io.BytesIO()
-        Image.fromarray(u8).save(buf, format="PNG")
-        buf.seek(0)
-        img = _load_image(buf, self.size, to_unit_interval=False)
+        img = _finish_pil(Image.fromarray(u8), self.size,
+                          to_unit_interval=False)
         out = {"image": img}
         for k, v in self.labels.items():
             out[k] = v[i]

@@ -39,6 +39,17 @@ from ..ops.attn_masks import build_mask
 from ..ops.rotary import apply_rotary, dalle_pos_emb
 
 
+def _block_body(mdl, x, key_mask, ind: int, deterministic: bool):
+    """One attn+ff residual pair — module-first so ``nn.remat`` can lift it
+    (flax replays dropout rngs inside the recompute automatically, replacing
+    the reference's manual RNG save/restore, reversible.py:20-50)."""
+    t = mdl.layer_types[ind]
+    x = x + mdl.attn_layers[ind](x, key_mask=key_mask, rotary=mdl.rotary,
+                                 np_mask=mdl.np_masks[t],
+                                 deterministic=deterministic)
+    return x + mdl.ff_layers[ind](x, deterministic=deterministic)
+
+
 def layerscale_init_eps(layer_index_1based: int) -> float:
     """Per-layer LayerScale init (reference transformer.py:74-83: 0.1 up to
     depth 18, 1e-5 to 24, 1e-6 beyond — keyed on the 1-based layer index)."""
@@ -395,6 +406,7 @@ class Transformer(nn.Module):
         m = self.np_masks[t]
         return None if m is None else jnp.asarray(m)
 
+
     # -- training / full forward ------------------------------------------
     def __call__(self, x, key_mask=None, deterministic: bool = True):
         """Sequential execution by default; ``cfg.reversible`` switches to the
@@ -404,12 +416,18 @@ class Transformer(nn.Module):
         c = self.cfg
         if c.reversible:
             return self._call_reversible(x, key_mask, deterministic)
+        use_remat = c.use_remat and not self.is_initializing()
         for ind in range(c.depth):
-            attn_l, ff_l, t = self.attn_layers[ind], self.ff_layers[ind], self.layer_types[ind]
-            x = x + attn_l(x, key_mask=key_mask, rotary=self.rotary,
-                           np_mask=self.np_masks[t],
-                           deterministic=deterministic)
-            x = x + ff_l(x, deterministic=deterministic)
+            if use_remat:
+                # real jax.checkpoint per block pair: activations inside the
+                # block are recomputed in backward — the memory lever that
+                # lets batch/depth scale past HBM (complements `reversible`,
+                # which is O(1) in depth rather than O(depth) checkpoints)
+                blk = nn.remat(_block_body, prevent_cse=False,
+                               static_argnums=(3, 4))
+                x = blk(self, x, key_mask, ind, deterministic)
+            else:
+                x = _block_body(self, x, key_mask, ind, deterministic)
         return x
 
     def _call_reversible(self, x, key_mask, deterministic: bool):
