@@ -33,15 +33,21 @@ class NumpyPaths(ImagePaths):
         arr = np.load(self.paths[i])
         if arr.ndim == 2:
             arr = np.stack([arr] * 3, axis=-1)
-        if np.issubdtype(arr.dtype, np.integer):
-            # scale by the dtype's full range (uint8 passes through; uint16
-            # must not wrap modulo 256)
+        if arr.dtype == np.uint8:
+            u8 = arr
+        elif np.issubdtype(arr.dtype, np.unsignedinteger):
+            # wide unsigned stores (uint16 PNGs) use the dtype's full range —
+            # must not wrap modulo 256
             info = np.iinfo(arr.dtype)
             u8 = (arr.astype(np.float64) * (255.0 / info.max)).astype(np.uint8)
+        elif np.issubdtype(arr.dtype, np.integer):
+            # signed ints (numpy's default) conventionally hold 0-255 pixels
+            u8 = np.clip(arr, 0, 255).astype(np.uint8)
         else:
-            # floats: [0,1] unless values exceed 1 → assume a 0-255 store
+            # floats: [0,1] unless clearly a 0-255 store (threshold well away
+            # from 1.0 so interpolation overshoot doesn't dim the image 255×)
             f = arr.astype(np.float64)
-            if f.max() > 1.0:
+            if f.max() > 2.0:
                 f = f / 255.0
             u8 = (np.clip(f, 0.0, 1.0) * 255).astype(np.uint8)
         # shorter-side resize + center crop through the SAME tail as the file

@@ -4,3 +4,4 @@ from .metrics import ThroughputMeter, device_peak_tflops, count_params, profile_
 from .trainer_vae import VAETrainer, anneal_temperature, make_vae_train_step
 from .trainer_vqgan import (VQGANTrainer, GANTrainState, make_vqgan_train_step,
                             LambdaWarmUpCosineScheduler)
+from .trainer_clip import CLIPTrainer, make_clip_train_step

@@ -1,0 +1,74 @@
+"""CLIP trainer — contrastive text/image training as one jitted SPMD step.
+
+The reference ships the CLIP model with its symmetric-CE loss
+(dalle_pytorch/dalle_pytorch.py:292-332) but no training script (CLIP is used
+for reranking, generate_images :553-555). This trainer completes the family so
+a rerank model can be trained in-framework, with the same shell as every other
+trainer (NaN rollback, checkpoints, meter, bf16 compute).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import numpy as np
+import optax
+
+from ..config import ClipConfig, TrainConfig
+from ..models.clip import CLIP, init_clip
+from ..parallel import shard_batch, shard_params
+from .base_trainer import BaseTrainer
+from .metrics import ThroughputMeter, count_params
+from .train_state import (TrainState, cast_floating, compute_dtype,
+                          make_optimizer)
+
+
+def make_clip_train_step(model: CLIP, dtype=None):
+    """Returns step(state, text, images) -> (state, metrics)."""
+
+    def loss_fn(params, text, images):
+        x = images if dtype is None else images.astype(dtype)
+        return model.apply(cast_floating(params, dtype), text, x,
+                           return_loss=True)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(state: TrainState, text, images):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, text, images)
+        state = state.apply_gradients(grads)
+        return state, {"loss": loss, "grad_norm": optax.global_norm(grads)}
+
+    return step
+
+
+class CLIPTrainer(BaseTrainer):
+    model_class = "CLIP"
+
+    def __init__(self, model_cfg: ClipConfig, train_cfg: TrainConfig,
+                 mesh=None, backend=None):
+        super().__init__(train_cfg, mesh=mesh, backend=backend)
+        self.model_cfg = model_cfg
+        self.model, params = init_clip(model_cfg, self.base_key)
+        params = shard_params(self.mesh, params)
+        tx = make_optimizer(train_cfg.optim)
+        self.state = TrainState.create(apply_fn=self.model.apply, params=params,
+                                       tx=tx)
+        self.step_fn = make_clip_train_step(
+            self.model, dtype=compute_dtype(train_cfg.precision))
+        n = count_params(self.state.params)
+        self.meter = ThroughputMeter(
+            train_cfg.batch_size, train_cfg.log_every,
+            flops_per_step=6.0 * n * train_cfg.batch_size,
+            num_chips=self.mesh.size)
+
+    def train_step(self, text: np.ndarray, images: np.ndarray):
+        text = shard_batch(self.mesh, np.asarray(text, np.int32))
+        images = shard_batch(self.mesh, np.asarray(images, np.float32))
+        self.state, metrics = self.step_fn(self.state, text, images)
+        return self._finish_step(metrics)
+
+    def similarity(self, text: np.ndarray, images: np.ndarray):
+        """Per-pair rerank scores (reference generate_images :553-555)."""
+        import jax.numpy as jnp
+        return self.model.apply(self.state.params, jnp.asarray(text),
+                                jnp.asarray(images))

@@ -138,3 +138,26 @@ def test_backend_name_aliases():
     ns = argparse.Namespace(distributed_backend="deepspeed")
     b = set_backend_from_args(ns)
     assert type(b).__name__ == "JaxBackend"
+
+
+def test_clip_trainer_descends(tmp_path):
+    from dalle_tpu.config import ClipConfig
+    from dalle_tpu.train.trainer_clip import CLIPTrainer
+    cfg = ClipConfig(dim_text=32, dim_image=32, dim_latent=32,
+                     num_text_tokens=64, text_enc_depth=1, text_seq_len=8,
+                     text_heads=2, visual_enc_depth=1, visual_heads=2,
+                     visual_image_size=16, visual_patch_size=8)
+    tc = TrainConfig(batch_size=8, log_every=1000, save_every_steps=10_000,
+                     checkpoint_dir=str(tmp_path / "ck"),
+                     preflight_checkpoint=False, mesh=MeshConfig(dp=8),
+                     optim=OptimConfig(learning_rate=2e-3))
+    tr = CLIPTrainer(cfg, tc)
+    rng = np.random.RandomState(0)
+    text = rng.randint(1, 64, (8, 8))
+    imgs = rng.rand(8, 16, 16, 3).astype("float32")
+    first = tr.train_step(text, imgs)["loss"]
+    for _ in range(15):
+        m = tr.train_step(text, imgs)
+    assert m["loss"] < first
+    scores = tr.similarity(text[:4], imgs[:4])
+    assert scores.shape == (4,)

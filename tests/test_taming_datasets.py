@@ -91,3 +91,23 @@ def test_faceshq_mix(tmp_path):
     ds = FacesHQ(str(cl), str(fl), size=8)
     assert len(ds) == 4
     assert ds[0]["class"] == 0 and ds[3]["class"] == 1
+
+
+def test_numpy_paths_dtype_conventions(tmp_path):
+    rng = np.random.RandomState(0)
+    base = rng.rand(16, 16, 3)
+    stores = {
+        "u8": (base * 255).astype(np.uint8),
+        "u16": (base * 65535).astype(np.uint16),
+        "i64": (base * 255).astype(np.int64),    # numpy default int, 0-255
+        "f01": base.astype(np.float32),
+        "f255": (base * 255).astype(np.float32),
+        "f_overshoot": np.clip(base * 1.0000001, 0, 1.0000001).astype(np.float32),
+    }
+    ref = None
+    for name, arr in stores.items():
+        np.save(tmp_path / f"{name}.npy", arr)
+        img = NumpyPaths([str(tmp_path / f"{name}.npy")], size=16)[0]["image"]
+        if ref is None:
+            ref = img
+        assert np.abs(img - ref).max() < 0.02, f"{name} diverges from uint8"
