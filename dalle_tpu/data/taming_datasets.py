@@ -26,8 +26,20 @@ from .loaders import IMAGE_EXTS, ImagePaths, _finish_pil, _load_image
 
 
 class NumpyPaths(ImagePaths):
-    """.npy image arrays (HWC uint8) instead of encoded files
-    (taming/data/base.py:73-89)."""
+    """.npy image arrays (HWC) instead of encoded files
+    (taming/data/base.py:73-89).
+
+    ``assume_range`` resolves the inherent ambiguity of float stores:
+    "auto" (default) treats max ≤ 2.0 as [0,1]-intent (tolerating
+    interpolation overshoot) and anything brighter as 0-255; pass "unit" or
+    "255" when the dataset's convention is known — a dark 0-255 float image
+    (max ≤ 2) is indistinguishable from a [0,1] one by inspection."""
+
+    def __init__(self, paths, size: int = 256, labels=None,
+                 assume_range: str = "auto"):
+        super().__init__(paths, size=size, labels=labels)
+        assert assume_range in ("auto", "unit", "255"), assume_range
+        self.assume_range = assume_range
 
     def __getitem__(self, i: int):
         arr = np.load(self.paths[i])
@@ -44,10 +56,9 @@ class NumpyPaths(ImagePaths):
             # signed ints (numpy's default) conventionally hold 0-255 pixels
             u8 = np.clip(arr, 0, 255).astype(np.uint8)
         else:
-            # floats: [0,1] unless clearly a 0-255 store (threshold well away
-            # from 1.0 so interpolation overshoot doesn't dim the image 255×)
             f = arr.astype(np.float64)
-            if f.max() > 2.0:
+            if self.assume_range == "255" or (self.assume_range == "auto"
+                                              and f.max() > 2.0):
                 f = f / 255.0
             u8 = (np.clip(f, 0.0, 1.0) * 255).astype(np.uint8)
         # shorter-side resize + center crop through the SAME tail as the file

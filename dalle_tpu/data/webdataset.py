@@ -177,12 +177,18 @@ class WebDataset:
         self._ops: List = []
 
     # -- chainable stages (each returns self) ------------------------------
-    def decode(self, image_size: Optional[int] = None):
-        self._ops.append(("map", lambda s: decode_sample(s, image_size)))
-        return self
+    def decode(self, image_size: Optional[int] = None, workers: int = 0):
+        """``workers > 0`` decodes on a thread pool (PIL releases the GIL in
+        its codecs) — the host-side parallelism that keeps a pod's input
+        pipeline fed (SURVEY.md §7 "input pipeline throughput")."""
+        return self.map(lambda s: decode_sample(s, image_size),
+                        workers=workers)
 
-    def map(self, fn: Callable):
-        self._ops.append(("map", fn))
+    def map(self, fn: Callable, workers: int = 0):
+        if workers > 0:
+            self._ops.append(("pmap", (fn, workers)))
+        else:
+            self._ops.append(("map", fn))
         return self
 
     def select(self, pred: Callable):
@@ -233,6 +239,8 @@ class WebDataset:
         for kind, arg in self._ops:
             if kind == "map":
                 it = _safe_map(it, arg, self.handler)
+            elif kind == "pmap":
+                it = _parallel_map(it, arg[0], arg[1], self.handler)
             elif kind == "filter":
                 it = filter(arg, it)   # not a genexp: binds arg now, not lazily
             elif kind == "shuffle":
@@ -245,6 +253,29 @@ class WebDataset:
         """Run the pipeline on a daemon thread; consumer pulls from a bounded
         queue — decode/IO overlaps device step time."""
         return _Prefetcher(self, max_queue)
+
+
+def _parallel_map(it, fn, workers: int, handler):
+    """Order-preserving thread-pool map with a bounded in-flight window: a
+    sliding queue of futures so decode overlaps both IO and the consumer."""
+    import collections
+    from concurrent.futures import ThreadPoolExecutor
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        window: collections.deque = collections.deque()
+        for s in it:
+            window.append(pool.submit(fn, s))
+            if len(window) >= workers * 2:
+                yield from _drain_one(window, handler)
+        while window:
+            yield from _drain_one(window, handler)
+
+
+def _drain_one(window, handler):
+    try:
+        yield window.popleft().result()
+    except Exception as e:              # noqa: BLE001 - sample-level skip
+        if not handler(e):
+            raise
 
 
 def _safe_map(it, fn, handler):

@@ -19,7 +19,7 @@ from ..config import ClipConfig, TrainConfig
 from ..models.clip import CLIP, init_clip
 from ..parallel import shard_batch, shard_params
 from .base_trainer import BaseTrainer
-from .metrics import ThroughputMeter, count_params
+from .metrics import ThroughputMeter, count_params, transformer_train_flops
 from .train_state import (TrainState, cast_floating, compute_dtype,
                           make_optimizer)
 
@@ -56,9 +56,14 @@ class CLIPTrainer(BaseTrainer):
         self.step_fn = make_clip_train_step(
             self.model, dtype=compute_dtype(train_cfg.precision))
         n = count_params(self.state.params)
+        tokens_per_sample = (model_cfg.text_seq_len +
+                             (model_cfg.visual_image_size //
+                              model_cfg.visual_patch_size) ** 2)
         self.meter = ThroughputMeter(
             train_cfg.batch_size, train_cfg.log_every,
-            flops_per_step=6.0 * n * train_cfg.batch_size,
+            tokens_per_sample=tokens_per_sample,
+            flops_per_step=transformer_train_flops(
+                n, train_cfg.batch_size * tokens_per_sample),
             num_chips=self.mesh.size)
 
     def train_step(self, text: np.ndarray, images: np.ndarray):

@@ -189,3 +189,24 @@ class TestForkLoaders:
         assert item["image"].min() >= -1.0 and item["image"].max() <= 1.0
         assert item["image"].min() < 0    # actually in [-1,1], not [0,1]
         assert item["cls"] == 0
+
+
+def test_parallel_decode_preserves_order_and_skips_errors(tmp_path):
+    _make_shards(tmp_path, n_shards=2, per_shard=8)
+    serial = list(WebDataset(str(tmp_path), split_by_host=False)
+                  .decode(image_size=8).map(lambda s: s["__key__"]))
+    par = list(WebDataset(str(tmp_path), split_by_host=False)
+               .decode(image_size=8, workers=4).map(lambda s: s["__key__"]))
+    assert par == serial  # order-preserving
+
+    # corrupt member: parallel path must skip it like the serial path
+    path = tmp_path / "mix.tar"
+    with tarfile.open(path, "w") as tf:
+        for key, data in (("a", _png_bytes((1, 2, 3))), ("b", b"JUNK"),
+                          ("c", _png_bytes((4, 5, 6)))):
+            info = tarfile.TarInfo(f"{key}.png")
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    out = [s["__key__"] for s in
+           WebDataset(str(path), split_by_host=False).decode(workers=3)]
+    assert out == ["a", "c"]
