@@ -171,3 +171,42 @@ def batch_arrays(dataset, indices: Sequence[int]):
     cols = list(zip(*items))
     return tuple(np.stack(c) if isinstance(c[0], np.ndarray) else np.asarray(c)
                  for c in cols)
+
+
+def grid_shape(n: int, cols: Optional[int] = None) -> Tuple[int, int]:
+    """(rows, cols) covering all n items: near-square by default."""
+    import math
+    if n == 0:
+        return (0, cols or 0)
+    if cols is None:
+        rows = max(int(math.sqrt(n)), 1)
+        cols = math.ceil(n / rows)
+    rows = math.ceil(n / cols)
+    return rows, cols
+
+
+def tile_images(images: Sequence[np.ndarray], cols: Optional[int] = None
+                ) -> np.ndarray:
+    """Tile a list/batch of HWC images into one grid image — the save-file
+    counterpart of the fork's matplotlib ``draw_images`` (loader.py:25-40).
+    Every image is kept; the last row may be partially empty."""
+    images = [np.asarray(im) for im in images]
+    if not images:
+        raise ValueError("tile_images needs at least one image")
+    rows, cols = grid_shape(len(images), cols)
+    h, w, c = images[0].shape
+    grid = np.zeros((rows * h, cols * w, c), images[0].dtype)
+    for i, im in enumerate(images):
+        r, col = divmod(i, cols)
+        grid[r * h:(r + 1) * h, col * w:(col + 1) * w] = im
+    return grid
+
+
+def print_labels(labels: Sequence[Sequence[str]], sep: str = "_",
+                 printer=print, cols: Optional[int] = None) -> None:
+    """Row-major label grid printout (fork loader.py:43-50), using the SAME
+    grid shape as ``tile_images`` so labels line up with the tiled image."""
+    rows, cols = grid_shape(len(labels), cols)
+    for r in range(rows):
+        row = labels[r * cols:(r + 1) * cols]
+        printer(":".join(sep.join(l) for l in row))
