@@ -40,8 +40,13 @@ DEFAULT_RULES: Tuple[Tuple[str, P], ...] = (
     # feed-forward
     (r".*(ff|mlp).*(w1|wi|fc1|dense_in)/kernel$",            P("fsdp", "tp")),
     (r".*(ff|mlp).*(w2|wo|fc2|dense_out)/kernel$",           P("tp", "fsdp")),
-    # embeddings + output head
-    (r".*(tok_emb|text_emb|image_emb|embedding)/embedding$", P("tp", "fsdp")),
+    # embeddings + output head. Vocab shards over BOTH axes with the feature
+    # dim replicated: a gather from a vocab-sharded table emits a replicated
+    # feature dim, so activations stay batch-sharded at remat-block boundaries
+    # (feature-sharded tables force an involuntary full-remat reshard in the
+    # SPMD partitioner: dim-over-fsdp gather output vs batch-over-(dp,fsdp)
+    # block inputs).
+    (r".*(tok_emb|text_emb|image_emb|embedding)/embedding$", P(("tp", "fsdp"),)),
     (r".*(to_logits|logits|head)/kernel$",                   P("fsdp", "tp")),
     # conv kernels (dVAE/VQGAN): shard output channels over fsdp only
     (r".*conv.*/kernel$",                                    P(None, None, None, "fsdp")),

@@ -144,21 +144,36 @@ class BPE:
     def decode(self, ids: Iterable[int]) -> str:
         text = "".join(self.decoder[i] for i in ids
                        if i in self.decoder and self.decoder[i] not in (SOT, EOT))
-        text = text.replace("</w>", " ")
+        # byte-decode first, then turn '</w>' markers into spaces (the marker's
+        # own chars are printable ASCII and pass through the byte table) —
+        # replacing first would drop the space, which is not a byte-table char
         data = bytes(self.byte_dec[c] for c in text if c in self.byte_dec)
-        return data.decode("utf-8", errors="replace").strip()
+        return (data.decode("utf-8", errors="replace")
+                .replace("</w>", " ").strip())
 
 
 # ---------------------------------------------------------------------------
 # merges file io (CLIP-compatible) + training
 # ---------------------------------------------------------------------------
 
+DEFAULT_VOCAB_PATH = Path(__file__).parent / "data" / "bpe_simple_vocab_16e6.txt.gz"
+
+
 def load_merges(path: str | Path, limit: Optional[int] = None) -> List[Tuple[str, str]]:
-    """Read a CLIP-format merges file: 'first second' per line; tolerate a
-    version header and blank lines. ``limit`` reproduces the reference's
-    slice (tokenizer.py:58: merges[1:49152-256-2+1])."""
-    lines = Path(path).read_text(encoding="utf-8").split("\n")
-    if lines and (" " not in lines[0] or lines[0].startswith("#")):
+    """Read a CLIP-format merges file ('first second' per line; tolerate a
+    version header and blank lines), plain or gzipped. ``limit`` reproduces
+    the reference's slice (tokenizer.py:58: merges[1:49152-256-2+1])."""
+    path = Path(path)
+    if path.suffix == ".gz":
+        import gzip
+        text = gzip.decompress(path.read_bytes()).decode("utf-8")
+    else:
+        text = path.read_text(encoding="utf-8")
+    lines = text.split("\n")
+    # The version header may itself split into two tokens (CLIP's reads
+    # '"bpe_simple_vocab_16e6.txt#version: 0.2'), so detect it by content,
+    # not shape — the reference drops line 0 unconditionally (tokenizer.py:60).
+    if lines and ("#" in lines[0] or len(lines[0].split()) != 2):
         lines = lines[1:]
     merges = []
     for ln in lines:

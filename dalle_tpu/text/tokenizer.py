@@ -23,21 +23,36 @@ from typing import Iterable, List, Optional, Sequence
 
 import numpy as np
 
-from .bpe import BPE, load_merges, save_merges, train_bpe
+from .bpe import BPE, DEFAULT_VOCAB_PATH, load_merges, save_merges, train_bpe
+
+_DEFAULT = object()  # sentinel: "use the shipped CLIP vocab"
 
 
 class SimpleTokenizer:
     """Byte-level BPE with the reference contract. ``bpe_path`` accepts a
-    CLIP-format merges file; ``merges`` accepts an in-memory merge list."""
+    CLIP-format merges file (plain or .gz); ``merges`` accepts an in-memory
+    merge list. With no arguments the shipped CLIP merges vocabulary loads
+    by default, reproducing the reference's 49,408-token vocab
+    (tokenizer.py:55-76 + dalle_pytorch/data/bpe_simple_vocab_16e6.txt);
+    pass ``bpe_path=None, merges=[]`` explicitly for a bare byte-level
+    tokenizer (vocab 514). ``clip_compat`` truncates merges at the CLIP
+    limit (reference tokenizer.py:58); default: only for the shipped vocab —
+    user merges files load in full."""
 
     CLIP_MERGE_LIMIT = 49152 - 256 - 2  # reference tokenizer.py:58
 
-    def __init__(self, bpe_path: Optional[str] = None, merges=None,
-                 clip_compat: bool = False):
+    def __init__(self, bpe_path: Optional[str] = _DEFAULT, merges=None,
+                 clip_compat: Optional[bool] = None):
+        if bpe_path is _DEFAULT:
+            bpe_path = (str(DEFAULT_VOCAB_PATH)
+                        if merges is None and DEFAULT_VOCAB_PATH.exists()
+                        else None)
+            if clip_compat is None and bpe_path is not None:
+                clip_compat = True
         if bpe_path is not None:
             limit = self.CLIP_MERGE_LIMIT if clip_compat else None
             merges = load_merges(bpe_path, limit=limit)
-        self.bpe = BPE(list(merges or []))
+        self.bpe = BPE(list(merges if merges is not None else []))
 
     @property
     def vocab_size(self) -> int:
@@ -86,7 +101,7 @@ class YttmTokenizer(SimpleTokenizer):
     def __init__(self, bpe_path: str):
         if not Path(bpe_path).exists():
             raise ValueError(f"BPE json path {bpe_path!r} does not exist")
-        super().__init__(bpe_path=str(bpe_path))
+        super().__init__(bpe_path=str(bpe_path), clip_compat=False)
 
 
 class HugTokenizer:

@@ -40,6 +40,46 @@ def load_model_checkpoint(ckpt_dir: str, expect_class: str, config_cls,
     return model, state.params, meta
 
 
+def save_vae_sidecar(output_dir: str, vae):
+    """Embed the (frozen) VAE weights+hparams inside the DALL·E checkpoint
+    directory, so generation needs only ``--dalle_path`` — the reference's
+    checkpoints carry the vae as a submodule of the DALLE state dict plus
+    ``vae_params``/``vae_class_name`` (legacy/train_dalle.py:535-582).
+    Pretrained wrappers (OpenAI/VQGAN) are skipped: they rebuild from their
+    own cached artifacts, exactly like the reference (generate.py:93-100)."""
+    from dalle_tpu.models.wrapper import DiscreteVAEAdapter
+    if type(vae) is not DiscreteVAEAdapter:
+        return
+    from dalle_tpu.train.checkpoints import CheckpointManager
+    mgr = CheckpointManager(os.path.join(output_dir, "vae"))
+    mgr.save(0, vae.params, {"vae_class_name": type(vae).__name__,
+                             "hparams": vae.model.cfg.to_dict()})
+    mgr.close()
+
+
+def load_vae_sidecar(ckpt_dir: str):
+    """Rebuild the VAE embedded by ``save_vae_sidecar``; None if absent."""
+    vdir = os.path.join(ckpt_dir, "vae")
+    if not os.path.isdir(vdir):
+        return None
+    import jax
+    from dalle_tpu.config import DVAEConfig
+    from dalle_tpu.models.dvae import init_dvae
+    from dalle_tpu.models.wrapper import DiscreteVAEAdapter
+    from dalle_tpu.train.checkpoints import CheckpointManager
+
+    mgr = CheckpointManager(vdir)
+    meta = mgr.load_metadata()
+    if meta is None or meta.get("vae_class_name") != "DiscreteVAEAdapter":
+        mgr.close()
+        return None
+    cfg = DVAEConfig.from_dict(meta["hparams"])
+    model, template = init_dvae(cfg, jax.random.PRNGKey(0))
+    params, _ = mgr.restore(template)
+    mgr.close()
+    return DiscreteVAEAdapter(model, params)
+
+
 def load_dvae_adapter(ckpt_dir: str):
     """Restore a scripts/train_vae.py checkpoint into a DiscreteVAEAdapter."""
     from dalle_tpu.config import DVAEConfig

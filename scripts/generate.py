@@ -18,7 +18,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from _common import (add_vae_args, build_vae_from_args,  # noqa: E402
-                     load_model_checkpoint, save_image_grid)
+                     load_model_checkpoint, load_vae_sidecar, save_image_grid)
 
 
 def build_parser():
@@ -67,8 +67,22 @@ def main(argv=None):
     tok_kw = {"bpe_path": args.bpe_path} if args.bpe_path else {}
     tokenizer = get_tokenizer(args.tokenizer, **tok_kw)
     model, params, meta = load_dalle(args.dalle_path, backend)
+    if tokenizer.vocab_size > model.cfg.num_text_tokens:
+        # mirror train_dalle's validation: larger-vocab ids would be silently
+        # clipped by the embedding gather and condition on garbage
+        print(f"error: tokenizer vocab {tokenizer.vocab_size} > checkpoint "
+              f"num_text_tokens {model.cfg.num_text_tokens} — pass the "
+              f"--tokenizer/--bpe_path the model was trained with",
+              file=sys.stderr)
+        return 2
 
-    vae = build_vae_from_args(args, backend)
+    explicit_vae = (args.vae_path or args.taming or args.vqgan_model_path
+                    or args.untrained_vae)
+    vae = None if explicit_vae else load_vae_sidecar(args.dalle_path)
+    if vae is None:
+        # explicit flags, or a checkpoint without an embedded VAE (pretrained
+        # wrappers rebuild from their own cache — reference generate.py:93-100)
+        vae = build_vae_from_args(args, backend)
     want = meta.get("vae_class_name")
     if want and want != type(vae).__name__:
         # the reference hard-errors on class mismatch (generate.py:100)

@@ -19,7 +19,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from _common import add_vae_args, build_vae_from_args, save_image_grid  # noqa: E402
+from _common import (add_vae_args, build_vae_from_args,  # noqa: E402
+                     save_image_grid, save_vae_sidecar)
 
 
 def build_parser():
@@ -138,6 +139,8 @@ def main(argv=None):
         "vae_class_name": type(vae).__name__,
         "vae_hparams": getattr(getattr(vae, "model", None), "cfg", None)
         and vae.model.cfg.to_dict()}
+    if is_root:
+        save_vae_sidecar(args.output_dir, vae)
     if args.resume:
         meta = trainer.restore()
         if is_root:
