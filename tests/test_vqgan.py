@@ -146,6 +146,7 @@ class TestScheduler:
 
 
 class TestTrainer:
+    @pytest.mark.slow
     @pytest.mark.parametrize("quantizer", ["vq", "gumbel"])
     def test_loss_decreases(self, tmp_path, quantizer):
         cfg = SMALL.replace(quantizer=quantizer)
@@ -184,6 +185,7 @@ class TestTrainer:
 
 
 class TestVariantModes:
+    @pytest.mark.slow
     def test_nodisc_mode_trains(self, tmp_path):
         tc = TrainConfig(batch_size=8, log_every=1000, save_every_steps=10_000,
                          checkpoint_dir=str(tmp_path / "ck"),
@@ -198,6 +200,7 @@ class TestVariantModes:
         ids = tr.get_codebook_indices(imgs[:2])
         assert ids.shape == (2, 256)
 
+    @pytest.mark.slow
     def test_segmentation_mode(self, tmp_path):
         # VQSegmentationModel: out_ch = n_labels, BCE-with-quant loss
         cfg = SMALL.replace(out_ch=8)
