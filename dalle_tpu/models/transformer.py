@@ -432,14 +432,17 @@ class Transformer(nn.Module):
 
     def _call_reversible(self, x, key_mask, deterministic: bool):
         """Unbind each layer into (pure fn, params) pairs and run the
-        reversible coupling. Dropout requires per-recompute rng replay — not
-        supported on this path (reference replays RNG state, reversible.py:20-50;
-        here keys are explicit and the sequential path covers dropout)."""
+        reversible coupling. Dropout works through explicit key replay: every
+        block fn carries its dropout key in the params pytree, so the
+        custom_vjp backward's recompute uses bit-identical masks — the
+        TPU-native version of the reference's RNG save/restore dance
+        (reversible.py:20-50). The same base key goes to every block: flax's
+        ``make_rng`` folds in the module path, so each layer still draws a
+        distinct mask, identical to what the sequential path would draw."""
         from .reversible import run_reversible
         c = self.cfg
-        if not deterministic and (c.attn_dropout > 0 or c.ff_dropout > 0):
-            raise NotImplementedError(
-                "reversible path requires deterministic execution (no dropout)")
+        use_dropout = (not deterministic
+                       and (c.attn_dropout > 0 or c.ff_dropout > 0))
         if self.is_initializing():
             # bound calls so flax creates the params; same coupled computation
             x1 = x2 = x
@@ -447,6 +450,7 @@ class Transformer(nn.Module):
                 x1 = x1 + self._apply_attn_layer(x2, ind, key_mask)
                 x2 = x2 + self._apply_ff_layer(x1, ind)
             return (x1 + x2) / 2.0
+        drop_key = self.make_rng("dropout") if use_dropout else None
         # Unbind the WHOLE stack once: shared layers live in their first
         # adopter's flax scope, so per-layer unbinding would lose their params.
         # Each block fn takes the full variable tree; unused-leaf cotangents
@@ -455,24 +459,31 @@ class Transformer(nn.Module):
         fns, params = [], []
         for ind in range(c.depth):
             def f(p, h, _ind=ind):
-                return tm.apply(p, h, _ind, key_mask,
-                                method=Transformer._apply_attn_layer)
+                var, key = p
+                rngs = None if key is None else {"dropout": key}
+                return tm.apply(var, h, _ind, key_mask, key is None,
+                                method=Transformer._apply_attn_layer,
+                                rngs=rngs)
 
             def g(p, h, _ind=ind):
-                return tm.apply(p, h, _ind, method=Transformer._apply_ff_layer)
+                var, key = p
+                rngs = None if key is None else {"dropout": key}
+                return tm.apply(var, h, _ind, key is None,
+                                method=Transformer._apply_ff_layer, rngs=rngs)
 
             fns.append((f, g))
-            params.append((variables, variables))
+            params.append(((variables, drop_key), (variables, drop_key)))
         return run_reversible(fns, params, x)
 
-    def _apply_attn_layer(self, h, ind: int, key_mask=None):
+    def _apply_attn_layer(self, h, ind: int, key_mask=None,
+                          deterministic: bool = True):
         t = self.layer_types[ind]
         return self.attn_layers[ind](h, key_mask=key_mask, rotary=self.rotary,
                                      np_mask=self.np_masks[t],
-                                     deterministic=True)
+                                     deterministic=deterministic)
 
-    def _apply_ff_layer(self, h, ind: int):
-        return self.ff_layers[ind](h, deterministic=True)
+    def _apply_ff_layer(self, h, ind: int, deterministic: bool = True):
+        return self.ff_layers[ind](h, deterministic=deterministic)
 
     # -- cached decode -----------------------------------------------------
     def init_cache(self, batch: int, max_seq: Optional[int] = None,

@@ -101,6 +101,67 @@ def test_transformer_reversible_grads_match_naive_coupling():
                                    rtol=5e-4, atol=1e-5)
 
 
+def test_transformer_reversible_dropout_grads_match_naive_coupling():
+    """Reversible + dropout (reference reversible.py:20-50 replays RNG state
+    so the backward recompute sees the same masks): the custom_vjp path with
+    dropout must equal full-activation autodiff of the identical coupled
+    forward using the SAME dropout key — key replay through the params pytree
+    makes the recompute bit-identical."""
+    cfg = _tiny_cfg(reversible=True, attn_dropout=0.3, ff_dropout=0.3)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 25, 32))
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(1), x)
+    dkey = jax.random.PRNGKey(7)
+
+    def loss_rev(p):
+        y = model.apply(p, x, deterministic=False, rngs={"dropout": dkey})
+        return jnp.sum(y ** 2)
+
+    # the reversible path draws its per-block base key via make_rng at the
+    # Transformer scope; reproduce the same derivation so masks match
+    base = model.apply(params, method=lambda m: m.make_rng("dropout"),
+                       rngs={"dropout": dkey})
+
+    def loss_naive(p):
+        # same coupling, same per-layer rng derivation (flax folds the module
+        # path into make_rng, so passing the same base key reproduces the
+        # reversible path's masks exactly)
+        x1 = x2 = x
+        for ind in range(cfg.depth):
+            x1 = x1 + model.apply(p, x2, ind, None, False,
+                                  method=Transformer._apply_attn_layer,
+                                  rngs={"dropout": base})
+            x2 = x2 + model.apply(p, x1, ind, False,
+                                  method=Transformer._apply_ff_layer,
+                                  rngs={"dropout": base})
+        return jnp.sum(((x1 + x2) / 2.0) ** 2)
+
+    np.testing.assert_allclose(float(loss_rev(params)),
+                               float(loss_naive(params)), rtol=1e-6)
+    g_rev = jax.grad(loss_rev)(params)
+    g_nai = jax.grad(loss_naive)(params)
+    for a, b in zip(jax.tree.leaves(g_nai), jax.tree.leaves(g_rev)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=5e-4, atol=1e-5)
+
+
+def test_transformer_reversible_dropout_changes_with_key():
+    """Different dropout keys → different outputs (the masks are real), and
+    deterministic=True ignores the rng entirely."""
+    cfg = _tiny_cfg(reversible=True, attn_dropout=0.5, ff_dropout=0.5)
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 25, 32))
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(1), x)
+    y1 = model.apply(params, x, deterministic=False,
+                     rngs={"dropout": jax.random.PRNGKey(2)})
+    y2 = model.apply(params, x, deterministic=False,
+                     rngs={"dropout": jax.random.PRNGKey(3)})
+    y_det = model.apply(params, x)
+    assert not np.allclose(np.asarray(y1), np.asarray(y2))
+    assert np.isfinite(np.asarray(y1)).all()
+    assert np.isfinite(np.asarray(y_det)).all()
+
+
 def test_transformer_reversible_vs_sequential_architectures_differ():
     """Sanity: reversible is a different function than sequential (two-stream
     coupling), so outputs should NOT match — guards against silently running
