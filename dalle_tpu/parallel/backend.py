@@ -167,7 +167,11 @@ class JaxBackend(DistributedBackend):
         return jax.device_count()
 
     def _get_rank(self) -> int:
-        return jax.process_index() * max(1, jax.local_device_count())
+        # global rank of this host's first worker slot = number of devices on
+        # lower-indexed processes (correct even when hosts own unequal device
+        # counts, unlike process_index * local_device_count)
+        me = jax.process_index()
+        return sum(1 for d in jax.devices() if d.process_index < me)
 
     def _get_local_rank(self) -> int:
         return 0  # one process per host; local root == this process

@@ -85,3 +85,84 @@ def test_shard_params_places_on_mesh(mesh8):
     x = shard_batch(mesh8, np.ones((8, 8), np.float32))
     y = jax.jit(lambda a, b: a @ b)(x, k)
     np.testing.assert_allclose(np.asarray(y), 8.0)
+
+
+# --------------------------------------------------------------------------
+# Real 2-process jax.distributed run over the loopback coordinator (the DCN
+# path the dryrun can't cover: process_allgather, sync_global_devices,
+# per-host shard split, rank/world queries across processes).
+# --------------------------------------------------------------------------
+
+_CHILD_CODE = """
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import argparse, sys
+import numpy as np
+
+pid, port = int(sys.argv[1]), sys.argv[2]
+from dalle_tpu.parallel import backend as B
+
+ap = argparse.ArgumentParser()
+B.wrap_arg_parser(ap)
+args = ap.parse_args([
+    '--distributed_backend', 'jax',
+    '--coordinator_address', f'127.0.0.1:{port}',
+    '--num_processes', '2', '--process_id', str(pid)])
+b = B.set_backend_from_args(args).initialize()
+
+assert jax.process_count() == 2, jax.process_count()
+assert b.get_world_size() == 4, b.get_world_size()          # 2 procs x 2 devs
+assert b.get_rank() == pid * 2, (pid, b.get_rank())
+assert b.is_root_worker() == (pid == 0)
+assert b.is_local_root_worker()
+b.local_barrier()                                           # sync_global_devices
+
+avg = b.average_all(np.float32(pid))                        # process_allgather
+assert abs(float(avg) - 0.5) < 1e-6, avg
+
+from dalle_tpu.data.webdataset import split_shards_per_host
+shards = [f's{i}' for i in range(5)]
+mine = split_shards_per_host(shards)
+want = shards[pid::2]
+assert mine == want, (mine, want)
+
+b.local_barrier()
+print(f'CHILD_OK {pid} rank={b.get_rank()}')
+"""
+
+
+def test_two_process_dcn(tmp_path):
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    script = tmp_path / "dcn_child.py"
+    script.write_text(_CHILD_CODE)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = " ".join(f for f in env.get("XLA_FLAGS", "").split()
+                     if "xla_force_host_platform_device_count" not in f)
+    env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=2").strip()
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    procs = [subprocess.Popen([sys.executable, str(script), str(i), str(port)],
+                              env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for i in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"child {i} failed:\n{out[-3000:]}"
+        assert f"CHILD_OK {i}" in out
