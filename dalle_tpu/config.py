@@ -374,6 +374,13 @@ class OptimConfig(ConfigBase):
     warmup_steps: int = 0
     total_steps: int = 100_000
     lr_scheduler: str = "constant"       # constant | cosine | exponential | plateau
+    # plateau (ReduceLROnPlateau parity, ref legacy/train_dalle.py:444-459:
+    # factor 0.5, patience 10, cooldown 10, min_lr 1e-6) — applied in-graph
+    # via optax.contrib.reduce_on_plateau on the step's loss
+    plateau_factor: float = 0.5
+    plateau_patience: int = 10
+    plateau_cooldown: int = 10
+    plateau_min_scale: float = 1e-3      # min lr as a fraction of base lr
 
 
 @dataclass(frozen=True)
@@ -395,6 +402,9 @@ class TrainConfig(ConfigBase):
     preflight_checkpoint: bool = True    # ref: legacy/train_dalle.py:591-594
     sample_every_steps: int = 0
     profile_step: int = 0                # >0 → dump a jax.profiler trace + MFU report
+    # upload each saved checkpoint as a wandb artifact through the metrics
+    # writer (ref legacy/train_dalle.py:584-587,667-669); no-op without wandb
+    log_artifacts: bool = False
     optim: OptimConfig = field(default_factory=OptimConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     precision: PrecisionConfig = field(default_factory=PrecisionConfig)

@@ -122,6 +122,13 @@ class BaseTrainer:
                     self.ckpt.save(step_num, self.state, meta)
                     self._snapshot_good()
                     self._signal_save = False
+                    if (getattr(tc, "log_artifacts", False)
+                            and metrics_writer is not None
+                            and hasattr(metrics_writer, "log_artifact")):
+                        metrics_writer.log_artifact(
+                            tc.checkpoint_dir,
+                            name=f"trained-{self.model_class.lower()}",
+                            metadata={"step": step_num})
                 if getattr(tc, "sample_every_steps", 0) and sample_fn and \
                         step_num % tc.sample_every_steps == 0:
                     sample_fn(step_num)

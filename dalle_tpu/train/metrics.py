@@ -121,6 +121,38 @@ class MetricsLogger:
             self._wandb.log({k: v for k, v in rec.items() if k != "step"},
                             step=step)
 
+    def log_images(self, step: int, images, key: str = "samples",
+                   captions=None):
+        """Periodic generated/reconstruction image logging (reference
+        legacy/train_dalle.py:639-649, train_vae.py:245-255). ``images`` is
+        (b, H, W, C) float [0,1]; no-op without a live wandb run (disk grids
+        are the script's responsibility)."""
+        if self._wandb is None:
+            return
+        import numpy as np
+        import wandb
+        arr = np.asarray(images)
+        caps = captions or [None] * len(arr)
+        self._wandb.log(
+            {key: [wandb.Image((a * 255).clip(0, 255).astype("uint8"),
+                               caption=c) for a, c in zip(arr, caps)]},
+            step=step)
+
+    def log_artifact(self, path: str, name: str, type: str = "model",
+                     metadata: Optional[dict] = None):
+        """Checkpoint artifact upload (reference legacy/train_dalle.py:584-587,
+        667-669: per-epoch trained-dalle wandb.Artifact). No-op without wandb."""
+        if self._wandb is None:
+            return
+        import os
+        import wandb
+        art = wandb.Artifact(name, type=type, metadata=metadata or {})
+        if os.path.isdir(path):
+            art.add_dir(path)
+        else:
+            art.add_file(path)
+        self._wandb.log_artifact(art)
+
     def close(self):
         if self._fh is not None:
             self._fh.close()

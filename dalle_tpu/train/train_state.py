@@ -33,8 +33,12 @@ class TrainState:
         return cls(step=jnp.zeros((), jnp.int32), params=params,
                    opt_state=tx.init(params), apply_fn=apply_fn, tx=tx)
 
-    def apply_gradients(self, grads):
-        updates, opt_state = self.tx.update(grads, self.opt_state, self.params)
+    def apply_gradients(self, grads, **extra_args):
+        """``extra_args`` feed GradientTransformationExtraArgs members of the
+        chain — e.g. ``value=loss`` drives the plateau schedule; plain
+        transforms ignore them (the tx is wrapped with extra-args support)."""
+        updates, opt_state = self.tx.update(grads, self.opt_state, self.params,
+                                            **extra_args)
         params = optax.apply_updates(self.params, updates)
         return self.replace(step=self.step + 1, params=params, opt_state=opt_state)
 
@@ -52,10 +56,10 @@ def make_lr_schedule(cfg: OptimConfig):
                                         transition_steps=cfg.lr_transition_steps,
                                         decay_rate=cfg.lr_decay_rate)
     elif cfg.lr_scheduler == "plateau":
-        # ReduceLROnPlateau is control-flow on a host metric; approximated by
-        # cosine decay (the trainer may also rebuild the tx on plateau host-side)
-        sched = optax.cosine_decay_schedule(cfg.learning_rate,
-                                            max(cfg.total_steps, 1), alpha=0.1)
+        # base lr stays constant; the ReduceLROnPlateau behavior is an
+        # in-graph update scaler appended by make_optimizer (driven by the
+        # step's loss via apply_gradients(value=...))
+        sched = optax.constant_schedule(cfg.learning_rate)
     else:
         raise ValueError(f"unknown lr_scheduler {cfg.lr_scheduler!r}")
     if cfg.warmup_steps > 0:
@@ -79,10 +83,21 @@ def make_optimizer(cfg: OptimConfig) -> optax.GradientTransformation:
     if cfg.grad_clip_norm and cfg.grad_clip_norm > 0:
         parts.append(optax.clip_by_global_norm(cfg.grad_clip_norm))
     parts.append(core)
+    if cfg.lr_scheduler == "plateau":
+        # ReduceLROnPlateau parity (reference legacy/train_dalle.py:444-459),
+        # as an update scaler fed the loss through apply_gradients(value=...)
+        if cfg.grad_accum_steps > 1:
+            raise ValueError("plateau schedule is incompatible with "
+                             "grad_accum_steps > 1 (MultiSteps drops the "
+                             "loss value the plateau state needs)")
+        from optax import contrib
+        parts.append(contrib.reduce_on_plateau(
+            factor=cfg.plateau_factor, patience=cfg.plateau_patience,
+            cooldown=cfg.plateau_cooldown, min_scale=cfg.plateau_min_scale))
     tx = optax.chain(*parts)
     if cfg.grad_accum_steps > 1:
         tx = optax.MultiSteps(tx, every_k_schedule=cfg.grad_accum_steps)
-    return tx
+    return optax.with_extra_args_support(tx)
 
 
 def compute_dtype(precision) -> Any:

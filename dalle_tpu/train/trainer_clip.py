@@ -35,7 +35,7 @@ def make_clip_train_step(model: CLIP, dtype=None):
     @partial(jax.jit, donate_argnums=(0,))
     def step(state: TrainState, text, images):
         loss, grads = jax.value_and_grad(loss_fn)(state.params, text, images)
-        state = state.apply_gradients(grads)
+        state = state.apply_gradients(grads, value=loss)
         return state, {"loss": loss, "grad_norm": optax.global_norm(grads)}
 
     return step

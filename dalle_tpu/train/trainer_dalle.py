@@ -56,7 +56,7 @@ def make_dalle_train_step(model: DALLE, *, null_cond_prob: float = 0.0,
     def step(state: TrainState, text, image_ids, key):
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, text, image_ids, key)
-        new_state = state.apply_gradients(grads)
+        new_state = state.apply_gradients(grads, value=loss)
         metrics = {"loss": loss, "grad_norm": optax.global_norm(grads), **aux}
         return new_state, metrics
 

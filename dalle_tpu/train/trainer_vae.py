@@ -55,7 +55,7 @@ def make_vae_train_step(model: DiscreteVAE, dtype=None):
     def step(state: TrainState, images, key, temp):
         (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, images, key, temp)
-        state = state.apply_gradients(grads)
+        state = state.apply_gradients(grads, value=loss)
         return state, {"loss": loss, "grad_norm": optax.global_norm(grads)}
 
     return step

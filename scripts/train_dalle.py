@@ -73,6 +73,16 @@ def build_parser():
     train.add_argument("--sample_dir", type=str, default="./dalle_samples")
     train.add_argument("--resume", action="store_true")
     train.add_argument("--seed", type=int, default=42)
+    train.add_argument("--lr_scheduler", type=str, default="constant",
+                       choices=["constant", "cosine", "exponential", "plateau"],
+                       help="plateau = ReduceLROnPlateau parity (ref :444-459)")
+    train.add_argument("--wandb", action="store_true",
+                       help="mirror metrics/images/artifacts to wandb "
+                            "(ref legacy/train_dalle.py:463-476)")
+    train.add_argument("--wandb_project", type=str, default="dalle_train_transformer")
+    train.add_argument("--wandb_name", type=str, default=None)
+    train.add_argument("--log_artifacts", action="store_true",
+                       help="upload each checkpoint as a wandb artifact (ref :667-669)")
     train.add_argument("--steps", type=int, default=None)
     train.add_argument("--no_preflight", action="store_true")
     train.add_argument("--flops_profiler", action="store_true",
@@ -129,9 +139,11 @@ def main(argv=None):
         preflight_checkpoint=not args.no_preflight,
         sample_every_steps=args.sample_every_steps,
         profile_step=200 if args.flops_profiler else 0,
+        log_artifacts=args.log_artifacts,
         optim=OptimConfig(learning_rate=args.learning_rate,
                           grad_clip_norm=args.clip_grad_norm,
-                          grad_accum_steps=args.ga_steps))
+                          grad_accum_steps=args.ga_steps,
+                          lr_scheduler=args.lr_scheduler))
 
     trainer = DalleTrainer(model_cfg, train_cfg, backend=backend,
                            null_cond_prob=args.null_cond_prob)
@@ -182,6 +194,17 @@ def main(argv=None):
         raw = ds.batches(args.batch_size, epochs=args.epochs)
         batches = (encode_batch(imgs, caps) for imgs, caps in raw)
 
+    # metrics sink: JSONL always; wandb scalars/images/artifacts when asked
+    # (reference legacy/train_dalle.py:463-476,639-649,667-669)
+    from dalle_tpu.train.metrics import MetricsLogger
+    metrics_writer = None
+    if is_root:
+        metrics_writer = MetricsLogger(
+            path=os.path.join(args.output_dir, "metrics.jsonl"),
+            use_wandb=args.wandb, project=args.wandb_project,
+            run_name=args.wandb_name,
+            config={"model": model_cfg.to_dict(), "train": train_cfg.to_dict()})
+
     # periodic in-training sampling (reference :639-649)
     sample_fn = None
     if args.sample_every_steps:
@@ -195,6 +218,9 @@ def main(argv=None):
             imgs = dv.generate_images(sample_text, jax.random.PRNGKey(step))
             save_image_grid(imgs, os.path.join(
                 args.sample_dir, f"step{step}_{{}}.png"))
+            if metrics_writer is not None:
+                metrics_writer.log_images(step, imgs, key="generated",
+                                          captions=["sample"] * len(imgs))
             if is_root:
                 print(f"[step {step}] wrote sample to {args.sample_dir}")
 
@@ -206,11 +232,14 @@ def main(argv=None):
     steps = args.steps
     if args.flops_profiler:
         steps = 201  # profile at 200 then stop (reference :656-657)
-    trainer.fit(batches, steps=steps, log=log, sample_fn=sample_fn)
+    trainer.fit(batches, steps=steps, log=log, sample_fn=sample_fn,
+                metrics_writer=metrics_writer)
 
     final = int(trainer.state.step)
     if trainer.ckpt.latest_step() != final:
         trainer.ckpt.save(final, trainer.state, trainer._meta())
+    if metrics_writer is not None:
+        metrics_writer.close()
     if is_root:
         print(f"done at step {final}; checkpoints in {args.output_dir}")
     return 0
