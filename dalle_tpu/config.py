@@ -347,6 +347,13 @@ class VQGANConfig(ConfigBase):
     beta: float = 0.25        # commitment cost
     gumbel_kl_weight: float = 5e-4
     straight_through: bool = True
+    # index remapping onto a used-codes subset (taming quantize.py:303-310
+    # remap/sane_index_shape): interface indices live in [0, len(remap_used))
+    # with unknown codes mapped per remap_unknown ('random' | 'extra' | int).
+    # Our indices are already (b, h, w)-shaped internally, so the reference's
+    # sane_index_shape flag is inherently true.
+    remap_used: Optional[Tuple[int, ...]] = None
+    remap_unknown: str = "random"
 
     @property
     def num_layers(self) -> int:

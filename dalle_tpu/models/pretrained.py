@@ -261,7 +261,15 @@ def vqgan_config_from_yaml(path: str) -> VQGANConfig:
     p = y["model"]["params"]
     dd = p["ddconfig"]
     target = y["model"].get("target", "")
+    remap = p.get("remap")
+    if isinstance(remap, str):
+        # taming passes remap as a path to an .npy of used code ids
+        remap = tuple(int(i) for i in np.load(remap))
+    elif remap is not None:
+        remap = tuple(int(i) for i in remap)
     return VQGANConfig(
+        remap_used=remap,
+        remap_unknown=str(p.get("unknown_index", "random")),
         embed_dim=p["embed_dim"], n_embed=p["n_embed"],
         double_z=dd.get("double_z", False), z_channels=dd["z_channels"],
         resolution=dd["resolution"], in_channels=dd["in_channels"],
@@ -365,7 +373,13 @@ class VQGanVAE(VAEAdapter):
         import math
         f = cfg.resolution // self.model.fmap_size
         self.num_layers = int(math.log2(f))
-        self.num_tokens = cfg.n_embed
+        # with remap the interface vocab is the used subset (+1 for the
+        # 'extra' unknown token) — taming's re_embed (quantize.py:229-236)
+        if cfg.remap_used is not None:
+            self.num_tokens = (len(cfg.remap_used)
+                               + (1 if cfg.remap_unknown == "extra" else 0))
+        else:
+            self.num_tokens = cfg.n_embed
         self._encode = jax.jit(lambda p, x: self.model.apply(
             p, 2.0 * x - 1.0, method=VQModel.get_codebook_indices))
         self._decode = jax.jit(lambda p, ids: jnp.clip(

@@ -26,7 +26,8 @@ import jax
 import jax.numpy as jnp
 
 from ..config import VQGANConfig
-from ..ops.quantize import VQOutput, gumbel_quantize, vector_quantize
+from ..ops.quantize import (VQOutput, gumbel_quantize, remap_indices,
+                            unmap_indices, vector_quantize)
 
 
 def swish(x):
@@ -219,11 +220,19 @@ class VQModel(nn.Module):
     def get_codebook_indices(self, img):
         out = self.encode(img, deterministic=True)
         b = out.indices.shape[0]
-        return out.indices.reshape(b, -1)
+        ids = out.indices
+        if self.cfg.remap_used is not None:
+            # restricted-vocab checkpoints (taming quantize.py remap): expose
+            # indices in the used subset's id space
+            ids = remap_indices(ids, self.cfg.remap_used,
+                                unknown=self.cfg.remap_unknown)
+        return ids.reshape(b, -1)
 
     def decode_code(self, ids):
         b, n = ids.shape
         hw = int(n ** 0.5)
+        if self.cfg.remap_used is not None:
+            ids = unmap_indices(ids, self.cfg.remap_used)
         # a second-stage sampler's vocab may exceed n_embed (taming GPT vocab
         # covers cond codes too); clamp instead of XLA's undefined OOB gather
         ids = jnp.clip(ids, 0, self.cfg.n_embed - 1)

@@ -80,6 +80,35 @@ def gumbel_quantize(key: jax.Array, logits: jnp.ndarray, codebook: jnp.ndarray,
     return VQOutput(zq, idx, kl)
 
 
+def remap_indices(idx: jnp.ndarray, used, unknown="random",
+                  key: Optional[jax.Array] = None) -> jnp.ndarray:
+    """Map full-codebook indices onto a restricted ``used`` subset — parity
+    with VectorQuantizer2 ``remap_to_used`` (taming quantize.py:238-248):
+    indices not in ``used`` become a random used index (``unknown='random'``),
+    the extra index ``len(used)`` (``'extra'``), or a fixed int."""
+    used = jnp.asarray(used)
+    match = idx[..., None] == used          # (..., n_used)
+    found = jnp.any(match, axis=-1)
+    new = jnp.argmax(match, axis=-1)
+    if unknown == "random":
+        key = key if key is not None else jax.random.PRNGKey(0)
+        fill = jax.random.randint(key, idx.shape, 0, used.shape[0])
+    elif unknown == "extra":
+        fill = jnp.full(idx.shape, used.shape[0])
+    else:
+        fill = jnp.full(idx.shape, int(unknown))
+    return jnp.where(found, new, fill).astype(jnp.int32)
+
+
+def unmap_indices(idx: jnp.ndarray, used) -> jnp.ndarray:
+    """Inverse of ``remap_indices`` — VectorQuantizer2 ``unmap_to_all``
+    (taming quantize.py:250-256): out-of-range (the 'extra' token) collapses
+    to used[0], then gather back to full-codebook ids."""
+    used = jnp.asarray(used)
+    idx = jnp.where(idx >= used.shape[0], 0, idx)
+    return used[idx].astype(jnp.int32)
+
+
 def kl_to_uniform(logits: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
     """KL(softmax(logits) ‖ uniform), 'batchmean' reduction — summed over
     positions and vocab, divided by batch size (leading dim), matching the dVAE

@@ -216,3 +216,44 @@ class TestVariantModes:
         for _ in range(10):
             m = tr.train_step(imgs, seg)
         assert m["nll_loss"] < first
+
+
+class TestRemap:
+    """Index remapping onto a used-codes subset (taming quantize.py:238-256,
+    303-310: remap/unknown_index/sane_index_shape)."""
+
+    def test_remap_unmap_round_trip(self):
+        from dalle_tpu.ops.quantize import remap_indices, unmap_indices
+        used = (3, 7, 11, 42)
+        idx = jnp.asarray([[3, 42, 7], [11, 3, 11]])
+        re = remap_indices(idx, used)
+        assert re.tolist() == [[0, 3, 1], [2, 0, 2]]
+        back = unmap_indices(re, used)
+        assert back.tolist() == idx.tolist()
+
+    def test_unknown_modes(self):
+        from dalle_tpu.ops.quantize import remap_indices, unmap_indices
+        used = (3, 7)
+        idx = jnp.asarray([5, 3])          # 5 is not a used code
+        extra = remap_indices(idx, used, unknown="extra")
+        assert extra.tolist() == [2, 0]
+        # 'extra' collapses to used[0] on the way back
+        assert unmap_indices(extra, used).tolist() == [3, 3]
+        fixed = remap_indices(idx, used, unknown=1)
+        assert fixed.tolist() == [1, 0]
+        rand = remap_indices(idx, used, unknown="random",
+                             key=jax.random.PRNGKey(0))
+        assert 0 <= int(rand[0]) < len(used) and int(rand[1]) == 0
+
+    def test_vqmodel_remap_interface(self, rng):
+        cfg = VQGANConfig(resolution=16, ch=8, ch_mult=(1, 2),
+                          num_res_blocks=1, attn_resolutions=(8,),
+                          z_channels=4, embed_dim=4, n_embed=16,
+                          remap_used=(0, 2, 5, 9, 13), remap_unknown="extra")
+        model, params = init_vqgan(cfg, jax.random.PRNGKey(0))
+        img = jnp.asarray(rng.rand(2, 16, 16, 3).astype(np.float32) * 2 - 1)
+        ids = model.apply(params, img, method=VQModel.get_codebook_indices)
+        assert int(jnp.max(ids)) <= len(cfg.remap_used)  # used ids + extra
+        rec = model.apply(params, ids, method=VQModel.decode_code)
+        assert rec.shape == (2, 16, 16, 3)
+        assert bool(jnp.all(jnp.isfinite(rec)))
