@@ -90,8 +90,12 @@ def build_block_lists(n_pad: int, block_q: int, block_k: int,
 # kernels (grid = (b, h, n_blocks); block lists in SMEM via scalar prefetch)
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(ids_ref, cnt_ref, q_ref, k_ref, v_ref, mask_ref,
-                o_ref, lse_ref, *, scale, block_k, n_valid, causal):
+def _fwd_kernel(ids_ref, cnt_ref, q_ref, k_ref, v_ref, *rest,
+                scale, block_k, n_valid, causal, has_mask):
+    if has_mask:
+        mask_ref, o_ref, lse_ref = rest
+    else:
+        o_ref, lse_ref = rest
     iq = pl.program_id(2)
     bq, d = q_ref.shape[2], q_ref.shape[3]
     q = q_ref[0, 0].astype(jnp.float32) * scale                    # (bq, d)
@@ -109,7 +113,8 @@ def _fwd_kernel(ids_ref, cnt_ref, q_ref, k_ref, v_ref, mask_ref,
         valid = kpos < n_valid
         if causal:
             valid &= kpos <= qpos
-        valid &= mask_ref[:, pl.ds(jb * block_k, block_k)] > 0
+        if has_mask:
+            valid &= mask_ref[:, pl.ds(jb * block_k, block_k)] > 0
         s = jnp.where(valid, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         # for a fully-masked row m_new == NEG_INF and exp(s - m_new) would be
@@ -135,8 +140,12 @@ def _fwd_kernel(ids_ref, cnt_ref, q_ref, k_ref, v_ref, mask_ref,
 
 
 def _bwd_dq_kernel(ids_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                   delta_ref, mask_ref, dq_ref, *, scale, block_k, n_valid,
-                   causal):
+                   delta_ref, *rest, scale, block_k, n_valid, causal,
+                   has_mask):
+    if has_mask:
+        mask_ref, dq_ref = rest
+    else:
+        (dq_ref,) = rest
     iq = pl.program_id(2)
     bq, d = q_ref.shape[2], q_ref.shape[3]
     q = q_ref[0, 0].astype(jnp.float32) * scale
@@ -156,7 +165,8 @@ def _bwd_dq_kernel(ids_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         valid = kpos < n_valid
         if causal:
             valid &= kpos <= qpos
-        valid &= mask_ref[:, pl.ds(jb * block_k, block_k)] > 0
+        if has_mask:
+            valid &= mask_ref[:, pl.ds(jb * block_k, block_k)] > 0
         s = jnp.where(valid, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -170,8 +180,12 @@ def _bwd_dq_kernel(ids_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 
 def _bwd_dkv_kernel(ids_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                    delta_ref, mask_ref, dk_ref, dv_ref, *, scale, block_q,
-                    n_valid, causal):
+                    delta_ref, *rest, scale, block_q, n_valid, causal,
+                    has_mask):
+    if has_mask:
+        mask_ref, dk_ref, dv_ref = rest
+    else:
+        dk_ref, dv_ref = rest
     jk = pl.program_id(2)
     bk, d = dk_ref.shape[2], dk_ref.shape[3]
     k = k_ref[0, 0].astype(jnp.float32)                            # (bk, d)
@@ -192,7 +206,8 @@ def _bwd_dkv_kernel(ids_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         valid = kpos < n_valid
         if causal:
             valid &= kpos <= qpos
-        valid &= mask_ref[pl.ds(ib * block_q, block_q), :] > 0
+        if has_mask:
+            valid &= mask_ref[pl.ds(ib * block_q, block_q), :] > 0
         s = jnp.where(valid, s, NEG_INF)
         p = jnp.exp(s - lse)                                       # (blkq, bk)
         dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
@@ -234,10 +249,16 @@ def _make_flash_fn(n: int, n_pad: int, block_q: int, block_k: int,
         buf, shape = mask_key
         mask_np = np.frombuffer(buf, dtype=bool).reshape(shape)
     lists = build_block_lists(n_pad, block_q, block_k, mask_np, causal)
-    mask_pad = np.zeros((n_pad, n_pad), dtype=np.int32)  # int32: Mosaic v5e lacks i8 vector compare
-    if mask_np is None:
-        mask_pad[:, :] = 1
-    else:
+    # with no element mask (pure causal / padding handled by iota compares)
+    # the kernels take no mask operand at all — the (block_q, n_pad) int32
+    # mask row was as much VMEM traffic per grid step as the scores
+    # themselves, and the dkv kernel's scoped VMEM overflowed at long seq
+    has_mask = mask_np is not None
+    # int32 mask: Mosaic v5e has no i8 or packed-bf16 vector compare, so 4
+    # bytes/entry is the narrowest workable element mask; long-seq masked
+    # configs therefore top out at block 128/256 (VMEM), which the tuner picks
+    mask_pad = np.zeros((n_pad, n_pad), dtype=np.int32)
+    if has_mask:
         s = min(mask_np.shape[0], n_pad)
         mask_pad[:s, :s] = mask_np[:s, :s]
     # keep closure constants as NUMPY: jnp conversion inside a jit trace would
@@ -252,15 +273,20 @@ def _make_flash_fn(n: int, n_pad: int, block_q: int, block_k: int,
 
     def _fwd_call(q, k, v, scale):
         b, h, _, d = q.shape
+        in_specs = [
+            _qblock_spec(d, block_q),
+            _full_spec(n_pad, d),
+            _full_spec(n_pad, d),
+        ]
+        operands = [k_ids, k_cnt, q, k, v]
+        if has_mask:
+            in_specs.append(
+                pl.BlockSpec((block_q, n_pad), lambda ib, ih, i, *_: (i, 0)))
+            operands.append(mask_c)
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(b, h, nq),
-            in_specs=[
-                _qblock_spec(d, block_q),
-                _full_spec(n_pad, d),
-                _full_spec(n_pad, d),
-                pl.BlockSpec((block_q, n_pad), lambda ib, ih, i, *_: (i, 0)),
-            ],
+            in_specs=in_specs,
             out_specs=[
                 _qblock_spec(d, block_q),
                 pl.BlockSpec((1, 1, block_q, 128),
@@ -269,14 +295,14 @@ def _make_flash_fn(n: int, n_pad: int, block_q: int, block_k: int,
         )
         return pl.pallas_call(
             functools.partial(_fwd_kernel, scale=scale, block_k=block_k,
-                              n_valid=n, causal=causal),
+                              n_valid=n, causal=causal, has_mask=has_mask),
             grid_spec=grid_spec,
             out_shape=[
                 jax.ShapeDtypeStruct((b, h, n_pad, d), q.dtype),
                 jax.ShapeDtypeStruct((b, h, n_pad, 128), jnp.float32),
             ],
             interpret=interpret,
-        )(k_ids, k_cnt, q, k, v, mask_c)
+        )(*operands)
 
     @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
     def flash(q, k, v, scale):
@@ -297,56 +323,66 @@ def _make_flash_fn(n: int, n_pad: int, block_q: int, block_k: int,
         delta = jnp.broadcast_to(delta[..., None], delta.shape + (128,))
         lse_qspec = pl.BlockSpec((1, 1, block_q, 128),
                                  lambda ib, ih, i, *_: (ib, ih, i, 0))
+        dq_in_specs = [
+            _qblock_spec(d, block_q),
+            _full_spec(n_pad, d),
+            _full_spec(n_pad, d),
+            _qblock_spec(d, block_q),
+            lse_qspec,
+            lse_qspec,
+        ]
+        dq_operands = [k_ids, k_cnt, qp, kp, vp, gp, lse, delta]
+        if has_mask:
+            dq_in_specs.append(
+                pl.BlockSpec((block_q, n_pad), lambda ib, ih, i, *_: (i, 0)))
+            dq_operands.append(mask_c)
         dq_grid = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(b, h, nq),
-            in_specs=[
-                _qblock_spec(d, block_q),
-                _full_spec(n_pad, d),
-                _full_spec(n_pad, d),
-                _qblock_spec(d, block_q),
-                lse_qspec,
-                lse_qspec,
-                pl.BlockSpec((block_q, n_pad), lambda ib, ih, i, *_: (i, 0)),
-            ],
+            in_specs=dq_in_specs,
             out_specs=_qblock_spec(d, block_q),
         )
         dq = pl.pallas_call(
             functools.partial(_bwd_dq_kernel, scale=scale, block_k=block_k,
-                              n_valid=n, causal=causal),
+                              n_valid=n, causal=causal, has_mask=has_mask),
             grid_spec=dq_grid,
             out_shape=jax.ShapeDtypeStruct((b, h, n_pad, d), qp.dtype),
             interpret=interpret,
-        )(k_ids, k_cnt, qp, kp, vp, gp, lse, delta, mask_c)
+        )(*dq_operands)
 
         kblock_spec = pl.BlockSpec((1, 1, block_k, d),
                                    lambda ib, ih, j, *_: (ib, ih, j, 0))
         lse_fullspec = pl.BlockSpec((1, 1, n_pad, 128),
                                     lambda ib, ih, j, *_: (ib, ih, 0, 0))
+        dkv_in_specs = [
+            _full_spec(n_pad, d),
+            kblock_spec,
+            kblock_spec,
+            _full_spec(n_pad, d),
+            lse_fullspec,
+            lse_fullspec,
+        ]
+        dkv_operands = [q_ids, q_cnt, qp, kp, vp, gp, lse, delta]
+        if has_mask:
+            dkv_in_specs.append(
+                pl.BlockSpec((n_pad, block_k), lambda ib, ih, j, *_: (0, j)))
+            dkv_operands.append(mask_c)
         dkv_grid = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(b, h, nk),
-            in_specs=[
-                _full_spec(n_pad, d),
-                kblock_spec,
-                kblock_spec,
-                _full_spec(n_pad, d),
-                lse_fullspec,
-                lse_fullspec,
-                pl.BlockSpec((n_pad, block_k), lambda ib, ih, j, *_: (0, j)),
-            ],
+            in_specs=dkv_in_specs,
             out_specs=[kblock_spec, kblock_spec],
         )
         dk, dv = pl.pallas_call(
             functools.partial(_bwd_dkv_kernel, scale=scale, block_q=block_q,
-                              n_valid=n, causal=causal),
+                              n_valid=n, causal=causal, has_mask=has_mask),
             grid_spec=dkv_grid,
             out_shape=[
                 jax.ShapeDtypeStruct((b, h, n_pad, d), qp.dtype),
                 jax.ShapeDtypeStruct((b, h, n_pad, d), qp.dtype),
             ],
             interpret=interpret,
-        )(q_ids, q_cnt, qp, kp, vp, gp, lse, delta, mask_c)
+        )(*dkv_operands)
         return dq[:, :, :n], dk[:, :, :n], dv[:, :, :n]
 
     flash.defvjp(flash_fwd, flash_bwd)
@@ -363,10 +399,23 @@ def sparsity_fraction(n: int, block_q: int = 128, block_k: int = 128,
     return float(lists.k_cnt.sum()) / float(nq * nk)
 
 
+def _auto_block(n: int, has_mask: bool) -> int:
+    """Measured v5e defaults (scripts/bench_flash.py, fwd+bwd, bf16):
+    mask-free kernels carry no element-mask operand so bigger blocks fit;
+    masked kernels hold a (block, n_pad) int32 mask row and hit the 16M
+    scoped-VMEM limit earlier as n grows."""
+    if has_mask:
+        blk = 256 if n <= 2560 else 128
+    else:
+        blk = 512 if n <= 2560 else 256
+    return min(blk, max(128, _ceil_to(n, 128)))
+
+
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                     mask: Optional[np.ndarray] = None,
                     causal: bool = True,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     scale: Optional[float] = None,
                     interpret: Optional[bool] = None) -> jnp.ndarray:
     """Flash attention over (b, h, n, d) with optional static (n, n) bool mask.
@@ -376,11 +425,16 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     are skipped entirely via host-precomputed block lists.
 
     ``mask`` must be host-side numpy (it is a compile-time sparsity pattern).
+    ``block_q``/``block_k`` default to measured-on-v5e auto sizes.
     ``interpret`` defaults to True off-TPU so tests run on CPU.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     n = q.shape[2]
+    if block_q is None:
+        block_q = _auto_block(n, mask is not None)
+    if block_k is None:
+        block_k = _auto_block(n, mask is not None)
     if scale is None:
         scale = q.shape[-1] ** -0.5
     n_pad = _ceil_to(n, max(block_q, block_k))

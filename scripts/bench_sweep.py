@@ -86,6 +86,17 @@ def main():
         elif w == "big":
             BIG = dict(MEDIUM, dim=2048, depth=24, heads=16, dim_head=128)
             run("big_b16", BIG, 16)
+        elif w == "longseq":
+            # long-sequence regime (4096 image tokens — the reference's
+            # "2048 visual tokens" anecdote class, README:32-34): sparse
+            # attention interleave; pallas flash + block skipping vs dense
+            LS = dict(num_text_tokens=10000, text_seq_len=256, dim=512,
+                      depth=4, heads=8, dim_head=64, image_size=512,
+                      image_vocab_size=8192, image_fmap_size=64,
+                      attn_types=("full", "axial_row", "axial_col", "full"),
+                      attn_softmax_f32=False)
+            run("longseq_dense_b2", LS, 2, steps=4)
+            run("longseq_pallas_b2", dict(LS, use_pallas=True), 2, steps=4)
         else:
             print(f"unknown config {w}", file=sys.stderr)
 

@@ -15,7 +15,14 @@ import os
 # sitecustomize imports jax at interpreter startup, so env vars are too late —
 # but backends initialize lazily, so jax.config.update still wins as long as no
 # plugin has created a client yet.
-os.environ["JAX_PLATFORMS"] = "cpu"
+#
+# DALLE_TPU_TESTS=1 keeps the real accelerator instead, enabling the
+# TPU-gated tests (e.g. Mosaic compilation of the pallas kernels in
+# test_flash_attention.py) — the rest of the suite still passes but runs
+# slower through the device tunnel.
+_USE_REAL_TPU = os.environ.get("DALLE_TPU_TESTS") == "1"
+if not _USE_REAL_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
@@ -24,7 +31,8 @@ import jax  # noqa: E402
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _USE_REAL_TPU:
+    jax.config.update("jax_platforms", "cpu")
 
 jax.config.update("jax_default_matmul_precision", "float32")
 
