@@ -53,6 +53,14 @@ def build_parser():
     train.add_argument("--steps", type=int, default=None,
                        help="hard stop after N steps (overrides epochs)")
     train.add_argument("--no_preflight", action="store_true")
+    train.add_argument("--sample_every_steps", type=int, default=0,
+                       help="log recon grids + codebook histogram every N "
+                            "steps (ref legacy/train_vae.py:245-264)")
+    train.add_argument("--sample_dir", type=str, default="./vae_samples")
+    train.add_argument("--wandb", action="store_true")
+    train.add_argument("--wandb_project", type=str, default="dalle_train_vae")
+    train.add_argument("--wandb_name", type=str, default=None)
+    train.add_argument("--log_artifacts", action="store_true")
 
     from dalle_tpu.parallel import wrap_arg_parser
     wrap_arg_parser(ap)
@@ -83,6 +91,8 @@ def main(argv=None):
         checkpoint_dir=args.output_dir, save_every_steps=args.save_every_steps,
         keep_n_checkpoints=args.keep_n_checkpoints,
         preflight_checkpoint=not args.no_preflight,
+        sample_every_steps=args.sample_every_steps,
+        log_artifacts=args.log_artifacts,
         optim=OptimConfig(learning_rate=args.learning_rate,
                           grad_clip_norm=args.clip_grad_norm,
                           lr_scheduler="exponential",
@@ -106,8 +116,45 @@ def main(argv=None):
         print(f"dataset: {len(ds)} samples; mesh {dict(backend.mesh.shape)}")
 
     trainer = VAETrainer(model_cfg, train_cfg, anneal, backend=backend)
-    log = print if backend.is_root_worker() else (lambda *a, **k: None)
-    trainer.fit(batches, steps=args.steps, log=log)
+    is_root = backend.is_root_worker()
+    log = print if is_root else (lambda *a, **k: None)
+
+    from dalle_tpu.train.metrics import MetricsLogger
+    metrics_writer = None
+    if is_root:
+        metrics_writer = MetricsLogger(
+            path=os.path.join(args.output_dir, "metrics.jsonl"),
+            use_wandb=args.wandb, project=args.wandb_project,
+            run_name=args.wandb_name, config={"model": model_cfg.to_dict()})
+
+    # recon grids + codebook-collapse histogram (ref train_vae.py:245-264)
+    sample_fn = None
+    if args.sample_every_steps:
+        import numpy as np
+        os.makedirs(args.sample_dir, exist_ok=True)
+        probe = next(iter(ds.batches(min(args.batch_size, 8), epochs=1)))[0] \
+            if not args.synthetic else ds.as_arrays(limit=8)[0]
+
+        def sample_fn(step):
+            recons = np.asarray(trainer.reconstruct(probe, hard=True))
+            from PIL import Image
+            grid = (np.concatenate([np.concatenate(list(probe), 1),
+                                    np.concatenate(list(recons), 1)], 0)
+                    * 255).clip(0, 255).astype("uint8")
+            Image.fromarray(grid).save(
+                os.path.join(args.sample_dir, f"step{step}_recon.png"))
+            hist = trainer.codebook_histogram(probe)
+            used = int((hist > 0).sum())
+            if metrics_writer is not None:
+                metrics_writer.log(step, {"codebook_used": used})
+                metrics_writer.log_images(step, recons, key="hard_recons")
+            log(f"[step {step}] recon grid → {args.sample_dir}; "
+                f"codebook codes used: {used}/{model_cfg.num_tokens}")
+
+    trainer.fit(batches, steps=args.steps, log=log, sample_fn=sample_fn,
+                metrics_writer=metrics_writer)
+    if metrics_writer is not None:
+        metrics_writer.close()
 
     final = int(trainer.state.step)
     if trainer.ckpt.latest_step() != final:  # avoid re-saving an existing step
