@@ -263,6 +263,12 @@ class DalleConfig(ConfigBase):
     ff_dropout: float = 0.0
     attn_types: Tuple[str, ...] = ("full",)
     loss_img_weight: float = 7.0
+    # >0: compute the vocab-head + cross-entropy in rematerialized sequence
+    # chunks of this size — the (b, n, total_tokens) logits tensor never
+    # materializes, trading one extra head matmul in backward for the HBM
+    # that otherwise caps the batch size (total_tokens ≈ 58k with the CLIP
+    # vocab makes full logits the largest activation in the step)
+    loss_chunk: int = 0
     stable: bool = False
     sandwich_norm: bool = False
     shift_tokens: bool = False

@@ -58,6 +58,14 @@ class AxialPositionalEmbedding(nn.Module):
         return emb if n is None else emb[:n]
 
 
+def _ce_chunk_body(mdl, x_c, lbl_c, start: int):
+    """Head + cross-entropy for one sequence chunk — module-first so
+    ``nn.remat`` can lift it (same pattern as transformer._block_body)."""
+    logits = mdl._finish(x_c, (start, x_c.shape[1]))
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), lbl_c)
+
+
 class DALLE(nn.Module):
     cfg: DalleConfig
 
@@ -184,15 +192,27 @@ class DALLE(nn.Module):
         tokens = self._stabilize(tokens)
 
         out = self.transformer(tokens, deterministic=deterministic)
-        logits = self._finish(out, (0, tokens.shape[1]))
 
         if not return_loss:
-            return logits
+            return self._finish(out, (0, tokens.shape[1]))
 
         labels = jnp.concatenate(
             [text_b[:, 1:], image_ids + self.num_text_tokens], axis=1)
-        logits32 = logits.astype(jnp.float32)
-        ce = optax.softmax_cross_entropy_with_integer_labels(logits32, labels)
+        n = tokens.shape[1]
+        if c.loss_chunk > 0 and n % c.loss_chunk == 0 and not self.is_initializing():
+            # chunked head+CE under remat: full (b, n, vocab) logits never hit
+            # HBM — each chunk's logits are recomputed in backward
+            parts = []
+            for i in range(0, n, c.loss_chunk):
+                body = nn.remat(_ce_chunk_body, prevent_cse=False,
+                                static_argnums=(3,))
+                parts.append(body(self, out[:, i:i + c.loss_chunk],
+                                  labels[:, i:i + c.loss_chunk], i))
+            ce = jnp.concatenate(parts, axis=1)
+        else:
+            logits = self._finish(out, (0, n))
+            logits32 = logits.astype(jnp.float32)
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits32, labels)
         loss_text = ce[:, :c.text_seq_len].mean()
         loss_img = ce[:, c.text_seq_len:].mean()
         loss = (loss_text + c.loss_img_weight * loss_img) / (c.loss_img_weight + 1)
