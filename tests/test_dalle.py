@@ -194,13 +194,13 @@ def test_chunked_loss_matches_full():
     from dalle_tpu.models.dalle import init_dalle
 
     rng = np.random.RandomState(0)
-    kw = dict(num_text_tokens=64, text_seq_len=16, dim=64, depth=2, heads=2,
-              dim_head=32, image_size=32, image_vocab_size=64,
-              image_fmap_size=4)
-    text = rng.randint(1, 64, (2, 16))
-    ids = rng.randint(0, 64, (2, 16))
+    kw = dict(num_text_tokens=64, text_seq_len=8, dim=32, depth=1, heads=2,
+              dim_head=16, image_size=16, image_vocab_size=64,
+              image_fmap_size=2)
+    text = rng.randint(1, 64, (2, 8))
+    ids = rng.randint(0, 64, (2, 4))
     m_full, params = init_dalle(DalleConfig(**kw), jax.random.PRNGKey(0))
-    m_chunk, _ = init_dalle(DalleConfig(**kw, loss_chunk=8),
+    m_chunk, _ = init_dalle(DalleConfig(**kw, loss_chunk=4),
                             jax.random.PRNGKey(0))
 
     def loss(m):
