@@ -246,9 +246,12 @@ class DALLE(nn.Module):
 
     def generate_images_tokens(self, text, key, *, filter_thres: float = 0.5,
                                temperature: float = 1.0, cond_scale: float = 1.0,
-                               image_prime: Optional[jnp.ndarray] = None):
+                               image_prime: Optional[jnp.ndarray] = None,
+                               cache_dtype=jnp.float32):
         """AR-sample the full image token sequence. Returns (b, image_seq_len)
         int32 codebook ids. ``text`` must be (b, text_seq_len).
+        ``cache_dtype=bf16`` halves the KV-cache traffic of the decode loop
+        (sampling itself always runs on f32 logits).
         (reference generate_images :490-557 minus vae decode/CLIP, which live in
         DalleWithVae)"""
         c = self.cfg
@@ -257,10 +260,12 @@ class DALLE(nn.Module):
         n_steps = c.image_seq_len - n_prime
         use_cfg = cond_scale != 1.0
 
-        logits, cache, prefix_len = self._prefill(text, image_prime, b)
+        logits, cache, prefix_len = self._prefill(text, image_prime, b,
+                                                  dtype=cache_dtype)
         if use_cfg:
             null_text = jnp.zeros_like(text)  # all-pad after remap
-            null_logits, null_cache, _ = self._prefill(null_text, image_prime, b)
+            null_logits, null_cache, _ = self._prefill(null_text, image_prime,
+                                                       b, dtype=cache_dtype)
             logits = null_logits + (logits - null_logits) * cond_scale
 
         def sample_from(logits, k):

@@ -97,8 +97,49 @@ def main():
                       attn_softmax_f32=False)
             run("longseq_dense_b2", LS, 2, steps=4)
             run("longseq_pallas_b2", dict(LS, use_pallas=True), 2, steps=4)
+        elif w == "gen":
+            bench_generation()
         else:
             print(f"unknown config {w}", file=sys.stderr)
+
+
+def bench_generation(batch=64, reps=3):
+    """Generation p50 latency, BASELINE config-5-shaped: DALL·E-small, 256
+    image tokens, batch 64, top-k 0.9; f32 vs bf16 decode (weights+cache)."""
+    import jax.numpy as jnp
+    from dalle_tpu.config import DalleConfig
+    from dalle_tpu.models.dalle import DALLE, init_dalle
+    from dalle_tpu.train.train_state import cast_floating
+
+    cfg = DalleConfig(**SMALL)
+    model, params = init_dalle(cfg, jax.random.PRNGKey(0))
+    text = np.zeros((batch, cfg.text_seq_len), np.int32)
+    text[:, :4] = 7
+
+    for precision in ("float32", "bfloat16"):
+        p = params if precision == "float32" else cast_floating(params, jnp.bfloat16)
+        cache_dtype = jnp.float32 if precision == "float32" else jnp.bfloat16
+
+        @jax.jit
+        def gen(p, text, key):
+            return model.apply(p, text, key, filter_thres=0.9,
+                               cache_dtype=cache_dtype,
+                               method=DALLE.generate_images_tokens)
+
+        ids = gen(p, text, jax.random.PRNGKey(0))
+        np.asarray(jax.device_get(ids[0, :1]))  # sync
+        times = []
+        for r in range(reps):
+            t0 = time.perf_counter()
+            ids = gen(p, text, jax.random.PRNGKey(r))
+            np.asarray(jax.device_get(ids[0, :1]))
+            times.append(time.perf_counter() - t0)
+        p50 = sorted(times)[len(times) // 2]
+        print(json.dumps({
+            "name": f"gen_b{batch}_{precision}", "p50_s": round(p50, 4),
+            "tokens_per_sec": round(batch * cfg.image_seq_len / p50, 1),
+            "unique_ids": int(len(np.unique(np.asarray(ids)))),
+        }), flush=True)
 
 
 if __name__ == "__main__":

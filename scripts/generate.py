@@ -36,6 +36,9 @@ def build_parser():
                     help="classifier-free guidance scale")
     ap.add_argument("--gentxt", action="store_true",
                     help="complete the caption with generate_texts first")
+    ap.add_argument("--bf16", action="store_true",
+                    help="bf16 weights + KV cache in the decode loop "
+                         "(~1.6x faster on TPU; sampling stays f32)")
     ap.add_argument("--outputs_dir", type=str, default="./outputs")
     ap.add_argument("--tokenizer", type=str, default="simple")
     ap.add_argument("--bpe_path", type=str, default=None)
@@ -115,7 +118,8 @@ def main(argv=None):
             batch_text = np.repeat(text, n, axis=0)
             imgs = dv.generate_images(
                 batch_text, bkey, filter_thres=args.top_k_thres,
-                temperature=args.temperature, cond_scale=args.cond_scale)
+                temperature=args.temperature, cond_scale=args.cond_scale,
+                precision="bfloat16" if args.bf16 else "float32")
             save_image_grid(np.asarray(imgs),
                             os.path.join(outdir, f"img_{made}_{{}}.png"))
             made += n
