@@ -126,6 +126,14 @@ class DalleWithVae:
             clip_model, clip_params = clip
             # pad-remapped ids exceed CLIP's text vocab; zero them back to pad
             clip_text = jnp.where(text >= clip_model.cfg.num_text_tokens, 0, text)
+            # CLIP may use a different text context than DALLE — crop or pad
+            # (an out-of-range position gather would fill with NaN)
+            n = clip_model.cfg.text_seq_len
+            if clip_text.shape[1] > n:
+                clip_text = clip_text[:, :n]
+            elif clip_text.shape[1] < n:
+                clip_text = jnp.pad(clip_text,
+                                    ((0, 0), (0, n - clip_text.shape[1])))
             scores = clip_model.apply(clip_params, clip_text, images)
             return images, scores
         return images

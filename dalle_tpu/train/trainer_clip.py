@@ -56,6 +56,7 @@ class CLIPTrainer(BaseTrainer):
         self.step_fn = make_clip_train_step(
             self.model, dtype=compute_dtype(train_cfg.precision))
         n = count_params(self.state.params)
+        self.num_params = n
         tokens_per_sample = (model_cfg.text_seq_len +
                              (model_cfg.visual_image_size //
                               model_cfg.visual_patch_size) ** 2)

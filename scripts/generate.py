@@ -39,6 +39,10 @@ def build_parser():
     ap.add_argument("--bf16", action="store_true",
                     help="bf16 weights + KV cache in the decode loop "
                          "(~1.6x faster on TPU; sampling stays f32)")
+    ap.add_argument("--clip_path", type=str, default=None,
+                    help="CLIP checkpoint dir (scripts/train_clip.py): rerank "
+                         "generations, best first (reference "
+                         "generate_images :553-555)")
     ap.add_argument("--outputs_dir", type=str, default="./outputs")
     ap.add_argument("--tokenizer", type=str, default="simple")
     ap.add_argument("--bpe_path", type=str, default=None)
@@ -95,6 +99,14 @@ def main(argv=None):
     cfg = model.cfg
     key = jax.random.PRNGKey(args.seed)
 
+    clip = None
+    if args.clip_path:
+        from dalle_tpu.config import ClipConfig
+        from dalle_tpu.models.clip import init_clip
+        clip_model, clip_params, _ = load_model_checkpoint(
+            args.clip_path, "CLIP", ClipConfig, init_clip)
+        clip = (clip_model, clip_params)
+
     prompts = [t.strip() for t in args.text.split("|") if t.strip()]
     for prompt in prompts:
         text_str = prompt
@@ -112,17 +124,31 @@ def main(argv=None):
                               text_str.replace(" ", "_")[:64])
         os.makedirs(outdir, exist_ok=True)
         made = 0
+        all_imgs, all_scores = [], []
         while made < args.num_images:
             n = min(args.batch_size, args.num_images - made)
             bkey, key = jax.random.split(key)
             batch_text = np.repeat(text, n, axis=0)
-            imgs = dv.generate_images(
+            out = dv.generate_images(
                 batch_text, bkey, filter_thres=args.top_k_thres,
                 temperature=args.temperature, cond_scale=args.cond_scale,
-                precision="bfloat16" if args.bf16 else "float32")
-            save_image_grid(np.asarray(imgs),
-                            os.path.join(outdir, f"img_{made}_{{}}.png"))
+                clip=clip, precision="bfloat16" if args.bf16 else "float32")
+            if clip is not None:
+                imgs, scores = out
+                all_scores.append(np.asarray(scores))
+            else:
+                imgs = out
+            all_imgs.append(np.asarray(imgs))
             made += n
+        imgs = np.concatenate(all_imgs)
+        if clip is not None:
+            # best-first ordering by CLIP similarity (reference :553-555)
+            scores = np.concatenate(all_scores)
+            order = np.argsort(-scores)
+            imgs = imgs[order]
+            print("clip scores (best first): "
+                  + " ".join(f"{scores[i]:.4f}" for i in order))
+        save_image_grid(imgs, os.path.join(outdir, "img_{}.png"))
         print(f"wrote {made} images for {text_str!r} → {outdir}")
     return 0
 

@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Train a CLIP reranker on TPU (or the CPU mesh).
+
+The reference ships the CLIP model + symmetric-CE loss
+(dalle_pytorch/dalle_pytorch.py:256-332) but no training script — CLIP's only
+job there is reranking generations (:553-555). This CLI completes the flow:
+train here, then rerank with ``scripts/generate.py --clip_path``.
+
+Example:
+  python scripts/sampler.py --outdir /tmp/shapes --count 256 --image_size 64
+  python scripts/train_clip.py --image_text_folder /tmp/shapes \
+      --image_size 64 --patch_size 8 --dim 128 --depth 2 --epochs 2
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_parser():
+    ap = argparse.ArgumentParser(description=__doc__)
+    data = ap.add_argument_group("data")
+    data.add_argument("--image_text_folder", type=str, default=None)
+    data.add_argument("--synthetic", action="store_true")
+    data.add_argument("--text_from_filename", action="store_true")
+    data.add_argument("--image_size", type=int, default=256)
+
+    tok = ap.add_argument_group("tokenizer")
+    tok.add_argument("--tokenizer", type=str, default="simple",
+                     choices=["simple", "yttm", "hug", "chinese"])
+    tok.add_argument("--bpe_path", type=str, default=None)
+
+    model = ap.add_argument_group("model")
+    model.add_argument("--dim", type=int, default=512,
+                       help="shared width for text/image encoders + latent")
+    model.add_argument("--depth", type=int, default=6)
+    model.add_argument("--heads", type=int, default=8)
+    model.add_argument("--text_seq_len", type=int, default=256)
+    model.add_argument("--patch_size", type=int, default=32)
+    model.add_argument("--num_text_tokens", type=int, default=None,
+                       help="default: tokenizer vocab size")
+
+    train = ap.add_argument_group("training")
+    train.add_argument("--epochs", type=int, default=20)
+    train.add_argument("--batch_size", type=int, default=32)
+    train.add_argument("--learning_rate", type=float, default=3e-4)
+    train.add_argument("--clip_grad_norm", type=float, default=0.5)
+    train.add_argument("--output_dir", type=str, default="./clip_ckpt")
+    train.add_argument("--save_every_n_steps", type=int, default=1000)
+    train.add_argument("--seed", type=int, default=42)
+    train.add_argument("--steps", type=int, default=None)
+    train.add_argument("--no_preflight", action="store_true")
+
+    from dalle_tpu.parallel import wrap_arg_parser
+    wrap_arg_parser(ap)
+    return ap
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if not (args.image_text_folder or args.synthetic):
+        print("error: provide --image_text_folder or --synthetic",
+              file=sys.stderr)
+        return 2
+
+    import numpy as np
+    from dalle_tpu.config import ClipConfig, OptimConfig, TrainConfig
+    from dalle_tpu.parallel import set_backend_from_args
+    from dalle_tpu.text.tokenizer import get_tokenizer
+    from dalle_tpu.train.trainer_clip import CLIPTrainer
+
+    backend = set_backend_from_args(args).initialize()
+    backend.check_batch_size(args.batch_size)
+    is_root = backend.is_root_worker()
+
+    tok_kw = {"bpe_path": args.bpe_path} if args.bpe_path else {}
+    tokenizer = get_tokenizer(args.tokenizer, **tok_kw)
+    num_text_tokens = args.num_text_tokens or max(tokenizer.vocab_size, 256)
+    if num_text_tokens < tokenizer.vocab_size:
+        print(f"error: --num_text_tokens {num_text_tokens} < tokenizer vocab "
+              f"{tokenizer.vocab_size}", file=sys.stderr)
+        return 2
+
+    model_cfg = ClipConfig(
+        dim_text=args.dim, dim_image=args.dim, dim_latent=args.dim,
+        num_text_tokens=num_text_tokens, text_enc_depth=args.depth,
+        text_seq_len=args.text_seq_len, text_heads=args.heads,
+        visual_enc_depth=args.depth, visual_heads=args.heads,
+        visual_image_size=args.image_size, visual_patch_size=args.patch_size)
+    train_cfg = TrainConfig(
+        batch_size=args.batch_size, epochs=args.epochs, seed=args.seed,
+        checkpoint_dir=args.output_dir,
+        save_every_steps=args.save_every_n_steps,
+        preflight_checkpoint=not args.no_preflight,
+        optim=OptimConfig(learning_rate=args.learning_rate,
+                          grad_clip_norm=args.clip_grad_norm))
+
+    trainer = CLIPTrainer(model_cfg, train_cfg, backend=backend)
+
+    def encode_batch(images, captions):
+        text = tokenizer.tokenize(list(captions), args.text_seq_len,
+                                  truncate_text=True)
+        return text, np.asarray(images, np.float32)
+
+    if args.synthetic:
+        from dalle_tpu.data.synthetic import ShapesDataset, batch_iterator
+        ds = ShapesDataset(image_size=args.image_size)
+        raw = batch_iterator(ds, args.batch_size, seed=args.seed,
+                             epochs=args.epochs)
+    else:
+        from dalle_tpu.data.text_image import TextImageDataset
+        ds = TextImageDataset(args.image_text_folder,
+                              image_size=args.image_size, shuffle=True,
+                              seed=args.seed,
+                              text_from_filename=args.text_from_filename)
+        raw = ds.batches(args.batch_size, epochs=args.epochs)
+    batches = (encode_batch(imgs, caps) for imgs, caps in raw)
+
+    if is_root:
+        print(f"CLIP: {trainer.num_params / 1e6:.1f}M params; "
+              f"mesh {dict(trainer.mesh.shape)}")
+    log = print if is_root else (lambda *a, **k: None)
+    trainer.fit(batches, steps=args.steps, log=log)
+
+    final = int(trainer.state.step)
+    if trainer.ckpt.latest_step() != final:
+        trainer.ckpt.save(final, trainer.state, trainer._meta())
+    if is_root:
+        print(f"done at step {final}; checkpoints in {args.output_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

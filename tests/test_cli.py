@@ -107,3 +107,40 @@ def test_generate_rejects_vocab_mismatch(shapes_dir, tmp_path):
         "--num_images", "1", "--batch_size", "1",
         "--outputs_dir", str(tmp_path / "out")])
     assert rc == 0
+
+
+def test_train_clip_and_rerank_generation(shapes_dir, tmp_path):
+    """CLIP flow end-to-end: train a reranker, then generate with
+    --clip_path — scores ordered best-first (reference generate_images
+    :553-555; the reference ships no CLIP training script, this framework
+    does). CLIP's shorter text context is cropped/padded automatically."""
+    dalle_ckpt = str(tmp_path / "dck")
+    clip_ckpt = str(tmp_path / "cck")
+
+    train = _load("train_dalle")
+    rc = train.main([
+        "--image_text_folder", shapes_dir, "--untrained_vae",
+        "--image_size", "32", "--untrained_vae_layers", "2",
+        "--dim", "32", "--depth", "1", "--heads", "2", "--dim_head", "16",
+        "--text_seq_len", "16", "--epochs", "1", "--batch_size", "4",
+        "--steps", "1", "--output_dir", dalle_ckpt, "--no_preflight"])
+    assert rc == 0
+
+    tclip = _load("train_clip")
+    rc = tclip.main([
+        "--image_text_folder", shapes_dir, "--image_size", "32",
+        "--patch_size", "8", "--dim", "32", "--depth", "1", "--heads", "2",
+        "--text_seq_len", "8",  # shorter than DALLE's: exercises crop
+        "--epochs", "1", "--batch_size", "4", "--steps", "1",
+        "--output_dir", clip_ckpt, "--no_preflight"])
+    assert rc == 0
+
+    gen = _load("generate")
+    outdir = str(tmp_path / "ranked")
+    rc = gen.main([
+        "--dalle_path", dalle_ckpt, "--text", "large red circle",
+        "--num_images", "2", "--batch_size", "2", "--outputs_dir", outdir,
+        "--clip_path", clip_ckpt, "--bf16"])
+    assert rc == 0
+    pngs = [f for _, _, fs in os.walk(outdir) for f in fs if f.endswith(".png")]
+    assert len(pngs) == 2
