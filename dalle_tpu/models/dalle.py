@@ -68,12 +68,17 @@ def _ce_chunk_body(mdl, x_c, lbl_c, start: int):
 
 class DALLE(nn.Module):
     cfg: DalleConfig
+    # sequence-parallel mesh: routes the training forward's attention through
+    # ring attention over the 'sp' axis (static module metadata; generation
+    # paths keep the cached dense core)
+    sp_mesh: Any = None
 
     def setup(self):
         c = self.cfg
         self.num_text_tokens = c.num_text_tokens + c.text_seq_len  # + per-pos pads
         self.total_tokens = self.num_text_tokens + c.image_vocab_size
-        self.transformer = Transformer(c.transformer(), name="transformer")
+        self.transformer = Transformer(c.transformer(), sp_mesh=self.sp_mesh,
+                                       name="transformer")
 
         if c.share_input_output_emb:
             # one (total_tokens, dim) table serves both embeddings and the
@@ -347,8 +352,8 @@ class DALLE(nn.Module):
         return jnp.concatenate([text, toks, final[:, None]], axis=1)
 
 
-def init_dalle(cfg: DalleConfig, key: jax.Array, batch: int = 1):
-    model = DALLE(cfg)
+def init_dalle(cfg: DalleConfig, key: jax.Array, batch: int = 1, sp_mesh=None):
+    model = DALLE(cfg, sp_mesh=sp_mesh)
     text = jnp.zeros((batch, cfg.text_seq_len), jnp.int32)
     img = jnp.zeros((batch, cfg.image_seq_len), jnp.int32)
     params = model.init({"params": key, "cfg": key}, text, img, return_loss=True)

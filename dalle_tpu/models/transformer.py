@@ -106,6 +106,11 @@ class Attention(nn.Module):
     stable: bool = False
     use_pallas: bool = False
     softmax_f32: bool = True
+    # sequence parallelism: a Mesh with an 'sp' axis routes the full-causal
+    # training forward through ring attention (parallel/ring_attention.py) —
+    # activations shard along the sequence, k/v rotate over ICI. Static
+    # module metadata (hashable), not a traced value.
+    sp_mesh: Any = None
 
     def setup(self):
         inner = self.heads * self.dim_head
@@ -129,7 +134,15 @@ class Attention(nn.Module):
         if rotary is not None:
             rot = rotary[:n][None, None]
             q, k, v = (apply_rotary(rot, t) for t in (q, k, v))
-        if self.use_pallas and key_mask is None and not self.is_initializing():
+        if self.sp_mesh is not None and not self.is_initializing():
+            # sequence-parallel ring attention (full-causal path only: sparse
+            # masks and key-padding masks are not sequence-sharded here)
+            assert np_mask is None and key_mask is None and self.causal, (
+                "sequence parallelism supports the full causal path only "
+                "(attn_types=('full',), no key_mask)")
+            from ..parallel.ring_attention import ring_attention
+            out = ring_attention(q, k, v, mesh=self.sp_mesh, causal=True)
+        elif self.use_pallas and key_mask is None and not self.is_initializing():
             # (init uses the dense path: params are identical and eager pallas
             # execution during un-jitted init is needlessly slow)
             from ..ops.flash_attention import flash_attention
@@ -332,6 +345,7 @@ class Transformer(nn.Module):
     ``attn_types`` tuple, layer sharing, rotary table, static sparse masks.
     (reference Transformer ctor :204-328)"""
     cfg: TransformerConfig
+    sp_mesh: Any = None    # sequence-parallel mesh (see Attention.sp_mesh)
 
     def setup(self):
         c = self.cfg
@@ -376,6 +390,7 @@ class Transformer(nn.Module):
                                  causal=c.causal, stable=c.stable,
                                  use_pallas=c.use_pallas,
                                  softmax_f32=c.attn_softmax_f32,
+                                 sp_mesh=self.sp_mesh,
                                  name=f"attn_{aid}")
                 shared_attn[aid] = (attn, t)
             if fid in shared_ff:

@@ -30,8 +30,12 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def _ring_body(q, k, v, *, axis: str, nper: int, causal: bool, scale: float):
-    """Per-device program: q stays, k/v rotate. q/k/v: (b, h, n_local, d)."""
+def _ring_body(q, k, v, *, axis: str, nper: int, causal: bool, scale: float,
+               n_valid: int):
+    """Per-device program: q stays, k/v rotate. q/k/v: (b, h, n_local, d).
+    ``n_valid``: true sequence length — keys at padded positions ≥ n_valid are
+    masked (under causal masking valid queries already exclude them, but the
+    non-causal path needs the explicit test)."""
     P_size = jax.lax.psum(1, axis)
     idx = jax.lax.axis_index(axis)
     n_local = q.shape[2]
@@ -47,10 +51,11 @@ def _ring_body(q, k, v, *, axis: str, nper: int, causal: bool, scale: float):
     for t in range(nper):
         src = (idx - t) % P_size            # ring origin of the current chunk
         s = jnp.einsum("bhid,bhjd->bhij", qf, k_cur)
+        kpos = src * n_local + jnp.arange(n_local)
+        vis = kpos[None, :] < n_valid
         if causal:
-            kpos = src * n_local + jnp.arange(n_local)
-            vis = kpos[None, :] <= qpos[:, None]                   # (i, j)
-            s = jnp.where(vis[None, None], s, -1e9)
+            vis &= kpos[None, :] <= qpos[:, None]                  # (i, j)
+        s = jnp.where(vis[None, None], s, -1e9)   # (1,1,i|1,j) broadcasts
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.where(s > -0.5e9, jnp.exp(s - m_new), 0.0)
         corr = jnp.exp(m - m_new)
@@ -65,10 +70,11 @@ def _ring_body(q, k, v, *, axis: str, nper: int, causal: bool, scale: float):
 
 
 @functools.lru_cache(maxsize=16)
-def _make_ring_fn(mesh: Mesh, axis: str, causal: bool, nper: int, scale: float):
+def _make_ring_fn(mesh: Mesh, axis: str, causal: bool, nper: int, scale: float,
+                  n_valid: int):
     spec = P(None, None, axis, None)
     body = functools.partial(_ring_body, axis=axis, nper=nper, causal=causal,
-                             scale=scale)
+                             scale=scale, n_valid=n_valid)
     return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec)
 
@@ -77,14 +83,20 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                    mesh: Mesh, axis: str = "sp", causal: bool = True,
                    scale: Optional[float] = None) -> jnp.ndarray:
     """Sequence-parallel attention over (b, h, n, d) arrays whose sequence dim
-    is (or will be) sharded along ``mesh[axis]``. n must divide evenly."""
+    is (or will be) sharded along ``mesh[axis]``. Sequences that don't divide
+    the axis are zero-padded; padded keys are masked, padded query rows are
+    sliced off."""
     nper = mesh.shape[axis]
     n = q.shape[2]
-    assert n % nper == 0, f"seq {n} must divide the {axis} axis ({nper})"
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    fn = _make_ring_fn(mesh, axis, causal, nper, float(scale))
-    return fn(q, k, v)
+    n_pad = -(-n // nper) * nper
+    if n_pad != n:
+        pad = ((0, 0), (0, 0), (0, n_pad - n), (0, 0))
+        q, k, v = (jnp.pad(t, pad) for t in (q, k, v))
+    fn = _make_ring_fn(mesh, axis, causal, nper, float(scale), n)
+    out = fn(q, k, v)
+    return out[:, :, :n] if n_pad != n else out
 
 
 def shard_seq(mesh: Mesh, x, axis: str = "sp"):

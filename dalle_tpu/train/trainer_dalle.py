@@ -76,7 +76,12 @@ class DalleTrainer(BaseTrainer):
         super().__init__(train_cfg, mesh=mesh, backend=backend)
         self.model_cfg = model_cfg
 
-        self.model, params = init_dalle(model_cfg, self.base_key)
+        sp = dict(self.mesh.shape).get("sp", 1)
+        if sp > 1:
+            assert tuple(model_cfg.attn_types or ("full",)) == ("full",), (
+                "sequence parallelism (sp > 1) supports attn_types=('full',)")
+        self.model, params = init_dalle(
+            model_cfg, self.base_key, sp_mesh=self.mesh if sp > 1 else None)
         params = shard_params(self.mesh, params)
         tx = make_optimizer(train_cfg.optim)
         self.state = TrainState.create(apply_fn=self.model.apply, params=params,
