@@ -46,6 +46,7 @@ def _block_body(mdl, x, key_mask, ind: int, deterministic: bool):
     t = mdl.layer_types[ind]
     x = x + mdl.attn_layers[ind](x, key_mask=key_mask, rotary=mdl.rotary,
                                  np_mask=mdl.np_masks[t],
+                                 mask_spec=mdl.mask_specs[t],
                                  deterministic=deterministic)
     return x + mdl.ff_layers[ind](x, deterministic=deterministic)
 
@@ -124,7 +125,7 @@ class Attention(nn.Module):
         return [t.reshape(shape).transpose(0, 2, 1, 3) for t in (q, k, v)]
 
     def __call__(self, x, *, key_mask=None, rotary=None, np_mask=None,
-                 deterministic: bool = True):
+                 mask_spec=None, deterministic: bool = True):
         """``np_mask`` is the ONE mask parameter (host-side numpy, compile-time
         constant): the pallas path lowers it to block lists, the dense path
         converts it to a jnp constant — a single source of truth so the two
@@ -146,7 +147,8 @@ class Attention(nn.Module):
             # (init uses the dense path: params are identical and eager pallas
             # execution during un-jitted init is needlessly slow)
             from ..ops.flash_attention import flash_attention
-            out = flash_attention(q, k, v, mask=np_mask, causal=self.causal)
+            out = flash_attention(q, k, v, mask=np_mask, mask_spec=mask_spec,
+                                  causal=self.causal)
         else:
             static = None if np_mask is None else jnp.asarray(np_mask)
             out = attend(q, k, v, causal=self.causal, key_mask=key_mask,
@@ -371,6 +373,22 @@ class Transformer(nn.Module):
                     block=c.sparse_block_size,
                     num_random_blocks=c.sparse_num_random_blocks)
         self.np_masks = masks
+        # structured-mask specs: the pallas kernels compute axial/conv
+        # element visibility from iotas instead of loading a mask table
+        # (ops/flash_attention.py elem_fn_from_spec)
+        specs: Dict[str, Optional[tuple]] = {}
+        for t in set(type_per_layer):
+            if not c.causal or masks.get(t) is None:
+                specs[t] = None
+            elif t in ("axial_row", "axial_col"):
+                specs[t] = ("axial", self.text_len, fmap,
+                            0 if t == "axial_row" else 1)
+            elif t == "conv_like":
+                specs[t] = ("conv", self.text_len, fmap,
+                            c.sparse_attn_kernel, 1)
+            else:
+                specs[t] = None
+        self.mask_specs = specs
 
         shared_attn: Dict[Any, Tuple[Attention, str]] = {}
         shared_ff: Dict[Any, GEGLUFeedForward] = {}
@@ -495,6 +513,7 @@ class Transformer(nn.Module):
         t = self.layer_types[ind]
         return self.attn_layers[ind](h, key_mask=key_mask, rotary=self.rotary,
                                      np_mask=self.np_masks[t],
+                                     mask_spec=self.mask_specs[t],
                                      deterministic=deterministic)
 
     def _apply_ff_layer(self, h, ind: int, deterministic: bool = True):

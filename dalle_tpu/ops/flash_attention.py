@@ -86,12 +86,52 @@ def build_block_lists(n_pad: int, block_q: int, block_k: int,
     return BlockLists(k_ids, k_cnt, q_ids, q_cnt)
 
 
+def elem_fn_from_spec(spec):
+    """Build the in-kernel element visibility test for a *structured* mask
+    spec — ("axial", text_len, fmap, axis) or ("conv", text_len, fmap,
+    kernel, dilation). Structured masks are pure functions of (qpos, kpos),
+    so the kernels compute them from iotas instead of loading a
+    (block, n_pad) int32 mask row per grid step — that row was as much VMEM
+    traffic as the scores themselves (see ops/attn_masks.py for the table
+    semantics these reproduce)."""
+    if spec is None:
+        return None
+    kind = spec[0]
+    if kind == "axial":
+        _, text_len, fmap, axis = spec
+
+        def fn(qpos, kpos):
+            qi, ki = qpos - text_len, kpos - text_len
+            if axis == 0:
+                same = (qi // fmap) == (ki // fmap)
+            else:
+                same = (qi % fmap) == (ki % fmap)
+            img_pair = (qpos >= text_len) & (kpos >= text_len)
+            return (kpos < text_len) | (img_pair & same)
+        return fn
+    if kind == "conv":
+        _, text_len, fmap, kernel, dil = spec
+        span = (kernel - 1) * dil
+
+        def fn(qpos, kpos):
+            qi, ki = qpos - text_len, kpos - text_len
+            dr = qi // fmap - ki // fmap
+            dc = qi % fmap - ki % fmap
+            win = (dr >= 0) & (dr <= span) & (dc >= 0) & (dc <= span)
+            if dil > 1:
+                win &= (dr % dil == 0) & (dc % dil == 0)
+            img_pair = (qpos >= text_len) & (kpos >= text_len)
+            return (kpos < text_len) | (img_pair & win)
+        return fn
+    raise ValueError(f"unknown mask spec {spec!r}")
+
+
 # ---------------------------------------------------------------------------
 # kernels (grid = (b, h, n_blocks); block lists in SMEM via scalar prefetch)
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(ids_ref, cnt_ref, q_ref, k_ref, v_ref, *rest,
-                scale, block_k, n_valid, causal, has_mask):
+                scale, block_k, n_valid, causal, has_mask, elem_fn=None):
     if has_mask:
         mask_ref, o_ref, lse_ref = rest
     else:
@@ -115,6 +155,8 @@ def _fwd_kernel(ids_ref, cnt_ref, q_ref, k_ref, v_ref, *rest,
             valid &= kpos <= qpos
         if has_mask:
             valid &= mask_ref[:, pl.ds(jb * block_k, block_k)] > 0
+        elif elem_fn is not None:
+            valid &= elem_fn(qpos, kpos)
         s = jnp.where(valid, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         # for a fully-masked row m_new == NEG_INF and exp(s - m_new) would be
@@ -141,7 +183,7 @@ def _fwd_kernel(ids_ref, cnt_ref, q_ref, k_ref, v_ref, *rest,
 
 def _bwd_dq_kernel(ids_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                    delta_ref, *rest, scale, block_k, n_valid, causal,
-                   has_mask):
+                   has_mask, elem_fn=None):
     if has_mask:
         mask_ref, dq_ref = rest
     else:
@@ -167,6 +209,8 @@ def _bwd_dq_kernel(ids_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             valid &= kpos <= qpos
         if has_mask:
             valid &= mask_ref[:, pl.ds(jb * block_k, block_k)] > 0
+        elif elem_fn is not None:
+            valid &= elem_fn(qpos, kpos)
         s = jnp.where(valid, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -181,7 +225,7 @@ def _bwd_dq_kernel(ids_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 def _bwd_dkv_kernel(ids_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                     delta_ref, *rest, scale, block_q, n_valid, causal,
-                    has_mask):
+                    has_mask, elem_fn=None):
     if has_mask:
         mask_ref, dk_ref, dv_ref = rest
     else:
@@ -208,6 +252,8 @@ def _bwd_dkv_kernel(ids_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             valid &= kpos <= qpos
         if has_mask:
             valid &= mask_ref[pl.ds(ib * block_q, block_q), :] > 0
+        elif elem_fn is not None:
+            valid &= elem_fn(qpos, kpos)
         s = jnp.where(valid, s, NEG_INF)
         p = jnp.exp(s - lse)                                       # (blkq, bk)
         dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
@@ -240,9 +286,12 @@ def _full_spec(n_pad, d):
 
 @functools.lru_cache(maxsize=64)
 def _make_flash_fn(n: int, n_pad: int, block_q: int, block_k: int,
-                   causal: bool, mask_key, interpret: bool):
+                   causal: bool, mask_key, interpret: bool,
+                   mask_spec=None):
     """Build the custom_vjp flash function for one (seq, mask) geometry.
-    ``mask_key`` is (bytes, shape) of the numpy mask, or None."""
+    ``mask_key`` is (bytes, shape) of the numpy mask, or None. A structured
+    ``mask_spec`` replaces the element-mask operand with an in-kernel test
+    (block lists still come from the numpy mask)."""
     if mask_key is None:
         mask_np = None
     else:
@@ -253,7 +302,8 @@ def _make_flash_fn(n: int, n_pad: int, block_q: int, block_k: int,
     # the kernels take no mask operand at all — the (block_q, n_pad) int32
     # mask row was as much VMEM traffic per grid step as the scores
     # themselves, and the dkv kernel's scoped VMEM overflowed at long seq
-    has_mask = mask_np is not None
+    elem_fn = elem_fn_from_spec(mask_spec)
+    has_mask = mask_np is not None and elem_fn is None
     # int32 mask: Mosaic v5e has no i8 or packed-bf16 vector compare, so 4
     # bytes/entry is the narrowest workable element mask; long-seq masked
     # configs therefore top out at block 128/256 (VMEM), which the tuner picks
@@ -295,7 +345,8 @@ def _make_flash_fn(n: int, n_pad: int, block_q: int, block_k: int,
         )
         return pl.pallas_call(
             functools.partial(_fwd_kernel, scale=scale, block_k=block_k,
-                              n_valid=n, causal=causal, has_mask=has_mask),
+                              n_valid=n, causal=causal, has_mask=has_mask,
+                              elem_fn=elem_fn),
             grid_spec=grid_spec,
             out_shape=[
                 jax.ShapeDtypeStruct((b, h, n_pad, d), q.dtype),
@@ -344,7 +395,8 @@ def _make_flash_fn(n: int, n_pad: int, block_q: int, block_k: int,
         )
         dq = pl.pallas_call(
             functools.partial(_bwd_dq_kernel, scale=scale, block_k=block_k,
-                              n_valid=n, causal=causal, has_mask=has_mask),
+                              n_valid=n, causal=causal, has_mask=has_mask,
+                              elem_fn=elem_fn),
             grid_spec=dq_grid,
             out_shape=jax.ShapeDtypeStruct((b, h, n_pad, d), qp.dtype),
             interpret=interpret,
@@ -375,7 +427,8 @@ def _make_flash_fn(n: int, n_pad: int, block_q: int, block_k: int,
         )
         dk, dv = pl.pallas_call(
             functools.partial(_bwd_dkv_kernel, scale=scale, block_q=block_q,
-                              n_valid=n, causal=causal, has_mask=has_mask),
+                              n_valid=n, causal=causal, has_mask=has_mask,
+                              elem_fn=elem_fn),
             grid_spec=dkv_grid,
             out_shape=[
                 jax.ShapeDtypeStruct((b, h, n_pad, d), qp.dtype),
@@ -413,6 +466,7 @@ def _auto_block(n: int, has_mask: bool) -> int:
 
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                     mask: Optional[np.ndarray] = None,
+                    mask_spec=None,
                     causal: bool = True,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
@@ -431,10 +485,13 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     n = q.shape[2]
+    # a structured spec carries no element-mask operand: auto blocks use the
+    # roomier mask-free VMEM budget
+    tabled = mask is not None and mask_spec is None
     if block_q is None:
-        block_q = _auto_block(n, mask is not None)
+        block_q = _auto_block(n, tabled)
     if block_k is None:
-        block_k = _auto_block(n, mask is not None)
+        block_k = _auto_block(n, tabled)
     if scale is None:
         scale = q.shape[-1] ** -0.5
     n_pad = _ceil_to(n, max(block_q, block_k))
@@ -443,5 +500,6 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
         mask_key = (mask.astype(bool).tobytes(), mask.shape)
     else:
         mask_key = None
-    fn = _make_flash_fn(n, n_pad, block_q, block_k, causal, mask_key, interpret)
+    fn = _make_flash_fn(n, n_pad, block_q, block_k, causal, mask_key,
+                        interpret, mask_spec)
     return fn(q, k, v, float(scale))

@@ -51,13 +51,16 @@ def timeit(grad_fn, q, k, v, iters=100, warmup=2):
 
 
 def masks_for(kind, n, text_len, fmap):
+    """(numpy mask, structured spec) per kind."""
     if kind == "full":
-        return None
+        return None, None
     from dalle_tpu.ops.attn_masks import axial_mask, conv_like_mask
     if kind == "axial_row":
-        return np.asarray(axial_mask(text_len, fmap, axis=0))
+        return (np.asarray(axial_mask(text_len, fmap, axis=0)),
+                ("axial", text_len, fmap, 0))
     if kind == "conv_like":
-        return np.asarray(conv_like_mask(text_len, fmap, kernel_size=5))
+        return (np.asarray(conv_like_mask(text_len, fmap, kernel_size=5)),
+                ("conv", text_len, fmap, 5, 1))
     raise ValueError(kind)
 
 
@@ -87,7 +90,7 @@ def main():
                    for i in range(3))
 
         for kind in ("full", "axial_row", "conv_like"):
-            mask = masks_for(kind, n_eff, 256, fmap)
+            mask, spec = masks_for(kind, n_eff, 256, fmap)
             if mask is not None and mask.shape[0] < n_eff:
                 continue
 
@@ -114,6 +117,7 @@ def main():
                     o = flash_attention(q, k, v, causal=True,
                                         mask=None if mask is None else
                                         mask[:n_eff, :n_eff],
+                                        mask_spec=spec,
                                         block_q=_blk, block_k=_blk)
                     return jnp.sum(o.astype(jnp.float32))
 

@@ -8,7 +8,8 @@ import numpy as np
 import pytest
 
 from dalle_tpu.ops.attention import attend
-from dalle_tpu.ops.attn_masks import build_mask
+from dalle_tpu.ops.attn_masks import (axial_mask, build_mask,
+                                      conv_like_mask)
 from dalle_tpu.ops.flash_attention import (build_block_lists, flash_attention,
                                            sparsity_fraction)
 
@@ -212,3 +213,35 @@ def test_mosaic_compiles_on_tpu():
             np.testing.assert_allclose(np.asarray(a, np.float32),
                                        np.asarray(b, np.float32),
                                        rtol=0.1, atol=0.05)
+
+
+@pytest.mark.parametrize("spec,builder", [
+    (("axial", 10, 4, 0), lambda: axial_mask(10, 4, axis=0)),
+    (("axial", 10, 4, 1), lambda: axial_mask(10, 4, axis=1)),
+    (("conv", 10, 4, 3, 1), lambda: conv_like_mask(10, 4, kernel_size=3)),
+])
+def test_structured_mask_spec_matches_table(spec, builder):
+    """mask_spec computes element visibility in-kernel from iotas; outputs and
+    grads must equal the mask-table path exactly (same block lists, same
+    math — just no mask operand)."""
+    mask = np.asarray(builder())
+    n = mask.shape[0]
+    q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (2, 2, n, 16))
+               for i in range(3))
+
+    def loss_table(q, k, v):
+        o = flash_attention(q, k, v, mask=mask, causal=True,
+                            block_q=16, block_k=16)
+        return jnp.sum(jnp.sin(o))
+
+    def loss_spec(q, k, v):
+        o = flash_attention(q, k, v, mask=mask, mask_spec=spec, causal=True,
+                            block_q=16, block_k=16)
+        return jnp.sum(jnp.sin(o))
+
+    lt, gt = jax.value_and_grad(loss_table, (0, 1, 2))(q, k, v)
+    ls, gs = jax.value_and_grad(loss_spec, (0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(float(ls), float(lt), rtol=1e-6)
+    for a, b in zip(gt, gs):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-5, atol=1e-6)
