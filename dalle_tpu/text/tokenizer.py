@@ -146,7 +146,8 @@ class HugTokenizer:
 
 class ChineseTokenizer:
     """bert-base-chinese via HF transformers (reference tokenizer.py:196-228).
-    Requires the pretrained vocab locally (no network egress here)."""
+    ``model_name`` may also be a local WordPiece ``vocab.txt`` path (one token
+    per line) — the offline path in this zero-egress environment."""
 
     def __init__(self, model_name: str = "bert-base-chinese"):
         try:
@@ -154,7 +155,10 @@ class ChineseTokenizer:
         except ImportError as e:  # pragma: no cover
             raise ImportError(
                 "ChineseTokenizer needs the `transformers` package") from e
-        self.tokenizer = BertTokenizer.from_pretrained(model_name)
+        if Path(model_name).is_file():
+            self.tokenizer = BertTokenizer(vocab_file=str(model_name))
+        else:
+            self.tokenizer = BertTokenizer.from_pretrained(model_name)
         self.vocab_size = self.tokenizer.vocab_size
 
     def encode(self, text: str) -> List[int]:

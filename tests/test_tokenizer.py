@@ -104,3 +104,23 @@ class TestTrainFlow:
     def test_get_tokenizer_registry_default(self):
         t = get_tokenizer("simple")
         assert t.vocab_size == 49408
+
+
+class TestChineseTokenizer:
+    def test_local_vocab_file(self, tmp_path):
+        """ChineseTokenizer from a local WordPiece vocab (the offline path —
+        no hub access in this environment)."""
+        pytest.importorskip("transformers")
+        vocab = tmp_path / "vocab.txt"
+        vocab.write_text("\n".join(
+            ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+             "你", "好", "世", "界", "猫", "红", "色"]) + "\n")
+        from dalle_tpu.text.tokenizer import ChineseTokenizer
+        tok = ChineseTokenizer(str(vocab))
+        assert tok.vocab_size == 12
+        ids = tok.encode("你好世界")
+        assert ids == [5, 6, 7, 8]
+        out = tok.tokenize(["红色猫"], context_length=8)
+        assert out.shape == (1, 8) and out.dtype == np.int32
+        assert out[0, :3].tolist() == [10, 11, 9]
+        assert "你 好" in tok.decode(ids) or "你好" in tok.decode(ids)
