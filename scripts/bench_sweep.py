@@ -99,8 +99,54 @@ def main():
             run("longseq_pallas_b2", dict(LS, use_pallas=True), 2, steps=4)
         elif w == "gen":
             bench_generation()
+        elif w == "vae":
+            bench_dvae()
         else:
             print(f"unknown config {w}", file=sys.stderr)
+
+
+def bench_dvae(batch=64, steps=8):
+    """dVAE training throughput, BASELINE config-1-shaped: 8192-codebook,
+    128x128 images. Reports imgs/sec/chip."""
+    import jax.numpy as jnp
+    from dalle_tpu.config import (AnnealConfig, DVAEConfig, MeshConfig,
+                                  OptimConfig, TrainConfig)
+    from dalle_tpu.parallel.mesh import build_mesh
+    from dalle_tpu.train.trainer_vae import VAETrainer
+
+    cfg = DVAEConfig(image_size=128, num_tokens=8192, codebook_dim=512,
+                     num_layers=3, num_resnet_blocks=1, hidden_dim=64)
+    n_dev = jax.device_count()
+    tc = TrainConfig(batch_size=batch, checkpoint_dir="/tmp/bench_vae_ckpt",
+                     preflight_checkpoint=False, mesh=MeshConfig(dp=n_dev),
+                     metrics_every=1000, optim=OptimConfig(learning_rate=1e-3))
+    trainer = VAETrainer(cfg, tc, AnnealConfig(),
+                         mesh=build_mesh(MeshConfig(dp=n_dev)))
+    from dalle_tpu.parallel import shard_batch
+    rng = np.random.RandomState(0)
+    # pre-place the batch: pushing 12MB of pixels through the device tunnel
+    # per step would swamp the compute being measured (a real input pipeline
+    # overlaps the transfer)
+    imgs = shard_batch(trainer.mesh,
+                       rng.rand(batch, 128, 128, 3).astype(np.float32))
+    key = jax.random.PRNGKey(0)
+
+    def sync():
+        jax.device_get(jax.tree.leaves(trainer.state.params)[0]).ravel()[0]
+
+    for _ in range(3):
+        trainer.state, _ = trainer.step_fn(trainer.state, imgs, key,
+                                           jnp.float32(1.0))
+    sync()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        trainer.state, _ = trainer.step_fn(trainer.state, imgs, key,
+                                           jnp.float32(1.0))
+    sync()
+    dt = (time.perf_counter() - t0) / steps
+    print(json.dumps({"name": f"dvae_train_b{batch}", "step_s": round(dt, 4),
+                      "imgs_per_sec_per_chip": round(batch / dt / n_dev, 1)}),
+          flush=True)
 
 
 def bench_generation(batch=64, reps=3):
