@@ -1,25 +1,26 @@
-"""Benchmark: DALL·E-medium training throughput on the attached chip(s).
+"""Benchmark: DALL·E-1.4B training throughput on the attached chip(s).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 The reference publishes no formal numbers (BASELINE.md): its only hooks are a
-samples/sec meter and a flops profile. The driver-set target is ≥45% MFU
-(BASELINE.json north_star, config 4), so ``vs_baseline`` reports measured
-MFU / 0.45 — >1.0 beats the target.
+samples/sec meter and a flops profile. The driver-set target is ≥45% MFU at
+the 1.3B scale (BASELINE.json north_star, config 4), so ``vs_baseline``
+reports measured MFU / 0.45 — >1.0 beats the target.
 
-Config recorded: DALL·E-medium (24L/16H/1024d — BASELINE.md config 3) with the
-production CLIP text vocab (49,408), 256 text + 256 image tokens, full causal
-attention, bf16 compute with f32 masters, per-block rematerialization, Adam +
-global-norm clipping — the full production train step, jitted once with state
-donation. MFU uses the PaLM convention: (6·N + 12·L·h·d_head·n) FLOPs/token,
-i.e. parameter FLOPs plus the n² attention term (attention is real work the
-chip does; a params-only denominator undercounts it).
+Config recorded: DALL·E-1.4B (24L/14H/1792d — BASELINE.md config 4's model
+scale) with the production CLIP text vocab (49,408), 256 text + 256 image
+tokens, full causal attention, bf16 compute with f32 masters, per-block
+rematerialization, chunked vocab-head CE (loss_chunk — the 58k-vocab logits
+never materialize), Adafactor + global-norm clipping — the full production
+train step, jitted once with state donation. Adafactor's factored second
+moments are what fit 1.4B params on one chip; multi-chip gets the same
+memory relief from fsdp-sharded Adam instead (dryrun_multichip covers that
+path). MFU uses the PaLM convention: (6·N + 12·L·h·d_head·n) FLOPs/token.
 
-Round-1 note: the previous flagship (DALL·E-small, 12L/8H/512d, batch 64)
-reaches 170k tokens/s/chip but only ~0.39 MFU on a v5e — at dim 512 the
-attention score traffic is HBM-bound (NEXT.md r1 profile: attention ≈53% of
-step). The medium config's 1024-wide GEMMs keep the MXU busy instead;
-scripts/bench_sweep.py holds both configs for comparison.
+Cross-config reference (scripts/bench_sweep.py): DALL·E-small (12L/512d,
+b64) 170k tokens/s/chip at ~0.39 MFU (attention-score HBM-bound at dim 512);
+DALL·E-medium (24L/1024d, Adam, b12) 33.3k at 0.554; this 1.4B config 13.3k
+at 0.60 — bigger GEMMs keep the MXU busier.
 """
 
 from __future__ import annotations
@@ -38,14 +39,14 @@ def main():
     from dalle_tpu.train.trainer_dalle import DalleTrainer
 
     on_accel = jax.devices()[0].platform != "cpu"
-    # DALL·E-medium (BASELINE.md config 3): 24L/16H/1024d, CLIP vocab, full
-    # causal attention, 256 text + 256 image tokens. bf16 attention scores —
-    # the HBM-dominant tensor (ops/attention.py softmax_f32).
+    # DALL·E-1.4B (BASELINE.md config 4 scale): 24L/14H/1792d, CLIP vocab,
+    # full causal attention, 256 text + 256 image tokens. bf16 attention
+    # scores (the HBM-dominant tensor), chunked CE, Adafactor.
     cfg = DalleConfig(
-        num_text_tokens=49408, text_seq_len=256, dim=1024, depth=24, heads=16,
-        dim_head=64, image_size=128, image_vocab_size=8192, image_fmap_size=16,
-        attn_softmax_f32=False)
-    batch = 12 if on_accel else 4
+        num_text_tokens=49408, text_seq_len=256, dim=1792, depth=24, heads=14,
+        dim_head=128, image_size=128, image_vocab_size=8192,
+        image_fmap_size=16, attn_softmax_f32=False, loss_chunk=128)
+    batch = 8 if on_accel else 2
     steps = 10 if on_accel else 2
 
     n_dev = jax.device_count()
@@ -54,7 +55,8 @@ def main():
     train_cfg = TrainConfig(batch_size=batch, checkpoint_dir="/tmp/bench_ckpt",
                             preflight_checkpoint=False, mesh=mesh_cfg,
                             metrics_every=1000,   # pipeline steps: no per-step sync
-                            optim=OptimConfig(grad_clip_norm=0.5))
+                            optim=OptimConfig(optimizer="adafactor",
+                                              grad_clip_norm=0.5))
     trainer = DalleTrainer(cfg, train_cfg, mesh=mesh)
 
     rng = np.random.RandomState(0)
@@ -86,7 +88,7 @@ def main():
         device_peak_tflops() * 1e12 * n_dev)
 
     print(json.dumps({
-        "metric": "dalle_medium_train_tokens_per_sec_per_chip",
+        "metric": "dalle_1p4b_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec_per_chip, 2),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.45, 4),

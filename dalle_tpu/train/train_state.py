@@ -75,6 +75,13 @@ def make_optimizer(cfg: OptimConfig) -> optax.GradientTransformation:
     elif cfg.optimizer == "adamw":
         core = optax.adamw(sched, b1=cfg.beta1, b2=cfg.beta2, eps=cfg.eps,
                            weight_decay=cfg.weight_decay)
+    elif cfg.optimizer == "adafactor":
+        # factored second moments, no first moment: O(rows+cols) optimizer
+        # state instead of Adam's 2x params — the single-chip path to
+        # billion-param configs (multi-chip gets the same effect from fsdp
+        # sharding of Adam state)
+        core = optax.adafactor(sched, momentum=None,
+                               weight_decay_rate=cfg.weight_decay or None)
     elif cfg.optimizer == "sgd":
         core = optax.sgd(sched)
     else:

@@ -210,3 +210,22 @@ def test_metrics_logger_images_and_artifacts_degrade_without_wandb(tmp_path):
     lg.close()
     lines = path.read_text().strip().splitlines()
     assert len(lines) == 1 and '"loss"' in lines[0]
+
+
+def test_adafactor_optimizer_trains():
+    """adafactor (factored second moments — the single-chip big-model
+    optimizer) plugs into the standard state/trainer path."""
+    import jax
+    import jax.numpy as jnp
+    from dalle_tpu.config import OptimConfig
+    from dalle_tpu.train.train_state import TrainState, make_optimizer
+
+    tx = make_optimizer(OptimConfig(optimizer="adafactor", learning_rate=1e-2,
+                                    grad_clip_norm=1.0))
+    state = TrainState.create(apply_fn=None,
+                              params={"w": jnp.ones((8, 4))}, tx=tx)
+    for i in range(3):
+        g = {"w": jnp.full((8, 4), 0.5)}
+        state = state.apply_gradients(g, value=jnp.float32(1.0))
+    assert bool(jnp.all(jnp.isfinite(state.params["w"])))
+    assert float(jnp.abs(state.params["w"] - 1.0).sum()) > 0
