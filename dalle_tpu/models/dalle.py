@@ -204,7 +204,12 @@ class DALLE(nn.Module):
         labels = jnp.concatenate(
             [text_b[:, 1:], image_ids + self.num_text_tokens], axis=1)
         n = tokens.shape[1]
-        if c.loss_chunk > 0 and n % c.loss_chunk == 0 and not self.is_initializing():
+        if c.loss_chunk > 0 and n % c.loss_chunk != 0:
+            raise ValueError(
+                f"loss_chunk={c.loss_chunk} must divide the sequence length "
+                f"{n} — a silent fall-back would rematerialize the full "
+                f"(b, n, vocab) logits the option exists to avoid")
+        if c.loss_chunk > 0 and not self.is_initializing():
             # chunked head+CE under remat: full (b, n, vocab) logits never hit
             # HBM — each chunk's logits are recomputed in backward
             parts = []

@@ -223,9 +223,13 @@ class VQModel(nn.Module):
         ids = out.indices
         if self.cfg.remap_used is not None:
             # restricted-vocab checkpoints (taming quantize.py remap): expose
-            # indices in the used subset's id space
+            # indices in the used subset's id space. taming draws a fresh
+            # randint per call for unknown codes; pass a 'remap' rng to get
+            # that — without one the fill is a fixed-key (deterministic)
+            # pseudo-random assignment, the sane choice for eval tokenization
+            key = (self.make_rng("remap") if self.has_rng("remap") else None)
             ids = remap_indices(ids, self.cfg.remap_used,
-                                unknown=self.cfg.remap_unknown)
+                                unknown=self.cfg.remap_unknown, key=key)
         return ids.reshape(b, -1)
 
     def decode_code(self, ids):

@@ -113,8 +113,13 @@ class DalleWithVae:
             prime = self.vae.get_codebook_indices(img)[:, :n_prime]
         params, cache_dtype = self.params, jnp.float32
         if precision in ("bfloat16", "bf16"):
-            from ..train.train_state import cast_floating
-            params = cast_floating(self.params, jnp.bfloat16)
+            # cast once and cache — re-casting the full tree per call would
+            # serialize GBs of casts ahead of every batch's decode loop
+            if getattr(self, "_bf16_params", None) is None:
+                from ..train.train_state import cast_floating
+                object.__setattr__(self, "_bf16_params",
+                                   cast_floating(self.params, jnp.bfloat16))
+            params = self._bf16_params
             cache_dtype = jnp.bfloat16
         ids = self.model.apply(
             params, text, key, filter_thres=filter_thres,

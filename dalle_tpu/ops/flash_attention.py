@@ -306,14 +306,16 @@ def _make_flash_fn(n: int, n_pad: int, block_q: int, block_k: int,
     has_mask = mask_np is not None and elem_fn is None
     # int32 mask: Mosaic v5e has no i8 or packed-bf16 vector compare, so 4
     # bytes/entry is the narrowest workable element mask; long-seq masked
-    # configs therefore top out at block 128/256 (VMEM), which the tuner picks
-    mask_pad = np.zeros((n_pad, n_pad), dtype=np.int32)
-    if has_mask:
-        s = min(mask_np.shape[0], n_pad)
-        mask_pad[:s, :s] = mask_np[:s, :s]
-    # keep closure constants as NUMPY: jnp conversion inside a jit trace would
+    # configs therefore top out at block 128/256 (VMEM), which the tuner picks.
+    # Only allocated when a kernel actually takes the operand — an (n_pad,
+    # n_pad) int32 table pinned in this lru-cached closure is ~85MB at seq 4k.
+    # Keep closure constants as NUMPY: jnp conversion inside a jit trace would
     # capture per-trace tracers in the lru-cached closure (leaked-tracer error)
-    mask_c = mask_pad
+    mask_c = None
+    if has_mask:
+        mask_c = np.zeros((n_pad, n_pad), dtype=np.int32)
+        s = min(mask_np.shape[0], n_pad)
+        mask_c[:s, :s] = mask_np[:s, :s]
     k_ids, k_cnt = lists.k_ids, lists.k_cnt
     q_ids, q_cnt = lists.q_ids, lists.q_cnt
     nq, nk = n_pad // block_q, n_pad // block_k

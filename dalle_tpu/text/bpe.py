@@ -171,9 +171,11 @@ def load_merges(path: str | Path, limit: Optional[int] = None) -> List[Tuple[str
         text = path.read_text(encoding="utf-8")
     lines = text.split("\n")
     # The version header may itself split into two tokens (CLIP's reads
-    # '"bpe_simple_vocab_16e6.txt#version: 0.2'), so detect it by content,
-    # not shape — the reference drops line 0 unconditionally (tokenizer.py:60).
-    if lines and ("#" in lines[0] or len(lines[0].split()) != 2):
+    # '"bpe_simple_vocab_16e6.txt#version: 0.2'), so detect it by the
+    # '#version' marker or a non-pair shape — a bare '#' test would eat a
+    # legitimate first merge containing the byte char '#'. (The reference
+    # drops line 0 unconditionally, tokenizer.py:60.)
+    if lines and ("#version" in lines[0] or len(lines[0].split()) != 2):
         lines = lines[1:]
     merges = []
     for ln in lines:

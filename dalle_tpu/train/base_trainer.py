@@ -125,8 +125,13 @@ class BaseTrainer:
                     if (getattr(tc, "log_artifacts", False)
                             and metrics_writer is not None
                             and hasattr(metrics_writer, "log_artifact")):
+                        # only the just-written step's directory — uploading
+                        # the whole checkpoint_dir would re-send every
+                        # retained checkpoint each save (ref uploads the one
+                        # new file, legacy/train_dalle.py:667-669)
+                        import os
                         metrics_writer.log_artifact(
-                            tc.checkpoint_dir,
+                            os.path.join(tc.checkpoint_dir, str(step_num)),
                             name=f"trained-{self.model_class.lower()}",
                             metadata={"step": step_num})
                 if getattr(tc, "sample_every_steps", 0) and sample_fn and \

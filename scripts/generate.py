@@ -134,21 +134,23 @@ def main(argv=None):
                 temperature=args.temperature, cond_scale=args.cond_scale,
                 clip=clip, precision="bfloat16" if args.bf16 else "float32")
             if clip is not None:
+                # reranking needs the whole set — accumulate
                 imgs, scores = out
                 all_scores.append(np.asarray(scores))
+                all_imgs.append(np.asarray(imgs))
             else:
-                imgs = out
-            all_imgs.append(np.asarray(imgs))
+                # stream each batch to disk as it is produced
+                save_image_grid(np.asarray(out),
+                                os.path.join(outdir, f"img_{made}_{{}}.png"))
             made += n
-        imgs = np.concatenate(all_imgs)
         if clip is not None:
             # best-first ordering by CLIP similarity (reference :553-555)
+            imgs = np.concatenate(all_imgs)
             scores = np.concatenate(all_scores)
             order = np.argsort(-scores)
-            imgs = imgs[order]
             print("clip scores (best first): "
                   + " ".join(f"{scores[i]:.4f}" for i in order))
-        save_image_grid(imgs, os.path.join(outdir, "img_{}.png"))
+            save_image_grid(imgs[order], os.path.join(outdir, "img_{}.png"))
         print(f"wrote {made} images for {text_str!r} → {outdir}")
     return 0
 
