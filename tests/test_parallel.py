@@ -2,6 +2,8 @@
 
 import argparse
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -99,7 +101,7 @@ jax.config.update('jax_platforms', 'cpu')
 import argparse, sys
 import numpy as np
 
-pid, port = int(sys.argv[1]), sys.argv[2]
+pid, port, nproc = int(sys.argv[1]), sys.argv[2], int(sys.argv[3])
 from dalle_tpu.parallel import backend as B
 
 ap = argparse.ArgumentParser()
@@ -107,23 +109,23 @@ B.wrap_arg_parser(ap)
 args = ap.parse_args([
     '--distributed_backend', 'jax',
     '--coordinator_address', f'127.0.0.1:{port}',
-    '--num_processes', '2', '--process_id', str(pid)])
+    '--num_processes', str(nproc), '--process_id', str(pid)])
 b = B.set_backend_from_args(args).initialize()
 
-assert jax.process_count() == 2, jax.process_count()
-assert b.get_world_size() == 4, b.get_world_size()          # 2 procs x 2 devs
+assert jax.process_count() == nproc, jax.process_count()
+assert b.get_world_size() == 2 * nproc, b.get_world_size()  # 2 devs/proc
 assert b.get_rank() == pid * 2, (pid, b.get_rank())
 assert b.is_root_worker() == (pid == 0)
 assert b.is_local_root_worker()
 b.local_barrier()                                           # sync_global_devices
 
 avg = b.average_all(np.float32(pid))                        # process_allgather
-assert abs(float(avg) - 0.5) < 1e-6, avg
+assert abs(float(avg) - (nproc - 1) / 2) < 1e-6, avg
 
 from dalle_tpu.data.webdataset import split_shards_per_host
-shards = [f's{i}' for i in range(5)]
+shards = [f's{i}' for i in range(2 * nproc + 1)]
 mine = split_shards_per_host(shards)
-want = shards[pid::2]
+want = shards[pid::nproc]
 assert mine == want, (mine, want)
 
 b.local_barrier()
@@ -131,7 +133,7 @@ print(f'CHILD_OK {pid} rank={b.get_rank()}')
 """
 
 
-def test_two_process_dcn(tmp_path):
+def _run_dcn(tmp_path, nproc):
     import os
     import socket
     import subprocess
@@ -150,10 +152,10 @@ def test_two_process_dcn(tmp_path):
     env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=2").strip()
     env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-    procs = [subprocess.Popen([sys.executable, str(script), str(i), str(port)],
-                              env=env, stdout=subprocess.PIPE,
-                              stderr=subprocess.STDOUT, text=True)
-             for i in range(2)]
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(i), str(port), str(nproc)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for i in range(nproc)]
     outs = []
     for p in procs:
         try:
@@ -166,3 +168,15 @@ def test_two_process_dcn(tmp_path):
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"child {i} failed:\n{out[-3000:]}"
         assert f"CHILD_OK {i}" in out
+
+
+def test_two_process_dcn(tmp_path):
+    """Real 2-process jax.distributed over a loopback coordinator."""
+    _run_dcn(tmp_path, 2)
+
+
+@pytest.mark.slow
+def test_four_process_dcn(tmp_path):
+    """4 hosts x 2 devices — multi-host beyond the pairwise case (rank
+    arithmetic, shard split, allgather at world size 8)."""
+    _run_dcn(tmp_path, 4)
