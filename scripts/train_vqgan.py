@@ -63,6 +63,14 @@ def build_parser():
     train.add_argument("--seed", type=int, default=42)
     train.add_argument("--steps", type=int, default=None)
     train.add_argument("--no_preflight", action="store_true")
+    train.add_argument("--sample_every_steps", type=int, default=0,
+                       help="write original/recon grids (taming ImageLogger "
+                            "parity, taming/main.py:215-313)")
+    train.add_argument("--sample_dir", type=str, default="./vqgan_samples")
+    train.add_argument("--wandb", action="store_true")
+    train.add_argument("--wandb_project", type=str, default="vqgan_train")
+    train.add_argument("--wandb_name", type=str, default=None)
+    train.add_argument("--log_artifacts", action="store_true")
 
     from dalle_tpu.parallel import wrap_arg_parser
     wrap_arg_parser(ap)
@@ -103,6 +111,8 @@ def main(argv=None):
         checkpoint_dir=args.output_dir, save_every_steps=args.save_every_steps,
         keep_n_checkpoints=args.keep_n_checkpoints,
         preflight_checkpoint=not args.no_preflight,
+        sample_every_steps=args.sample_every_steps,
+        log_artifacts=args.log_artifacts,
         # taming: Adam(lr, betas=(0.5, 0.9)) for both nets (vqgan.py:121-131)
         optim=OptimConfig(learning_rate=lr, beta1=0.5, beta2=0.9,
                           grad_clip_norm=0.0))
@@ -137,7 +147,42 @@ def main(argv=None):
         print(f"VQGAN {'gumbel' if args.gumbel else 'vq'}: "
               f"{model_cfg.to_json()}")
     log = print if is_root else (lambda *a, **k: None)
-    trainer.fit(batches, steps=args.steps, log=log)
+
+    from dalle_tpu.train.metrics import MetricsLogger
+    metrics_writer = None
+    if is_root:
+        metrics_writer = MetricsLogger(
+            path=os.path.join(args.output_dir, "metrics.jsonl"),
+            use_wandb=args.wandb, project=args.wandb_project,
+            run_name=args.wandb_name, config={"model": model_cfg.to_dict()})
+
+    # original/recon grids (taming ImageLogger parity, main.py:215-313)
+    sample_fn = None
+    if args.sample_every_steps:
+        os.makedirs(args.sample_dir, exist_ok=True)
+        if args.synthetic:
+            probe = ds.as_arrays(limit=4)[0] * 2.0 - 1.0
+        else:
+            probe, _ = batch_arrays(ds, list(range(min(4, len(ds)))))
+            probe = probe * 2.0 - 1.0
+
+        def sample_fn(step):
+            from PIL import Image
+            recon = np.asarray(trainer.reconstruct(probe))
+            grid = np.concatenate([np.concatenate(list(probe), 1),
+                                   np.concatenate(list(recon), 1)], 0)
+            grid = ((grid + 1) * 127.5).clip(0, 255).astype("uint8")
+            Image.fromarray(grid).save(
+                os.path.join(args.sample_dir, f"step{step}_recon.png"))
+            if metrics_writer is not None:
+                metrics_writer.log_images(step, (recon + 1) * 0.5,
+                                          key="reconstructions")
+            log(f"[step {step}] recon grid → {args.sample_dir}")
+
+    trainer.fit(batches, steps=args.steps, log=log, sample_fn=sample_fn,
+                metrics_writer=metrics_writer)
+    if metrics_writer is not None:
+        metrics_writer.close()
 
     final = int(trainer.state.step)
     if trainer.ckpt.latest_step() != final:
