@@ -142,7 +142,9 @@ class Attention(nn.Module):
                 "sequence parallelism supports the full causal path only "
                 "(attn_types=('full',), no key_mask)")
             from ..parallel.ring_attention import ring_attention
-            out = ring_attention(q, k, v, mesh=self.sp_mesh, causal=True)
+            # zigzag: balanced causal layout + quadrant skipping (exact)
+            out = ring_attention(q, k, v, mesh=self.sp_mesh, causal=True,
+                                 zigzag=True)
         elif self.use_pallas and key_mask is None and not self.is_initializing():
             # (init uses the dense path: params are identical and eager pallas
             # execution during un-jitted init is needlessly slow)
