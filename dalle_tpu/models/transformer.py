@@ -388,6 +388,10 @@ class Transformer(nn.Module):
             elif t == "conv_like":
                 specs[t] = ("conv", self.text_len, fmap,
                             c.sparse_attn_kernel, 1)
+            elif t == "sparse":
+                # block-aligned random-block pattern: kernel tiles coincide
+                # with the pattern's block grid, no element mask needed
+                specs[t] = ("block", c.sparse_block_size)
             else:
                 specs[t] = None
         self.mask_specs = specs

@@ -97,6 +97,12 @@ def elem_fn_from_spec(spec):
     if spec is None:
         return None
     kind = spec[0]
+    if kind == "block":
+        # block-aligned pattern (e.g. the DeepSpeed-style random-block
+        # 'sparse' variant): every kernel tile is either wholly visible or
+        # wholly skipped by the block lists, so no element test is needed —
+        # flash_attention pins the kernel block size to the pattern's
+        return None
     if kind == "axial":
         _, text_len, fmap, axis = spec
 
@@ -303,7 +309,7 @@ def _make_flash_fn(n: int, n_pad: int, block_q: int, block_k: int,
     # mask row was as much VMEM traffic per grid step as the scores
     # themselves, and the dkv kernel's scoped VMEM overflowed at long seq
     elem_fn = elem_fn_from_spec(mask_spec)
-    has_mask = mask_np is not None and elem_fn is None
+    has_mask = mask_np is not None and mask_spec is None
     # int32 mask: Mosaic v5e has no i8 or packed-bf16 vector compare, so 4
     # bytes/entry is the narrowest workable element mask; long-seq masked
     # configs therefore top out at block 128/256 (VMEM), which the tuner picks.
@@ -487,6 +493,10 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     n = q.shape[2]
+    if mask_spec is not None and mask_spec[0] == "block":
+        # block-aligned pattern: kernel tiles must coincide with the
+        # pattern's block grid for the no-element-mask shortcut to be exact
+        block_q = block_k = int(mask_spec[1])
     # a structured spec carries no element-mask operand: auto blocks use the
     # roomier mask-free VMEM budget
     tabled = mask is not None and mask_spec is None

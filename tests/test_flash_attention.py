@@ -245,3 +245,32 @@ def test_structured_mask_spec_matches_table(spec, builder):
     for a, b in zip(gt, gs):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_block_aligned_spec_matches_table():
+    """('block', B) spec: kernel tiles pinned to the pattern's block grid so
+    the block lists alone encode the sparsity — outputs/grads must equal the
+    tabled path for the DeepSpeed-style random-block pattern."""
+    from dalle_tpu.ops.attn_masks import block_sparse_mask
+    n, B = 26, 8
+    mask = np.asarray(block_sparse_mask(n, text_len=10, block=B,
+                                        num_random_blocks=1, seed=3))
+    q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (2, 2, n, 16))
+               for i in range(3))
+
+    def loss_table(q, k, v):
+        o = flash_attention(q, k, v, mask=mask, causal=True,
+                            block_q=B, block_k=B)
+        return jnp.sum(jnp.sin(o))
+
+    def loss_spec(q, k, v):
+        o = flash_attention(q, k, v, mask=mask, mask_spec=("block", B),
+                            causal=True)
+        return jnp.sum(jnp.sin(o))
+
+    lt, gt = jax.value_and_grad(loss_table, (0, 1, 2))(q, k, v)
+    ls, gs = jax.value_and_grad(loss_spec, (0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(float(ls), float(lt), rtol=1e-6)
+    for a, b in zip(gt, gs):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-5, atol=1e-6)
