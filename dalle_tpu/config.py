@@ -243,7 +243,10 @@ class TransformerConfig(ConfigBase):
     shared_attn_ids: Optional[Tuple[int, ...]] = None
     shared_ff_ids: Optional[Tuple[int, ...]] = None
     optimize_for_inference: bool = False  # sparse→dense+static-mask swap
-    use_pallas: bool = False              # pallas flash-attention on the full path
+    # pallas flash attention: "auto" (default) self-selects by the measured
+    # crossover — flash at seq ≥ 2048 on TPU, dense below (ops/
+    # flash_attention.resolve_use_pallas); "on"/"off" (or bools) override
+    use_pallas: str = "auto"
     # f32 attention softmax is the safe default; False keeps scores bf16 —
     # the dominant HBM tensor (big train-throughput win, tiny numeric delta)
     attn_softmax_f32: bool = True
@@ -278,7 +281,7 @@ class DalleConfig(ConfigBase):
     share_input_output_emb: bool = False
     reversible: bool = False
     use_remat: bool = True
-    use_pallas: bool = False
+    use_pallas: str = "auto"   # auto | on | off (see TransformerConfig)
     attn_softmax_f32: bool = True
     sparse_block_size: int = 128
     sparse_attn_kernel: int = 5

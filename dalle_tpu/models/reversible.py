@@ -13,9 +13,10 @@ TPU redesign:
     for the parameter/activation cotangents — activation memory is constant in
     depth, the compute cost is one extra forward (same as the reference).
   * No RNG dance: JAX dropout keys are explicit, so a recompute with the same
-    key is bit-identical by construction. (v1 restriction: the reversible path
-    requires deterministic execution — pass dropout-free configs; the sequential
-    path supports dropout.)
+    key is bit-identical by construction. Dropout works through key replay —
+    each block fn carries its (depth-folded) dropout key inside its params
+    pytree (Transformer._call_reversible), so the backward recompute draws the
+    same masks; grads ≡ naive autodiff with dropout (tests/test_reversible.py).
   * `f`/`g` are pure functions (params pytree, activations) — the flax layers
     are unbound (`Module.unbind()`) by the Transformer before entering here, so
     the custom_vjp boundary sees only pytrees. Shared layers appear as the same

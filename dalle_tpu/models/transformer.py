@@ -136,15 +136,23 @@ class Attention(nn.Module):
             rot = rotary[:n][None, None]
             q, k, v = (apply_rotary(rot, t) for t in (q, k, v))
         if self.sp_mesh is not None and not self.is_initializing():
-            # sequence-parallel ring attention (full-causal path only: sparse
-            # masks and key-padding masks are not sequence-sharded here)
-            assert np_mask is None and key_mask is None and self.causal, (
-                "sequence parallelism supports the full causal path only "
-                "(attn_types=('full',), no key_mask)")
+            # sequence-parallel ring attention: full causal plus structured
+            # (axial/conv) sparse masks, whose element test is a pure function
+            # of global (qpos, kpos) the ring evaluates per chunk pair —
+            # tabled masks ('sparse' random blocks) have no such function and
+            # stay single-chip
+            assert key_mask is None and self.causal, (
+                "sequence parallelism requires causal attention, no key_mask")
+            assert np_mask is None or (
+                mask_spec is not None and mask_spec[0] in ("axial", "conv")), (
+                "sequence parallelism supports full/axial/conv attention only")
             from ..parallel.ring_attention import ring_attention
-            # zigzag: balanced causal layout + quadrant skipping (exact)
+            # zigzag: balanced causal layout + quadrant skipping (exact);
+            # kernel='auto' → Pallas chunk kernels on TPU for chunks ≥ 512
             out = ring_attention(q, k, v, mesh=self.sp_mesh, causal=True,
-                                 zigzag=True)
+                                 zigzag=True,
+                                 mask_spec=mask_spec if np_mask is not None
+                                 else None)
         elif self.use_pallas and key_mask is None and not self.is_initializing():
             # (init uses the dense path: params are identical and eager pallas
             # execution during un-jitted init is needlessly slow)
@@ -356,6 +364,10 @@ class Transformer(nn.Module):
         fmap = c.image_fmap_size
         img_seq = fmap * fmap
         self.text_len = c.seq_len + 1 - img_seq if c.causal else 0
+        # "auto" resolves against the measured v5e crossover: flash kernels
+        # for seq ≥ 2048 on TPU, dense below (ops/flash_attention.py)
+        from ..ops.flash_attention import resolve_use_pallas
+        use_pallas = resolve_use_pallas(c.use_pallas, c.seq_len)
 
         attn_types = tuple(c.attn_types) or ("full",)
         type_per_layer = list(islice(cycle(attn_types), c.depth))
@@ -412,7 +424,7 @@ class Transformer(nn.Module):
             else:
                 attn = Attention(c.dim, c.heads, c.dim_head, c.attn_dropout,
                                  causal=c.causal, stable=c.stable,
-                                 use_pallas=c.use_pallas,
+                                 use_pallas=use_pallas,
                                  softmax_f32=c.attn_softmax_f32,
                                  sp_mesh=self.sp_mesh,
                                  name=f"attn_{aid}")
@@ -475,9 +487,11 @@ class Transformer(nn.Module):
         block fn carries its dropout key in the params pytree, so the
         custom_vjp backward's recompute uses bit-identical masks — the
         TPU-native version of the reference's RNG save/restore dance
-        (reversible.py:20-50). The same base key goes to every block: flax's
-        ``make_rng`` folds in the module path, so each layer still draws a
-        distinct mask, identical to what the sequential path would draw."""
+        (reversible.py:20-50). Each block gets the base key with its depth
+        index folded in: layers reused via shared_attn_ids/shared_ff_ids live
+        at the same module path, so without the fold every reuse would draw
+        the identical dropout mask (the sequential path decorrelates repeats
+        through flax's rng call counter)."""
         from .reversible import run_reversible
         c = self.cfg
         use_dropout = (not deterministic
@@ -497,6 +511,9 @@ class Transformer(nn.Module):
         tm, variables = self.unbind()
         fns, params = [], []
         for ind in range(c.depth):
+            blk_key = (None if drop_key is None
+                       else jax.random.fold_in(drop_key, ind))
+
             def f(p, h, _ind=ind):
                 var, key = p
                 rngs = None if key is None else {"dropout": key}
@@ -511,7 +528,7 @@ class Transformer(nn.Module):
                                 method=Transformer._apply_ff_layer, rngs=rngs)
 
             fns.append((f, g))
-            params.append(((variables, drop_key), (variables, drop_key)))
+            params.append(((variables, blk_key), (variables, blk_key)))
         return run_reversible(fns, params, x)
 
     def _apply_attn_layer(self, h, ind: int, key_mask=None,

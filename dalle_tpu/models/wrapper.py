@@ -114,12 +114,17 @@ class DalleWithVae:
         params, cache_dtype = self.params, jnp.float32
         if precision in ("bfloat16", "bf16"):
             # cast once and cache — re-casting the full tree per call would
-            # serialize GBs of casts ahead of every batch's decode loop
-            if getattr(self, "_bf16_params", None) is None:
+            # serialize GBs of casts ahead of every batch's decode loop. The
+            # cache keeps the source tree object and compares identity, so a
+            # checkpoint reload / EMA swap on the same wrapper recasts instead
+            # of reusing stale weights
+            cached = getattr(self, "_bf16_params", None)
+            if cached is None or cached[0] is not self.params:
                 from ..train.train_state import cast_floating
                 object.__setattr__(self, "_bf16_params",
-                                   cast_floating(self.params, jnp.bfloat16))
-            params = self._bf16_params
+                                   (self.params,
+                                    cast_floating(self.params, jnp.bfloat16)))
+            params = self._bf16_params[1]
             cache_dtype = jnp.bfloat16
         ids = self.model.apply(
             params, text, key, filter_thres=filter_thres,

@@ -460,6 +460,34 @@ def sparsity_fraction(n: int, block_q: int = 128, block_k: int = 128,
     return float(lists.k_cnt.sum()) / float(nq * nk)
 
 
+# measured fwd+bwd crossover on v5e (scripts/bench_flash.py, NEXT.md table):
+# dense wins below ~2k seq (flash ~0.9-1.0x at 512-1040), flash wins above
+# (1.4-1.5x full at 2281, up to 4.3x for structured sparse at 4352)
+PALLAS_AUTO_MIN_SEQ = 2048
+
+
+def resolve_use_pallas(setting, seq_len: int, backend: Optional[str] = None) -> bool:
+    """Resolve a config's ``use_pallas`` ("auto" | on | off, bools and their
+    string forms accepted for config round-trips) into the per-model bool.
+    "auto" applies the measured crossover: flash for seq ≥ 2048 on TPU, dense
+    below (and always dense off-TPU, where the kernels run interpret-mode) —
+    so default long-sequence configs hit the flash path with no flag, the way
+    the reference's sparse layers defaulted onto its CUDA kernel
+    (attention.py:339-398)."""
+    if isinstance(setting, bool):
+        return setting
+    s = str(setting).lower()
+    if s == "auto":
+        if backend is None:
+            backend = jax.default_backend()
+        return seq_len >= PALLAS_AUTO_MIN_SEQ and backend == "tpu"
+    if s in ("1", "true", "on", "yes"):
+        return True
+    if s in ("0", "false", "off", "no", "none"):
+        return False
+    raise ValueError(f"use_pallas must be auto/on/off, got {setting!r}")
+
+
 def _auto_block(n: int, has_mask: bool) -> int:
     """Measured v5e defaults (scripts/bench_flash.py, fwd+bwd, bf16):
     mask-free kernels carry no element-mask operand so bigger blocks fit;
@@ -494,9 +522,16 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
         interpret = jax.default_backend() != "tpu"
     n = q.shape[2]
     if mask_spec is not None and mask_spec[0] == "block":
-        # block-aligned pattern: kernel tiles must coincide with the
-        # pattern's block grid for the no-element-mask shortcut to be exact
-        block_q = block_k = int(mask_spec[1])
+        if int(mask_spec[1]) % 128 != 0:
+            # a non-lane-aligned pattern block (e.g. the reference's size 16,
+            # attention.py:358) would force tiny Mosaic tiles — a lowering
+            # failure/perf cliff on real TPU. Fall back to the tabled
+            # element-mask path, which handles arbitrary masks at 128+ tiles.
+            mask_spec = None
+        else:
+            # block-aligned pattern: kernel tiles must coincide with the
+            # pattern's block grid for the no-element-mask shortcut to be exact
+            block_q = block_k = int(mask_spec[1])
     # a structured spec carries no element-mask operand: auto blocks use the
     # roomier mask-free VMEM budget
     tabled = mask is not None and mask_spec is None

@@ -78,8 +78,12 @@ class DalleTrainer(BaseTrainer):
 
         sp = dict(self.mesh.shape).get("sp", 1)
         if sp > 1:
-            assert tuple(model_cfg.attn_types or ("full",)) == ("full",), (
-                "sequence parallelism (sp > 1) supports attn_types=('full',)")
+            sp_ok = {"full", "axial_row", "axial_col", "conv_like"}
+            bad = set(model_cfg.attn_types or ("full",)) - sp_ok
+            assert not bad, (
+                f"sequence parallelism (sp > 1) supports attn_types {sp_ok}; "
+                f"got unsupported {bad} (tabled 'sparse' masks need host-side "
+                "block lists the ring cannot shard)")
         self.model, params = init_dalle(
             model_cfg, self.base_key, sp_mesh=self.mesh if sp > 1 else None)
         params = shard_params(self.mesh, params)

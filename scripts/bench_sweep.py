@@ -95,8 +95,10 @@ def main():
                       image_vocab_size=8192, image_fmap_size=64,
                       attn_types=("full", "axial_row", "axial_col", "full"),
                       attn_softmax_f32=False)
-            run("longseq_dense_b2", LS, 2, steps=4)
-            run("longseq_pallas_b2", dict(LS, use_pallas=True), 2, steps=4)
+            # the DEFAULT config (use_pallas="auto") self-selects flash at
+            # seq 4352 ≥ the 2048 crossover — no flag needed
+            run("longseq_dense_b2", dict(LS, use_pallas="off"), 2, steps=4)
+            run("longseq_auto_pallas_b2", LS, 2, steps=4)
         elif w == "gen":
             bench_generation()
         elif w == "vae":

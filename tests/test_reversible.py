@@ -123,17 +123,18 @@ def test_transformer_reversible_dropout_grads_match_naive_coupling():
                        rngs={"dropout": dkey})
 
     def loss_naive(p):
-        # same coupling, same per-layer rng derivation (flax folds the module
-        # path into make_rng, so passing the same base key reproduces the
-        # reversible path's masks exactly)
+        # same coupling, same per-layer rng derivation: the reversible path
+        # folds the depth index into the base key (so layers shared across
+        # depths draw distinct masks), and flax folds the module path on top
         x1 = x2 = x
         for ind in range(cfg.depth):
+            bk = jax.random.fold_in(base, ind)
             x1 = x1 + model.apply(p, x2, ind, None, False,
                                   method=Transformer._apply_attn_layer,
-                                  rngs={"dropout": base})
+                                  rngs={"dropout": bk})
             x2 = x2 + model.apply(p, x1, ind, False,
                                   method=Transformer._apply_ff_layer,
-                                  rngs={"dropout": base})
+                                  rngs={"dropout": bk})
         return jnp.sum(((x1 + x2) / 2.0) ** 2)
 
     np.testing.assert_allclose(float(loss_rev(params)),
