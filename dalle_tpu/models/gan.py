@@ -122,6 +122,12 @@ class GANLossConfig(ConfigBase):
     perceptual_weight: float = 1.0
     use_actnorm: bool = False
     disc_loss: str = "hinge"   # hinge | vanilla
+    # which perceptual net backs the LPIPS term: "tiny" (default) loads the
+    # repo's shipped in-repo-trained weights (models/data/tiny_perceptual.npz,
+    # scripts/train_perceptual.py); "vgg" builds the torchvision-shaped trunk
+    # for load_torch_weights import of the reference's vgg.pth (random-init
+    # until imported — the round-2 placeholder behavior)
+    perceptual_net: str = "tiny"
 
 
 def _conv_out_apply(h, kernel, bias):

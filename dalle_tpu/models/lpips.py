@@ -1,4 +1,4 @@
-"""LPIPS perceptual distance (VGG16 features + learned 1×1 heads).
+"""LPIPS perceptual distance (conv features + learned 1×1 heads).
 
 Reference: ``LPIPS`` (dalle_pytorch/taming/modules/losses/lpips.py:11-123):
 a frozen torchvision VGG16 split into 5 relu slices, per-channel input
@@ -6,16 +6,28 @@ scaling, unit-normalized feature differences, squeezed through learned 1×1
 "lin" layers and spatially averaged.
 
 TPU notes: plain XLA convs in NHWC; the whole distance is one fused forward —
-no kernel work needed. Pretrained weights: this environment has zero egress,
-so ``load_torch_weights`` imports from a local torch checkpoint when one is
-available (torchvision ``vgg16`` state_dict + taming ``vgg.pth`` lin heads);
-otherwise the model runs with random features, which still defines a valid
-distance for tests (flagged via ``pretrained=False`` in the params metadata).
+no kernel work needed.
+
+Pretrained weights — two paths for a zero-egress environment:
+  * ``load_torch_weights`` imports a local torchvision ``vgg16`` state_dict +
+    taming ``vgg.pth`` lin heads when the user has them on disk (the
+    reference downloads them, taming/util.py:5-44; golden-tested in
+    tests/test_golden_import.py).
+  * ``load_tiny_perceptual`` loads the repo's OWN shipped weights
+    (models/data/tiny_perceptual.npz): a small trunk with the same
+    slice/normalize/lin structure, trained in-repo by
+    scripts/train_perceptual.py — trunk on shape/color/scale classification
+    over the synthetic shapes corpus (data/synthetic.py), lin heads on
+    2AFC-style distortion ranking (the same supervision style LPIPS lins get,
+    synthesized from parametric distortions instead of human judgments).
+    This is the default perceptual net for VQGAN training, replacing the
+    round-2 ones-init placeholder with a real perceptual metric.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, Sequence
+import os
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax
@@ -33,18 +45,27 @@ _VGG_SLICES = (
 )
 _LPIPS_CHANNELS = (64, 128, 256, 512, 512)
 
+# the in-repo trained trunk (scripts/train_perceptual.py): same structure,
+# ~0.6M params so the weights ship inside the package
+TINY_SLICES = ((32, 32), (64, 64), (128, 128), (256,))
+_TINY_WEIGHTS = os.path.join(os.path.dirname(__file__), "data",
+                             "tiny_perceptual.npz")
+
 # ImageNet scaling constants (taming lpips.py ScalingLayer:57-66)
 _SHIFT = np.array([-0.030, -0.088, -0.188], np.float32)
 _SCALE = np.array([0.458, 0.448, 0.450], np.float32)
 
 
 class VGG16Features(nn.Module):
-    """VGG16 conv trunk returning the 5 LPIPS relu slices (lpips.py:69-101)."""
+    """Conv trunk returning the relu slice outputs (lpips.py:69-101). The
+    default slice spec is torchvision VGG16; ``TINY_SLICES`` gives the
+    in-repo trunk (same structure, package-shippable size)."""
+    slices: Optional[Tuple[Tuple[int, ...], ...]] = None
 
     @nn.compact
     def __call__(self, x) -> Sequence[jnp.ndarray]:
         outs = []
-        for s, chans in enumerate(_VGG_SLICES):
+        for s, chans in enumerate(self.slices or _VGG_SLICES):
             if s > 0:
                 x = nn.max_pool(x, (2, 2), strides=(2, 2))
             for i, ch in enumerate(chans):
@@ -62,10 +83,11 @@ def _unit_normalize(x, eps: float = 1e-10):
 
 class LPIPS(nn.Module):
     """Perceptual distance d(x, y); inputs NHWC in [−1, 1]."""
+    slices: Optional[Tuple[Tuple[int, ...], ...]] = None
 
     @nn.compact
     def __call__(self, x, y):
-        vgg = VGG16Features(name="vgg")
+        vgg = VGG16Features(slices=self.slices, name="vgg")
         shift = jnp.asarray(_SHIFT, x.dtype)
         scale = jnp.asarray(_SCALE, x.dtype)
         fx = vgg((x - shift) / scale)
@@ -80,11 +102,32 @@ class LPIPS(nn.Module):
         return total  # (b,)
 
 
-def init_lpips(key: jax.Array, image_size: int = 64):
-    model = LPIPS()
+def init_lpips(key: jax.Array, image_size: int = 64,
+               slices: Optional[Tuple[Tuple[int, ...], ...]] = None):
+    model = LPIPS(slices=slices)
     x = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
     params = model.init(key, x, x)
     return model, params
+
+
+def save_perceptual_weights(params, path: str = _TINY_WEIGHTS):
+    """Flatten a params pytree to an npz ('/'-joined keys)."""
+    from flax.traverse_util import flatten_dict
+    flat = {"/".join(k): np.asarray(v)
+            for k, v in flatten_dict(jax.device_get(params)).items()}
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    np.savez_compressed(path, **flat)
+
+
+def load_tiny_perceptual(path: str = _TINY_WEIGHTS):
+    """The shipped in-repo perceptual net (see module docstring). Returns
+    (LPIPS model, params). Raises FileNotFoundError if the artifact is
+    missing (callers may fall back to ones-init)."""
+    from flax.traverse_util import unflatten_dict
+    data = np.load(path)
+    params = unflatten_dict({tuple(k.split("/")): jnp.asarray(data[k])
+                             for k in data.files})
+    return LPIPS(slices=TINY_SLICES), params
 
 
 def load_torch_weights(params, vgg_state: Dict[str, Any],

@@ -166,14 +166,14 @@ def make_vqgan_train_step(model: VQModel, disc: NLayerDiscriminator,
             gen_p, disc_p["params"], lpips_p, state.batch_stats, images, key,
             temp, state.step)
         gen_updates, gen_opt = state.gen_tx.update(
-            gen_grads, state.opt_state["gen"], gen_p)
+            gen_grads, state.opt_state["gen"], gen_p, value=ae_loss)
         gen_p = optax.apply_updates(gen_p, gen_updates)
         # --- optimizer_idx 1: discriminator -------------------------------
         (d_loss, d_aux), disc_grads = jax.value_and_grad(
             disc_loss_fn, has_aux=True)(disc_p["params"], state.batch_stats,
                                         images, aux["recon"], state.step)
         disc_updates, disc_opt = state.disc_tx.update(
-            disc_grads, state.opt_state["disc"], disc_p["params"])
+            disc_grads, state.opt_state["disc"], disc_p["params"], value=d_loss)
         disc_p = {"params": optax.apply_updates(disc_p["params"], disc_updates)}
         state = state.replace(
             step=state.step + 1,
@@ -217,7 +217,7 @@ def make_vq_simple_train_step(model: VQModel, loss_cfg: GANLossConfig,
     def step(state: TrainState, images, targets, key, temp):
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, images, targets, key, temp)
-        state = state.apply_gradients(grads)
+        state = state.apply_gradients(grads, value=loss)
         return state, {"loss": loss, **aux}
 
     return step
@@ -261,8 +261,24 @@ class VQGANTrainer(BaseTrainer):
                        model_cfg.in_channels), jnp.float32), train=True)
         batch_stats = disc_vars.get("batch_stats", {})
         if self.loss_cfg.perceptual_weight > 0:
-            self.lpips, lpips_params = init_lpips(
-                jax.random.fold_in(self.base_key, 2), model_cfg.resolution)
+            if self.loss_cfg.perceptual_net == "tiny":
+                # the shipped in-repo perceptual weights (real metric, no
+                # egress needed — scripts/train_perceptual.py)
+                from ..models.lpips import load_tiny_perceptual
+                try:
+                    self.lpips, lpips_params = load_tiny_perceptual()
+                except FileNotFoundError:
+                    import warnings
+                    warnings.warn("tiny_perceptual.npz missing — perceptual "
+                                  "loss falls back to a random-init net")
+                    self.lpips, lpips_params = init_lpips(
+                        jax.random.fold_in(self.base_key, 2),
+                        model_cfg.resolution)
+            else:
+                # torchvision-shaped trunk; import real weights via
+                # models.lpips.load_torch_weights when vgg.pth is on disk
+                self.lpips, lpips_params = init_lpips(
+                    jax.random.fold_in(self.base_key, 2), model_cfg.resolution)
         else:
             self.lpips, lpips_params = None, {}
 

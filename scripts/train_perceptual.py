@@ -1,0 +1,274 @@
+"""Train the in-repo perceptual net (models/data/tiny_perceptual.npz).
+
+The reference downloads LPIPS weights (taming/util.py:5-44 + taming/modules/
+losses/lpips.py:11-54: torchvision VGG16 + ``vgg.pth`` lin heads fitted to
+human 2AFC judgments). This environment has zero egress, so the framework
+ships its OWN perceptual net with the same structure (slices → unit-normalize
+→ 1×1 lin → spatial mean), trained here in two stages:
+
+  1. Trunk: shape/color/scale classification over the synthetic shapes corpus
+     (data/synthetic.py — the same corpus the rainbow end-to-end tests train
+     on). Classification forces the slices to carry edge/color/scale-selective
+     features, which is what a perceptual distance reads.
+  2. Lin heads: 2AFC-style ranking — for a reference image and two strengths
+     of the same parametric distortion (blur / noise / contrast / posterize /
+     color shift / block-downsample), the head must score the stronger
+     distortion farther. This synthesizes the supervision style of the LPIPS
+     lins from distortion magnitude instead of human judgments.
+
+Run (TPU ~2 min, CPU ~15 min):
+    python scripts/train_perceptual.py --out dalle_tpu/models/data/tiny_perceptual.npz
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from functools import partial
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dalle_tpu.data.synthetic import COLORS, SCALES, SHAPES, ShapesDataset
+from dalle_tpu.models.lpips import (LPIPS, TINY_SLICES, VGG16Features,
+                                    save_perceptual_weights)
+
+# ---------------------------------------------------------------------------
+# parametric distortions (strength s in [0, 1]; all pure jnp, jit-friendly)
+# ---------------------------------------------------------------------------
+
+def _box_blur(x, reps):
+    k = jnp.ones((3, 3, 1, 1), x.dtype) / 9.0
+    k = jnp.tile(k, (1, 1, 1, x.shape[-1]))
+
+    def one(img):
+        return jax.lax.conv_general_dilated(
+            img, k, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=x.shape[-1])
+
+    return jax.lax.fori_loop(0, reps, lambda _, v: one(v), x)
+
+
+def distort(x, kind: int, s, key):
+    """Apply distortion ``kind`` at strength ``s`` to NHWC images in [0,1]."""
+    b = x.shape[0]
+    if kind == 0:      # blur (1..6 box passes)
+        return _box_blur(x, 1 + (s * 5.0).astype(jnp.int32))
+    if kind == 1:      # additive gaussian noise
+        return jnp.clip(x + jax.random.normal(key, x.shape) * 0.25 * s, 0, 1)
+    if kind == 2:      # contrast collapse toward the per-image mean
+        mean = jnp.mean(x, axis=(1, 2, 3), keepdims=True)
+        return x * (1 - 0.8 * s) + mean * 0.8 * s
+    if kind == 3:      # posterize (quantize levels 16 → 2)
+        levels = jnp.maximum(16.0 * (1 - s), 2.0)
+        return jnp.round(x * levels) / levels
+    if kind == 4:      # channel shift (hue-ish): blend toward rolled channels
+        return x * (1 - 0.7 * s) + jnp.roll(x, 1, axis=-1) * 0.7 * s
+    if kind == 5:      # block corruption: average-pool k×k then upsample
+        size = x.shape[1]
+        k = 1 + (s * 7.0).astype(jnp.int32)
+
+        def pool(img):
+            idx = (jnp.arange(size) // k) * k
+            return img[:, idx][:, :, idx]
+
+        return pool(x)
+    raise ValueError(kind)
+
+
+N_KINDS = 6
+
+
+# ---------------------------------------------------------------------------
+# stage 1: trunk classification
+# ---------------------------------------------------------------------------
+
+class _Classifier(nn.Module):
+    """GAP over every slice → shared hidden → 3 label heads."""
+
+    @nn.compact
+    def __call__(self, feats):
+        h = jnp.concatenate([jnp.mean(f, axis=(1, 2)) for f in feats], -1)
+        h = nn.relu(nn.Dense(256)(h))
+        return (nn.Dense(len(SHAPES))(h), nn.Dense(len(COLORS))(h),
+                nn.Dense(len(SCALES))(h))
+
+
+def train_trunk(images, labels, *, steps: int, batch: int, seed: int):
+    """images in [-1, 1]; labels: (shape_id, color_id, scale_id) arrays."""
+    trunk = VGG16Features(slices=TINY_SLICES)
+    head = _Classifier()
+    k0, k1 = jax.random.split(jax.random.PRNGKey(seed))
+    x0 = images[:2]
+    tp = trunk.init(k0, x0)
+    hp = head.init(k1, trunk.apply(tp, x0))
+    params = {"trunk": tp, "head": hp}
+    tx = optax.adam(1e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, opt, x, ys, yc, ysc):
+        def loss_fn(p):
+            feats = trunk.apply(p["trunk"], x)
+            ls, lc, lsc = head.apply(p["head"], feats)
+            ce = optax.softmax_cross_entropy_with_integer_labels
+            loss = (ce(ls, ys).mean() + ce(lc, yc).mean() + ce(lsc, ysc).mean())
+            acc = jnp.mean((jnp.argmax(ls, -1) == ys) & (jnp.argmax(lc, -1) == yc)
+                           & (jnp.argmax(lsc, -1) == ysc))
+            return loss, acc
+
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt = tx.update(grads, opt, params)
+        return optax.apply_updates(params, updates), opt, loss, acc
+
+    rng = np.random.RandomState(seed)
+    n = images.shape[0]
+    for i in range(steps):
+        idx = rng.randint(0, n, batch)
+        params, opt, loss, acc = step(params, opt, images[idx],
+                                      labels[0][idx], labels[1][idx],
+                                      labels[2][idx])
+        if i % 100 == 0 or i == steps - 1:
+            print(f"  trunk step {i}: loss {float(loss):.4f} "
+                  f"acc(all-3) {float(acc):.3f}", flush=True)
+    return params["trunk"]
+
+
+# ---------------------------------------------------------------------------
+# stage 2: lin heads on distortion ranking
+# ---------------------------------------------------------------------------
+
+def train_lins(model: LPIPS, lpips_params, images, *, steps: int, batch: int,
+               seed: int, margin: float = 0.05):
+    """Hinge-rank d(x, weak) + margin < d(x, strong), within distortion type.
+    Only the lin heads train; the trunk stays frozen."""
+    lin_keys = [k for k in lpips_params["params"] if k.startswith("lin")]
+    tx = optax.adam(3e-3)
+
+    def split(p):
+        lins = {k: p["params"][k] for k in lin_keys}
+        return lins
+
+    def join(lins):
+        newp = dict(lpips_params["params"])
+        newp.update(lins)
+        return {"params": newp}
+
+    lins = split(lpips_params)
+    opt = tx.init(lins)
+
+    @jax.jit
+    def step(lins, opt, x, weak, strong):
+        def loss_fn(lins):
+            p = join(lins)
+            d_w = model.apply(p, x, weak)
+            d_s = model.apply(p, x, strong)
+            rank = jnp.mean(jax.nn.relu(margin + d_w - d_s))
+            # keep the overall scale anchored (ranking alone is scale-free)
+            anchor = (jnp.mean(d_s) - 1.0) ** 2 * 0.01
+            acc = jnp.mean(d_s > d_w)
+            return rank + anchor, acc
+
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(lins)
+        updates, opt = tx.update(grads, opt, lins)
+        return optax.apply_updates(lins, updates), opt, loss, acc
+
+    rng = np.random.RandomState(seed)
+    n = images.shape[0]
+    for i in range(steps):
+        idx = rng.randint(0, n, batch)
+        kind = int(rng.randint(N_KINDS))
+        key = jax.random.PRNGKey(rng.randint(1 << 30))
+        x, weak, strong = _make_pairs(images[idx], kind, key)
+        lins, opt, loss, acc = step(lins, opt, x, weak, strong)
+        if i % 100 == 0 or i == steps - 1:
+            print(f"  lin step {i}: rank-loss {float(loss):.4f} "
+                  f"pair-acc {float(acc):.3f}", flush=True)
+    return join(lins)
+
+
+@partial(jax.jit, static_argnums=(1,))
+def _make_pairs(x01, kind, key):
+    """x in [0,1] → (x, weak, strong) in [-1,1] with s_weak < s_strong."""
+    kw, ks, kd1, kd2 = jax.random.split(key, 4)
+    s_weak = jax.random.uniform(kw, (), minval=0.05, maxval=0.45)
+    s_strong = s_weak + jax.random.uniform(ks, (), minval=0.25, maxval=0.5)
+    weak = distort(x01, kind, s_weak, kd1)
+    strong = distort(x01, kind, jnp.minimum(s_strong, 1.0), kd2)
+    to = lambda t: t * 2.0 - 1.0
+    return to(x01), to(weak), to(strong)
+
+
+def rank_accuracy(model, params, images, *, seed: int, trials: int = 60):
+    """Held-out 2AFC accuracy across all distortion types."""
+    rng = np.random.RandomState(seed)
+    hits = total = 0
+    for _ in range(trials):
+        idx = rng.randint(0, images.shape[0], 16)
+        kind = int(rng.randint(N_KINDS))
+        key = jax.random.PRNGKey(rng.randint(1 << 30))
+        x, weak, strong = _make_pairs(images[idx], kind, key)
+        d_w = model.apply(params, x, weak)
+        d_s = model.apply(params, x, strong)
+        hits += int(jnp.sum(d_s > d_w))
+        total += d_w.shape[0]
+    return hits / total
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=str(Path(__file__).resolve().parent.parent /
+                                         "dalle_tpu/models/data/tiny_perceptual.npz"))
+    ap.add_argument("--image_size", type=int, default=64)
+    ap.add_argument("--variants", type=int, default=6)
+    ap.add_argument("--steps_cls", type=int, default=800)
+    ap.add_argument("--steps_lin", type=int, default=600)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    ds = ShapesDataset(image_size=args.image_size, variants=args.variants,
+                       seed=args.seed)
+    print(f"rendering {len(ds)} shape images…", flush=True)
+    samples = [ds[i] for i in range(len(ds))]
+    images01 = jnp.asarray(np.stack([s.image for s in samples]),
+                           jnp.float32) / 255.0
+    shape_ids = {s: i for i, s in enumerate(SHAPES)}
+    color_ids = {c: i for i, c in enumerate(COLORS)}
+    scale_ids = {s: i for i, s in enumerate(SCALES)}
+    labels = (np.array([shape_ids[s.label[1]] for s in samples]),
+              np.array([color_ids[s.label[0]] for s in samples]),
+              np.array([scale_ids[s.label[2]] for s in samples]))
+    # trunk consumes the LPIPS input convention ([-1,1] + ImageNet scaling
+    # happens inside LPIPS; for classification train on the same range)
+    images = images01 * 2.0 - 1.0
+
+    print("stage 1: trunk classification", flush=True)
+    trunk_params = train_trunk(images, labels, steps=args.steps_cls,
+                               batch=args.batch, seed=args.seed)
+
+    model = LPIPS(slices=TINY_SLICES)
+    x0 = images[:2]
+    params = jax.device_get(model.init(jax.random.PRNGKey(args.seed), x0, x0))
+    params["params"]["vgg"] = jax.device_get(trunk_params)["params"]
+
+    print("stage 2: lin heads on distortion ranking", flush=True)
+    params = train_lins(model, params, images01, steps=args.steps_lin,
+                        batch=32, seed=args.seed + 1)
+
+    acc = rank_accuracy(model, params, images01, seed=args.seed + 2)
+    print(f"held-out 2AFC ranking accuracy: {acc:.3f}", flush=True)
+
+    save_perceptual_weights(params, args.out)
+    nbytes = Path(args.out).stat().st_size
+    print(f"saved {args.out} ({nbytes / 1e6:.2f} MB)", flush=True)
+
+
+if __name__ == "__main__":
+    main()
