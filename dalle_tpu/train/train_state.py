@@ -90,20 +90,24 @@ def make_optimizer(cfg: OptimConfig) -> optax.GradientTransformation:
     if cfg.grad_clip_norm and cfg.grad_clip_norm > 0:
         parts.append(optax.clip_by_global_norm(cfg.grad_clip_norm))
     parts.append(core)
-    if cfg.lr_scheduler == "plateau":
-        # ReduceLROnPlateau parity (reference legacy/train_dalle.py:444-459),
-        # as an update scaler fed the loss through apply_gradients(value=...)
-        if cfg.grad_accum_steps > 1:
-            raise ValueError("plateau schedule is incompatible with "
-                             "grad_accum_steps > 1 (MultiSteps drops the "
-                             "loss value the plateau state needs)")
-        from optax import contrib
-        parts.append(contrib.reduce_on_plateau(
-            factor=cfg.plateau_factor, patience=cfg.plateau_patience,
-            cooldown=cfg.plateau_cooldown, min_scale=cfg.plateau_min_scale))
     tx = optax.chain(*parts)
     if cfg.grad_accum_steps > 1:
         tx = optax.MultiSteps(tx, every_k_schedule=cfg.grad_accum_steps)
+    if cfg.lr_scheduler == "plateau":
+        # ReduceLROnPlateau parity (reference legacy/train_dalle.py:444-459),
+        # as an update scaler fed the loss through apply_gradients(value=...).
+        # Sits OUTSIDE MultiSteps so it composes with grad accumulation (the
+        # reference runs ReduceLROnPlateau together with --ga_steps and steps
+        # the scheduler once per data iteration, :100,444-459): the plateau
+        # state sees every micro-step's loss; on accumulation micro-steps the
+        # emitted updates are zero and scaling them is a no-op.
+        from optax import contrib
+        tx = optax.chain(optax.with_extra_args_support(tx),
+                         contrib.reduce_on_plateau(
+                             factor=cfg.plateau_factor,
+                             patience=cfg.plateau_patience,
+                             cooldown=cfg.plateau_cooldown,
+                             min_scale=cfg.plateau_min_scale))
     return optax.with_extra_args_support(tx)
 
 

@@ -190,10 +190,35 @@ def test_plateau_schedule_reduces_update_scale():
         state, d = step_delta(state, 1.0)
         deltas.append(d)
     assert min(deltas) <= 0.5 + 1e-6, deltas   # scale halved at least once
-    # grad accumulation is incompatible (MultiSteps drops the loss value)
-    import pytest
-    with pytest.raises(ValueError):
-        make_optimizer(OptimConfig(lr_scheduler="plateau", grad_accum_steps=2))
+
+
+def test_plateau_composes_with_grad_accumulation():
+    """plateau + MultiSteps (reference runs ReduceLROnPlateau together with
+    --ga_steps, legacy/train_dalle.py:100,444-459): the plateau transform
+    sits outside MultiSteps, sees every micro-step's loss, and scales the
+    k-step updates once they emit."""
+    import jax.numpy as jnp
+    from dalle_tpu.config import OptimConfig
+    from dalle_tpu.train.train_state import TrainState, make_optimizer
+
+    cfg = OptimConfig(optimizer="sgd", learning_rate=1.0, grad_clip_norm=0.0,
+                      grad_accum_steps=2, lr_scheduler="plateau",
+                      plateau_factor=0.5, plateau_patience=2,
+                      plateau_cooldown=0)
+    tx = make_optimizer(cfg)
+    state = TrainState.create(apply_fn=None, params={"w": jnp.zeros(1)}, tx=tx)
+    g = {"w": jnp.ones(1)}
+
+    deltas = []
+    for _ in range(16):                        # flat loss → plateau fires
+        prev = float(state.params["w"][0])
+        state = state.apply_gradients(g, value=jnp.float32(1.0))
+        deltas.append(prev - float(state.params["w"][0]))
+    # micro-steps emit zero updates; full steps emit the averaged update
+    assert abs(deltas[0]) < 1e-6               # first micro-step: accumulating
+    assert abs(deltas[1] - 1.0) < 1e-6         # first full step at scale 1
+    emitted = [d for d in deltas if abs(d) > 1e-6]
+    assert min(emitted) <= 0.5 + 1e-6, deltas  # scale halved at least once
 
 
 def test_metrics_logger_images_and_artifacts_degrade_without_wandb(tmp_path):
