@@ -21,7 +21,12 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
-NEG_INF = jnp.float32(-1e9)
+# plain Python float, NOT jnp.float32(...): a module-level jnp constant would
+# initialize the XLA backend at import time, which breaks
+# jax.distributed.initialize in any process that imports dalle_tpu.parallel
+# before connecting to the coordinator (weak-typed, so it never promotes
+# bf16 score tensors either)
+NEG_INF = -1e9
 
 
 def stable_softmax(t: jnp.ndarray, axis: int = -1, alpha: float = 32.0 ** 2) -> jnp.ndarray:

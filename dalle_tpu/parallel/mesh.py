@@ -59,15 +59,24 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_batch(mesh: Mesh, batch):
-    """Place a host batch onto the mesh, sharded along the batch dimension."""
+def shard_batch(mesh: Mesh, batch, leading_replicated: int = 0):
+    """Place a host batch onto the mesh, sharded along the batch dimension.
+    ``leading_replicated`` axes before the batch dim stay replicated (e.g. the
+    scan/step axis of a (k, b, ...) microbatch stack)."""
     spec = batch_spec(mesh)
+    lead = (None,) * leading_replicated
 
     def put(x):
-        pspec = P(*(spec + (None,) * (x.ndim - 1)))
+        pspec = P(*lead, *(spec + (None,) * (x.ndim - 1 - leading_replicated)))
         return jax.device_put(x, NamedSharding(mesh, pspec))
 
     return jax.tree.map(put, batch)
+
+
+def shard_stacked_batch(mesh: Mesh, batch):
+    """(k, b, ...) microbatch stacks: axis 0 = scan step (replicated),
+    axis 1 = batch (sharded) — the input layout of ``train_steps``."""
+    return shard_batch(mesh, batch, leading_replicated=1)
 
 
 @contextlib.contextmanager

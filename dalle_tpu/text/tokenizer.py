@@ -165,19 +165,26 @@ class ChineseTokenizer:
             self.tokenizer = BertTokenizer(vocab_file=str(model_name))
         else:
             try:
-                self.tokenizer = BertTokenizer.from_pretrained(model_name)
-            except (OSError, EnvironmentError):
-                # hub unreachable / not cached — other failures (corrupted
-                # cache, version skew) must surface, not silently shrink the
-                # vocab from 21128 to the mini fixture's ~190
-                if model_name != "bert-base-chinese":
-                    raise
-                import warnings
-                warnings.warn(
-                    "bert-base-chinese unavailable (offline?) — falling back "
-                    f"to the vendored mini vocab {self.VENDORED_VOCAB}")
-                self.tokenizer = BertTokenizer(
-                    vocab_file=str(self.VENDORED_VOCAB))
+                # local cache first: a cached-but-corrupted model raises a
+                # parse error (ValueError/JSON) here, which must surface —
+                # only "not in cache" (OSError) proceeds to the hub
+                self.tokenizer = BertTokenizer.from_pretrained(
+                    model_name, local_files_only=True)
+            except OSError:
+                try:
+                    self.tokenizer = BertTokenizer.from_pretrained(model_name)
+                except OSError:
+                    # hub unreachable AND not cached: fall back (default
+                    # model only) so the path stays executable offline
+                    if model_name != "bert-base-chinese":
+                        raise
+                    import warnings
+                    warnings.warn(
+                        "bert-base-chinese unavailable (offline?) — falling "
+                        f"back to the vendored mini vocab "
+                        f"{self.VENDORED_VOCAB}")
+                    self.tokenizer = BertTokenizer(
+                        vocab_file=str(self.VENDORED_VOCAB))
         self.vocab_size = self.tokenizer.vocab_size
 
     def encode(self, text: str) -> List[int]:

@@ -19,26 +19,21 @@ from __future__ import annotations
 from functools import partial
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import optax
 
 from ..config import DalleConfig, TrainConfig
 from ..models.dalle import DALLE, init_dalle
-from ..parallel import shard_batch, shard_params
+from ..parallel import shard_batch, shard_params, shard_stacked_batch
 from .base_trainer import BaseTrainer
 from .metrics import ThroughputMeter, count_params, transformer_train_flops
 from .train_state import (TrainState, cast_floating, compute_dtype,
                           make_optimizer)
 
 
-def make_dalle_train_step(model: DALLE, *, null_cond_prob: float = 0.0,
-                          use_dropout: bool = False, dtype=None):
-    """Returns step(state, text, image_ids, key) -> (state, metrics). jit-once
-    with the state donated; ``null_cond_prob``/``use_dropout`` are compile-time
-    (they select rng wiring). ``dtype`` (e.g. bf16) is the compute precision:
-    params are cast inside the step, master copies stay f32 — the TPU-native
-    replacement for the DeepSpeed fp16 engine (SURVEY.md §2.9 Apex AMP row)."""
-
+def _make_dalle_loss_fn(model: DALLE, *, null_cond_prob: float,
+                        use_dropout: bool, dtype):
     def loss_fn(params, text, image_ids, key):
         rngs = {}
         if null_cond_prob > 0:
@@ -52,6 +47,19 @@ def make_dalle_train_step(model: DALLE, *, null_cond_prob: float = 0.0,
                                 rngs=rngs or None)
         return loss, aux
 
+    return loss_fn
+
+
+def make_dalle_train_step(model: DALLE, *, null_cond_prob: float = 0.0,
+                          use_dropout: bool = False, dtype=None):
+    """Returns step(state, text, image_ids, key) -> (state, metrics). jit-once
+    with the state donated; ``null_cond_prob``/``use_dropout`` are compile-time
+    (they select rng wiring). ``dtype`` (e.g. bf16) is the compute precision:
+    params are cast inside the step, master copies stay f32 — the TPU-native
+    replacement for the DeepSpeed fp16 engine (SURVEY.md §2.9 Apex AMP row)."""
+    loss_fn = _make_dalle_loss_fn(model, null_cond_prob=null_cond_prob,
+                                  use_dropout=use_dropout, dtype=dtype)
+
     @partial(jax.jit, donate_argnums=(0,))
     def step(state: TrainState, text, image_ids, key):
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
@@ -61,6 +69,38 @@ def make_dalle_train_step(model: DALLE, *, null_cond_prob: float = 0.0,
         return new_state, metrics
 
     return step
+
+
+def make_dalle_train_multi_step(model: DALLE, *, null_cond_prob: float = 0.0,
+                                use_dropout: bool = False, dtype=None):
+    """k optimizer steps in ONE device program: ``lax.scan`` over the step
+    body consuming a (k, b, ...) microbatch stack. Per-dispatch host overhead
+    (20ms-class through remote-device tunnels) amortizes over k steps, and
+    the k-1 interior state handoffs never touch the host — the TPU analogue
+    of a captured CUDA graph replay. Math per step is identical to
+    ``make_dalle_train_step`` (same loss/grad/update body; per-step rng =
+    fold_in(call key, step index))."""
+    loss_fn = _make_dalle_loss_fn(model, null_cond_prob=null_cond_prob,
+                                  use_dropout=use_dropout, dtype=dtype)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def steps(state: TrainState, texts, image_ids, key):
+        def body(state, xs):
+            text, ids, i = xs
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params, text, ids, jax.random.fold_in(key, i))
+            new_state = state.apply_gradients(grads, value=loss)
+            return new_state, {"loss": loss,
+                               "grad_norm": optax.global_norm(grads), **aux}
+
+        k = texts.shape[0]
+        state, ms = jax.lax.scan(body, state,
+                                 (texts, image_ids, jnp.arange(k)))
+        metrics = jax.tree.map(lambda x: x[-1], ms)   # last step's metrics
+        metrics["loss_mean"] = jnp.mean(ms["loss"])
+        return state, metrics
+
+    return steps
 
 
 class DalleTrainer(BaseTrainer):
@@ -94,6 +134,10 @@ class DalleTrainer(BaseTrainer):
         self.step_fn = make_dalle_train_step(
             self.model, null_cond_prob=null_cond_prob, use_dropout=use_dropout,
             dtype=compute_dtype(train_cfg.precision))
+        self._multi_step_kw = dict(null_cond_prob=null_cond_prob,
+                                   use_dropout=use_dropout,
+                                   dtype=compute_dtype(train_cfg.precision))
+        self._multi_step_fn = None   # built lazily on first train_steps()
 
         n = count_params(self.state.params)
         self.num_params = n
@@ -111,4 +155,25 @@ class DalleTrainer(BaseTrainer):
         text = shard_batch(self.mesh, np.asarray(text, np.int32))
         image_ids = shard_batch(self.mesh, np.asarray(image_ids, np.int32))
         self.state, metrics = self.step_fn(self.state, text, image_ids, key)
+        return self._finish_step(metrics)
+
+    # -- k steps in one device program ---------------------------------------
+    def train_steps(self, texts: np.ndarray, image_ids: np.ndarray):
+        """Run ``k = texts.shape[0]`` optimizer steps from stacked (k, b, ...)
+        microbatches in a single dispatched scan (see
+        make_dalle_train_multi_step). Returns the last step's metrics dict
+        plus ``loss_mean`` over the k steps; the host step advances by k."""
+        assert texts.ndim == 3 and image_ids.ndim == 3, (
+            "train_steps wants stacked (k, b, seq) microbatches")
+        if self._multi_step_fn is None:
+            self._multi_step_fn = make_dalle_train_multi_step(
+                self.model, **self._multi_step_kw)
+        key = jax.random.fold_in(self.base_key, self._host_step)
+        texts = shard_stacked_batch(self.mesh, np.asarray(texts, np.int32))
+        image_ids = shard_stacked_batch(self.mesh,
+                                        np.asarray(image_ids, np.int32))
+        k = texts.shape[0]
+        self.state, metrics = self._multi_step_fn(self.state, texts,
+                                                  image_ids, key)
+        self._host_step += k - 1     # _finish_step adds the final +1
         return self._finish_step(metrics)

@@ -16,7 +16,10 @@ import jax
 import numpy as np
 
 
-def run(name, cfg_kw, batch, steps=8, attn_flops=True):
+def run(name, cfg_kw, batch, steps=8, attn_flops=True, scan_k=0):
+    """``scan_k > 0``: drive trainer.train_steps with (scan_k, b, ...) stacks
+    — per-dispatch tunnel overhead (~20ms/call here) amortizes over scan_k
+    device-side steps, measuring the chip rather than the host."""
     from dalle_tpu.config import DalleConfig, MeshConfig, OptimConfig, TrainConfig
     from dalle_tpu.parallel.mesh import build_mesh
     from dalle_tpu.train.metrics import device_peak_tflops
@@ -37,14 +40,27 @@ def run(name, cfg_kw, batch, steps=8, attn_flops=True):
     def sync():
         jax.device_get(jax.tree.leaves(trainer.state.params)[0]).ravel()[0]
 
-    for _ in range(3):
-        trainer.train_step(text, image_ids)
-    sync()
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        trainer.train_step(text, image_ids)
-    sync()
-    dt = (time.perf_counter() - t0) / steps
+    if scan_k:
+        texts = np.broadcast_to(text, (scan_k, *text.shape)).copy()
+        idss = np.broadcast_to(image_ids, (scan_k, *image_ids.shape)).copy()
+        calls = max(1, steps // scan_k)
+        for _ in range(2):
+            trainer.train_steps(texts, idss)
+        sync()
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            trainer.train_steps(texts, idss)
+        sync()
+        dt = (time.perf_counter() - t0) / (calls * scan_k)
+    else:
+        for _ in range(3):
+            trainer.train_step(text, image_ids)
+        sync()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            trainer.train_step(text, image_ids)
+        sync()
+        dt = (time.perf_counter() - t0) / steps
 
     n = cfg.total_seq_len
     tokens_per_step = batch * n
@@ -78,6 +94,19 @@ def main():
             run("small_b64", SMALL, 64)
         elif w == "small128":
             run("small_b128", SMALL, 128)
+        elif w == "small_opt":
+            # the MFU-attack grid for the small config (VERDICT r2 next #4):
+            # remat off (memory is plentiful at 50M params — stop paying the
+            # recompute), flash at seq 512, and the scanned multi-step that
+            # takes per-dispatch tunnel overhead out of the measurement
+            run("small_b64", SMALL, 64)
+            run("small_noremat_b64", dict(SMALL, use_remat=False), 64)
+            run("small_flash_b64", dict(SMALL, use_pallas="on"), 64)
+            run("small_noremat_flash_b64",
+                dict(SMALL, use_remat=False, use_pallas="on"), 64)
+            run("small_scan8_b64", SMALL, 64, steps=16, scan_k=8)
+            run("small_noremat_scan8_b64", dict(SMALL, use_remat=False), 64,
+                steps=16, scan_k=8)
         elif w == "medium":
             for b in (16, 32):
                 run(f"medium_b{b}", MEDIUM, b)
@@ -99,6 +128,17 @@ def main():
             # seq 4352 ≥ the 2048 crossover — no flag needed
             run("longseq_dense_b2", dict(LS, use_pallas="off"), 2, steps=4)
             run("longseq_auto_pallas_b2", LS, 2, steps=4)
+        elif w == "longseq8k":
+            # 8k-class sequence (90x90 fmap → 8100 image + 256 text tokens):
+            # the regime where the flash kernel's O(n) memory and block
+            # skipping compound (VERDICT r2 next #1 bench criterion)
+            LS8 = dict(num_text_tokens=10000, text_seq_len=256, dim=512,
+                       depth=4, heads=8, dim_head=64, image_size=720,
+                       image_vocab_size=8192, image_fmap_size=90,
+                       attn_types=("full", "axial_row", "axial_col", "full"),
+                       attn_softmax_f32=False)
+            run("longseq8k_dense_b1", dict(LS8, use_pallas="off"), 1, steps=3)
+            run("longseq8k_auto_pallas_b1", LS8, 1, steps=3)
         elif w == "gen":
             bench_generation()
         elif w == "vae":

@@ -133,7 +133,7 @@ print(f'CHILD_OK {pid} rank={b.get_rank()}')
 """
 
 
-def _run_dcn(tmp_path, nproc):
+def _run_dcn(tmp_path, nproc, child_code=None, devices_per_proc=2):
     import os
     import socket
     import subprocess
@@ -144,12 +144,14 @@ def _run_dcn(tmp_path, nproc):
         port = s.getsockname()[1]
 
     script = tmp_path / "dcn_child.py"
-    script.write_text(_CHILD_CODE)
+    script.write_text(child_code or _CHILD_CODE)
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     flags = " ".join(f for f in env.get("XLA_FLAGS", "").split()
                      if "xla_force_host_platform_device_count" not in f)
-    env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=2").strip()
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={devices_per_proc}"
+    ).strip()
     env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
     procs = [subprocess.Popen(
@@ -180,3 +182,82 @@ def test_four_process_dcn(tmp_path):
     """4 hosts x 2 devices — multi-host beyond the pairwise case (rank
     arithmetic, shard split, allgather at world size 8)."""
     _run_dcn(tmp_path, 4)
+
+
+# --------------------------------------------------------------------------
+# Ring attention ACROSS processes: the sp mesh spans 2 procs x 4 devices, so
+# half the ppermute hops cross the process (DCN) boundary — the single-
+# process 8-device tests can't exercise that collective surface
+# (VERDICT r2 next #7).
+# --------------------------------------------------------------------------
+
+_RING_SP_CHILD = """
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import sys
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+pid, port, nproc = int(sys.argv[1]), sys.argv[2], int(sys.argv[3])
+jax.distributed.initialize(coordinator_address=f'127.0.0.1:{port}',
+                           num_processes=nproc, process_id=pid)
+assert jax.device_count() == 8 and jax.local_device_count() == 4
+
+from dalle_tpu.parallel.ring_attention import ring_attention
+
+mesh = Mesh(np.array(jax.devices()), ('sp',))
+spec = P(None, None, 'sp', None)
+sharding = NamedSharding(mesh, spec)
+
+b, h, n, d = 2, 2, 256, 32
+rng = np.random.RandomState(0)               # same on every process
+qn, kn, vn = (rng.standard_normal((b, h, n, d)).astype(np.float32)
+              for _ in range(3))
+
+def put(a):
+    return jax.make_array_from_callback(a.shape, sharding,
+                                        lambda idx: a[idx])
+
+q, k, v = put(qn), put(kn), put(vn)
+
+# numpy oracle (f32 causal softmax attention)
+s = np.einsum('bhid,bhjd->bhij', qn * d ** -0.5, kn)
+s = np.where(np.tril(np.ones((n, n), bool)), s, -1e9)
+p = np.exp(s - s.max(-1, keepdims=True))
+ref = np.einsum('bhij,bhjd->bhid', p / p.sum(-1, keepdims=True), vn)
+
+def check(out, what, tol=3e-5):
+    shards = out.addressable_shards
+    assert shards, what
+    for sh in shards:
+        np.testing.assert_allclose(np.asarray(sh.data), ref[sh.index],
+                                   rtol=tol, atol=tol, err_msg=what)
+
+for zigzag in (False, True):
+    fn = jax.jit(lambda q, k, v, z=zigzag: ring_attention(
+        q, k, v, mesh=mesh, causal=True, zigzag=z, kernel=False))
+    check(fn(q, k, v), f'dense ring zigzag={zigzag}')
+
+# kernel (pallas, interpret on CPU) ring: fwd numerics + the whole-ring
+# custom_vjp backward, whose dk/dv ppermutes also cross the DCN boundary
+kfn = jax.jit(lambda q, k, v: ring_attention(
+    q, k, v, mesh=mesh, causal=True, zigzag=True, kernel=True,
+    interpret=True))
+check(kfn(q, k, v), 'kernel ring zigzag', tol=2e-4)
+
+gfn = jax.jit(jax.grad(lambda q, k, v: jnp.sum(kfn(q, k, v) ** 2)))
+gq = gfn(q, k, v)
+for sh in gq.addressable_shards:
+    assert np.isfinite(np.asarray(sh.data)).all(), 'kernel ring grad'
+
+print(f'CHILD_OK {pid}')
+"""
+
+
+@pytest.mark.slow
+def test_ring_attention_across_processes(tmp_path):
+    """Ring attention over sp=8 spanning 2 processes (4 devices each):
+    ppermute hops cross the process boundary in forward and in the kernel
+    ring's backward; outputs verified shard-by-shard vs a numpy oracle."""
+    _run_dcn(tmp_path, 2, child_code=_RING_SP_CHILD, devices_per_proc=4)

@@ -125,25 +125,24 @@ class TestChineseTokenizer:
         assert out[0, :3].tolist() == [10, 11, 9]
         assert "你 好" in tok.decode(ids) or "你好" in tok.decode(ids)
 
-    def test_default_falls_back_to_vendored_vocab(self):
+    def test_default_falls_back_to_vendored_vocab(self, monkeypatch):
         """get_tokenizer('chinese') must be executable offline: the default
         hub model falls back to the vendored mini WordPiece vocab
-        (text/data/chinese_vocab_mini.txt) with a warning (VERDICT r2 #8)."""
-        pytest.importorskip("transformers")
-        import os
-        import warnings
+        (text/data/chinese_vocab_mini.txt) with a warning (VERDICT r2 #8).
+        from_pretrained is stubbed to raise OSError — env-var tricks
+        (HF_HUB_OFFLINE) bind at transformers import time and would not
+        force the branch on a machine with the model cached."""
+        transformers = pytest.importorskip("transformers")
         from dalle_tpu.text.tokenizer import ChineseTokenizer, get_tokenizer
         assert ChineseTokenizer.VENDORED_VOCAB.is_file()
-        env = dict(os.environ)
-        os.environ["HF_HUB_OFFLINE"] = "1"     # force the offline branch
-        os.environ["TRANSFORMERS_OFFLINE"] = "1"
-        try:
-            with warnings.catch_warnings():
-                warnings.simplefilter("ignore")
-                tok = get_tokenizer("chinese")
-        finally:
-            os.environ.clear()
-            os.environ.update(env)
+
+        def unreachable(*a, **k):
+            raise OSError("hub unreachable (test stub)")
+
+        monkeypatch.setattr(transformers.BertTokenizer, "from_pretrained",
+                            unreachable)
+        with pytest.warns(UserWarning, match="vendored mini vocab"):
+            tok = get_tokenizer("chinese")
         assert tok.vocab_size >= 150
         ids = tok.encode("红色圆形")
         assert len(ids) == 4 and all(i > 4 for i in ids)   # no [UNK] (id 1)

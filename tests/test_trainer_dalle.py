@@ -55,6 +55,35 @@ def test_sharded_step_matches_single_device(tmp_path, rng):
                                rtol=2e-4)
 
 
+def test_train_steps_scan_matches_single_steps(tmp_path, rng):
+    """k steps in one scanned program ≡ k single-step dispatches (same
+    stacked data, rng-free config) — the multi-step path is a dispatch
+    optimization, not different math."""
+    k, b = 3, 8
+    texts = np.stack([_batch(rng, TINY, b)[0] for _ in range(k)])
+    rng2 = np.random.RandomState(7)
+    idss = np.stack([rng2.randint(0, TINY.image_vocab_size,
+                                  (b, TINY.image_seq_len)) for _ in range(k)])
+    mesh_cfg = MeshConfig(dp=4, fsdp=2)
+    tc = TrainConfig(batch_size=b, checkpoint_dir=str(tmp_path),
+                     preflight_checkpoint=False, mesh=mesh_cfg,
+                     precision=PrecisionConfig(compute="float32"),
+                     optim=OptimConfig(learning_rate=1e-2))
+
+    tr1 = DalleTrainer(TINY, tc, mesh=build_mesh(mesh_cfg))
+    single = [tr1.train_step(texts[i], idss[i])["loss"] for i in range(k)]
+
+    tr2 = DalleTrainer(TINY, tc, mesh=build_mesh(mesh_cfg))
+    m = tr2.train_steps(texts, idss)
+    assert tr2._host_step == k
+    np.testing.assert_allclose(m["loss"], single[-1], rtol=1e-5)
+    np.testing.assert_allclose(m["loss_mean"], np.mean(single), rtol=1e-5)
+    p1 = jax.device_get(tr1.state.params)
+    p2 = jax.device_get(tr2.state.params)
+    for a, b_ in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_allclose(a, b_, rtol=2e-5, atol=2e-6)
+
+
 def test_fit_checkpoint_resume(tmp_path, rng):
     mesh_cfg = MeshConfig(dp=2)
     mesh = build_mesh(mesh_cfg, devices=jax.devices()[:2])
