@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Attention-core shootout at the DALL·E-small shapes (b64 h8 n512 dh64,
+bf16, causal, fwd+bwd): dense attend vs our Pallas flash vs the official
+jax.experimental TPU flash_attention and splash_attention. One dispatched
+scan per candidate. Source of docs/PERF_SMALL.md's kernel table."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timed(fn, args, k=8):
+    @jax.jit
+    def many(args):
+        def body(c, _):
+            a = tuple(x + jnp.asarray(1e-12 * c, x.dtype) for x in args)
+            g = jax.grad(
+                lambda *a: jnp.sum(fn(*a).astype(jnp.float32) ** 2),
+                argnums=0)(*a)
+            return c + 1e-30 * jnp.sum(g.astype(jnp.float32)), None
+
+        c, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=k)
+        return c
+
+    float(jax.device_get(many(args)))
+    t0 = time.perf_counter()
+    float(jax.device_get(many(args)))
+    return (time.perf_counter() - t0) / k
+
+
+def main():
+    b, h, n, d = 64, 8, 512, 64
+    rng = np.random.RandomState(0)
+    q, k_, v = (jnp.asarray(rng.standard_normal((b, h, n, d)), jnp.bfloat16)
+                for _ in range(3))
+
+    from dalle_tpu.ops.attention import attend
+    print("dense_attend      %7.2f ms" % (1e3 * timed(
+        lambda q, k, v: attend(q, k, v, causal=True, softmax_f32=False),
+        (q, k_, v))))
+
+    from dalle_tpu.ops.flash_attention import flash_attention
+    for blk in (128, 256, 512):
+        try:
+            t = timed(lambda q, k, v, blk=blk: flash_attention(
+                q, k, v, causal=True, block_q=blk, block_k=blk), (q, k_, v))
+            print("ours_flash_b%-4d  %7.2f ms" % (blk, 1e3 * t))
+        except Exception as e:
+            print("ours_flash_b%-4d  FAIL %s" % (blk, str(e)[:60]))
+
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes, flash_attention as jax_flash)
+    for blk in (128, 256, 512):
+        try:
+            bs = BlockSizes(
+                block_q=blk, block_k_major=blk, block_k=blk, block_b=1,
+                block_q_major_dkv=blk, block_k_major_dkv=blk,
+                block_k_dkv=blk, block_q_dkv=blk,
+                block_k_major_dq=blk, block_k_dq=blk, block_q_dq=blk)
+            t = timed(lambda q, k, v, bs=bs: jax_flash(
+                q, k, v, causal=True, sm_scale=d ** -0.5, block_sizes=bs),
+                (q, k_, v))
+            print("jax_flash_b%-4d   %7.2f ms" % (blk, 1e3 * t))
+        except Exception as e:
+            print("jax_flash_b%-4d   FAIL %s" % (blk, str(e)[:60]))
+
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as sk, splash_attention_mask as sm)
+    mqk = sm.MultiHeadMask([sm.CausalMask((n, n))] * h)
+    for blk in (256, 512):
+        try:
+            bs = sk.BlockSizes(
+                block_q=blk, block_kv=blk, block_kv_compute=blk,
+                block_q_dkv=blk, block_kv_dkv=blk, block_kv_dkv_compute=blk,
+                block_q_dq=blk, block_kv_dq=blk)
+            kernel = sk.make_splash_mha(mask=mqk, head_shards=1,
+                                        q_seq_shards=1, block_sizes=bs)
+            fn = jax.vmap(lambda q, k, v: kernel(q * (d ** -0.5), k, v))
+            t = timed(fn, (q, k_, v))
+            print("splash_b%-4d      %7.2f ms" % (blk, 1e3 * t))
+        except Exception as e:
+            print("splash_b%-4d      FAIL %s" % (blk, str(e)[:60]))
+
+
+if __name__ == "__main__":
+    main()

@@ -91,6 +91,10 @@ def main():
     which = sys.argv[1:] or ["small"]
     for w in which:
         if w == "small":
+            # shipped-best small recipe (docs/PERF_SMALL.md): scanned
+            # multi-step + chunked CE; the plain dispatch entry for reference
+            run("small_scan8_chunk256_b64", dict(SMALL, loss_chunk=256), 64,
+                steps=16, scan_k=8)
             run("small_b64", SMALL, 64)
         elif w == "small128":
             run("small_b128", SMALL, 128)
@@ -107,6 +111,18 @@ def main():
             run("small_scan8_b64", SMALL, 64, steps=16, scan_k=8)
             run("small_noremat_scan8_b64", dict(SMALL, use_remat=False), 64,
                 steps=16, scan_k=8)
+        elif w == "small_opt2":
+            # round 2: chunked vocab-head CE (the head is 23.5ms vs a 9.6ms
+            # roofline at b64 — f32 logits traffic) and batch scaling
+            run("small_chunk128_scan8_b64", dict(SMALL, loss_chunk=128), 64,
+                steps=16, scan_k=8)
+            run("small_chunk256_scan8_b64", dict(SMALL, loss_chunk=256), 64,
+                steps=16, scan_k=8)
+            run("small_scan8_b128", SMALL, 128, steps=16, scan_k=8)
+            run("small_chunk256_scan8_b128", dict(SMALL, loss_chunk=256), 128,
+                steps=16, scan_k=8)
+            run("small_chunk256_scan4_b256", dict(SMALL, loss_chunk=256), 256,
+                steps=8, scan_k=4)
         elif w == "medium":
             for b in (16, 32):
                 run(f"medium_b{b}", MEDIUM, b)

@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Decompose the DALL·E-small train step on the real chip: which component
+owns the gap between the ~60ms flops-ideal and the ~195ms measured step?
+
+Each candidate subprogram runs K times inside ONE dispatched lax.scan (the
+input is perturbed by the carry so XLA cannot hoist the body), so per-call
+tunnel overhead (~20ms here) is excluded from every number.
+
+Usage: python scripts/profile_small.py [K]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timed_scan(fn, args, k=8, grad=False, wrt=0):
+    """Time fn (or grad of fn) executed k times inside one scan dispatch.
+    Returns seconds per execution."""
+    if grad:
+        base = jax.grad(
+            lambda *a: jnp.sum(fn(*a).astype(jnp.float32) ** 2), argnums=wrt)
+    else:
+        base = fn
+
+    @jax.jit
+    def many(args):
+        def body(c, _):
+            perturbed = tuple(
+                a + jnp.asarray(1e-12 * c, a.dtype)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a
+                for a in args)
+            out = base(*perturbed)
+            s = (jnp.sum(out[0] if isinstance(out, tuple) else out)
+                 .astype(jnp.float32))
+            return c + s * 0e0 + 1e-30 * s, None
+
+        c, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=k)
+        return c
+
+    r = many(args)
+    float(jax.device_get(r))           # warm/compile + hard sync
+    t0 = time.perf_counter()
+    r = many(args)
+    float(jax.device_get(r))
+    return (time.perf_counter() - t0) / k
+
+
+def main():
+    k = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    from dalle_tpu.config import DalleConfig
+    from dalle_tpu.models.dalle import init_dalle
+    from dalle_tpu.train.train_state import cast_floating
+
+    cfg = DalleConfig(
+        num_text_tokens=10000, text_seq_len=256, dim=512, depth=12, heads=8,
+        dim_head=64, image_size=128, image_vocab_size=8192,
+        image_fmap_size=16, attn_softmax_f32=False)
+    b, n, d = 64, cfg.total_seq_len, cfg.dim
+    model, params = init_dalle(cfg, jax.random.PRNGKey(0))
+    bf16 = cast_floating(params, jnp.bfloat16)
+    rng = np.random.RandomState(0)
+    text = jnp.asarray(rng.randint(1, cfg.num_text_tokens,
+                                   (b, cfg.text_seq_len)), jnp.int32)
+    ids = jnp.asarray(rng.randint(0, cfg.image_vocab_size,
+                                  (b, cfg.image_seq_len)), jnp.int32)
+
+    report = {}
+
+    # 1. full loss fwd (bf16 params like the train step)
+    def loss(p, text, ids):
+        l, _ = model.apply(p, text, ids, return_loss=True)
+        return l
+
+    report["loss_fwd"] = timed_scan(
+        lambda t, i: loss(bf16, t, i), (text, ids), k)
+
+    # 2. full loss fwd+bwd (grad wrt params — the train step's core)
+    gfn = jax.grad(lambda p, t, i: loss(p, t, i))
+
+    @jax.jit
+    def many_grad(p, t, i):
+        def body(c, _):
+            g = gfn(jax.tree.map(
+                lambda x: x + jnp.asarray(1e-12 * c, x.dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, p), t, i)
+            return c + 1e-30 * jnp.sum(
+                jax.tree.leaves(g)[0].astype(jnp.float32)), None
+        c, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=k)
+        return c
+
+    r = many_grad(bf16, text, ids)
+    float(jax.device_get(r))
+    t0 = time.perf_counter()
+    float(jax.device_get(many_grad(bf16, text, ids)))
+    report["loss_fwd_bwd"] = (time.perf_counter() - t0) / k
+
+    # 3. transformer stack alone (fwd and fwd+bwd) on (b, n, d) bf16
+    from dalle_tpu.models.transformer import Transformer
+    tcfg = cfg.transformer()
+    tr = Transformer(tcfg)
+    x = jnp.asarray(rng.standard_normal((b, n, d)), jnp.bfloat16)
+    tp = tr.init(jax.random.PRNGKey(1), x)
+    tpb = cast_floating(tp, jnp.bfloat16)
+    report["transformer_fwd"] = timed_scan(
+        lambda x: tr.apply(tpb, x), (x,), k)
+    report["transformer_fwd_bwd"] = timed_scan(
+        lambda x: tr.apply(tpb, x), (x,), k, grad=True)
+
+    # 4. vocab head + CE alone: x(b,n,d) @ W(d, V) + softmax CE fwd+bwd
+    V = cfg.total_tokens
+    W = jnp.asarray(rng.standard_normal((d, V)) * 0.02, jnp.bfloat16)
+    labels = jnp.asarray(rng.randint(0, V, (b, n)), jnp.int32)
+
+    def head_ce(x, W):
+        logits = (x @ W).astype(jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, labels[..., None], -1)[..., 0]
+        return jnp.mean(lse - gold)
+
+    report["head_ce_fwd"] = timed_scan(head_ce, (x, W), k)
+    report["head_ce_fwd_bwd"] = timed_scan(head_ce, (x, W), k, grad=True)
+
+    # 5. attention cores only: 12x attend(b,h,n,dh) (no proj)
+    from dalle_tpu.ops.attention import attend
+    q = jnp.asarray(rng.standard_normal((b, cfg.heads, n, cfg.dim_head)),
+                    jnp.bfloat16)
+
+    def attn12(q):
+        y = q
+        for _ in range(cfg.depth):
+            y = attend(y, q, q, causal=True, softmax_f32=False)
+        return y
+
+    report["attend_x12_fwd"] = timed_scan(attn12, (q,), k)
+    report["attend_x12_fwd_bwd"] = timed_scan(attn12, (q,), k, grad=True)
+
+    # 6. dense matmul stack reference: 12 layers x (qkv+out+ff) GEMM flops
+    W1 = jnp.asarray(rng.standard_normal((d, 4 * d)) * 0.02, jnp.bfloat16)
+    W2 = jnp.asarray(rng.standard_normal((4 * d, d)) * 0.02, jnp.bfloat16)
+
+    def ff12(x):
+        y = x
+        for _ in range(cfg.depth):
+            y = jax.nn.gelu(y @ W1) @ W2
+        return y
+
+    report["ff_x12_fwd"] = timed_scan(ff12, (x,), k)
+    report["ff_x12_fwd_bwd"] = timed_scan(ff12, (x,), k, grad=True)
+
+    for name, dt in report.items():
+        print(f"{name:24s} {dt * 1e3:8.2f} ms")
+
+
+if __name__ == "__main__":
+    main()
