@@ -1,55 +1,43 @@
 #!/usr/bin/env python
 """Attention-core shootout at the DALL·E-small shapes (b64 h8 n512 dh64,
-bf16, causal, fwd+bwd): dense attend vs our Pallas flash vs the official
-jax.experimental TPU flash_attention and splash_attention. One dispatched
-scan per candidate. Source of docs/PERF_SMALL.md's kernel table."""
+bf16, causal, FULL fwd+bwd — gradients wrt q, k AND v, so XLA cannot
+dead-code-eliminate the dense arm's dk/dv matmuls while the opaque
+custom_vjp kernels compute theirs): dense attend vs our Pallas flash vs the
+official jax.experimental TPU flash_attention and splash_attention. One
+dispatched scan per candidate (scripts/_bench_util.py). Source of
+docs/PERF_SMALL.md's kernel table."""
 
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
-
-def timed(fn, args, k=8):
-    @jax.jit
-    def many(args):
-        def body(c, _):
-            a = tuple(x + jnp.asarray(1e-12 * c, x.dtype) for x in args)
-            g = jax.grad(
-                lambda *a: jnp.sum(fn(*a).astype(jnp.float32) ** 2),
-                argnums=0)(*a)
-            return c + 1e-30 * jnp.sum(g.astype(jnp.float32)), None
-
-        c, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=k)
-        return c
-
-    float(jax.device_get(many(args)))
-    t0 = time.perf_counter()
-    float(jax.device_get(many(args)))
-    return (time.perf_counter() - t0) / k
+from _bench_util import timed_scan
 
 
 def main():
     b, h, n, d = 64, 8, 512, 64
     rng = np.random.RandomState(0)
+    import jax.numpy as jnp
     q, k_, v = (jnp.asarray(rng.standard_normal((b, h, n, d)), jnp.bfloat16)
                 for _ in range(3))
 
     from dalle_tpu.ops.attention import attend
-    print("dense_attend      %7.2f ms" % (1e3 * timed(
-        lambda q, k, v: attend(q, k, v, causal=True, softmax_f32=False),
-        (q, k_, v))))
+    t = timed_scan(lambda q, k, v: attend(q, k, v, causal=True,
+                                          softmax_f32=False),
+                   (q, k_, v), grad=True)
+    print("dense_attend      %7.2f ms" % (1e3 * t))
 
     from dalle_tpu.ops.flash_attention import flash_attention
     for blk in (128, 256, 512):
         try:
-            t = timed(lambda q, k, v, blk=blk: flash_attention(
-                q, k, v, causal=True, block_q=blk, block_k=blk), (q, k_, v))
+            t = timed_scan(lambda q, k, v, blk=blk: flash_attention(
+                q, k, v, causal=True, block_q=blk, block_k=blk),
+                (q, k_, v), grad=True)
             print("ours_flash_b%-4d  %7.2f ms" % (blk, 1e3 * t))
         except Exception as e:
             print("ours_flash_b%-4d  FAIL %s" % (blk, str(e)[:60]))
@@ -63,9 +51,9 @@ def main():
                 block_q_major_dkv=blk, block_k_major_dkv=blk,
                 block_k_dkv=blk, block_q_dkv=blk,
                 block_k_major_dq=blk, block_k_dq=blk, block_q_dq=blk)
-            t = timed(lambda q, k, v, bs=bs: jax_flash(
+            t = timed_scan(lambda q, k, v, bs=bs: jax_flash(
                 q, k, v, causal=True, sm_scale=d ** -0.5, block_sizes=bs),
-                (q, k_, v))
+                (q, k_, v), grad=True)
             print("jax_flash_b%-4d   %7.2f ms" % (blk, 1e3 * t))
         except Exception as e:
             print("jax_flash_b%-4d   FAIL %s" % (blk, str(e)[:60]))
@@ -82,7 +70,7 @@ def main():
             kernel = sk.make_splash_mha(mask=mqk, head_shards=1,
                                         q_seq_shards=1, block_sizes=bs)
             fn = jax.vmap(lambda q, k, v: kernel(q * (d ** -0.5), k, v))
-            t = timed(fn, (q, k_, v))
+            t = timed_scan(fn, (q, k_, v), grad=True)
             print("splash_b%-4d      %7.2f ms" % (blk, 1e3 * t))
         except Exception as e:
             print("splash_b%-4d      FAIL %s" % (blk, str(e)[:60]))

@@ -1,55 +1,27 @@
 #!/usr/bin/env python
 """Decompose the DALL·E-small train step on the real chip: which component
-owns the gap between the ~60ms flops-ideal and the ~195ms measured step?
+owns the gap between the ~80ms flops-ideal and the ~194ms measured step?
 
-Each candidate subprogram runs K times inside ONE dispatched lax.scan (the
-input is perturbed by the carry so XLA cannot hoist the body), so per-call
-tunnel overhead (~20ms here) is excluded from every number.
+Methodology: scripts/_bench_util.timed_scan — every candidate runs K times
+in one dispatched scan; all floating inputs (INCLUDING weights, passed as
+arguments, never closures) are carry-perturbed so nothing hoists, and
+"fwd+bwd" rows take gradients wrt every floating input so no backward
+matmul is dead-code-eliminated.
 
 Usage: python scripts/profile_small.py [K]
 """
 
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-
-def timed_scan(fn, args, k=8, grad=False, wrt=0):
-    """Time fn (or grad of fn) executed k times inside one scan dispatch.
-    Returns seconds per execution."""
-    if grad:
-        base = jax.grad(
-            lambda *a: jnp.sum(fn(*a).astype(jnp.float32) ** 2), argnums=wrt)
-    else:
-        base = fn
-
-    @jax.jit
-    def many(args):
-        def body(c, _):
-            perturbed = tuple(
-                a + jnp.asarray(1e-12 * c, a.dtype)
-                if jnp.issubdtype(a.dtype, jnp.floating) else a
-                for a in args)
-            out = base(*perturbed)
-            s = (jnp.sum(out[0] if isinstance(out, tuple) else out)
-                 .astype(jnp.float32))
-            return c + s * 0e0 + 1e-30 * s, None
-
-        c, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=k)
-        return c
-
-    r = many(args)
-    float(jax.device_get(r))           # warm/compile + hard sync
-    t0 = time.perf_counter()
-    r = many(args)
-    float(jax.device_get(r))
-    return (time.perf_counter() - t0) / k
+from _bench_util import timed_scan
 
 
 def main():
@@ -73,47 +45,26 @@ def main():
 
     report = {}
 
-    # 1. full loss fwd (bf16 params like the train step)
+    # 1. full loss: params are a perturbed ARGUMENT (closure would hoist)
     def loss(p, text, ids):
         l, _ = model.apply(p, text, ids, return_loss=True)
         return l
 
-    report["loss_fwd"] = timed_scan(
-        lambda t, i: loss(bf16, t, i), (text, ids), k)
+    report["loss_fwd"] = timed_scan(loss, (bf16, text, ids), k)
+    report["loss_fwd_bwd"] = timed_scan(loss, (bf16, text, ids), k,
+                                        grad=True, grad_argnums=(0,))
 
-    # 2. full loss fwd+bwd (grad wrt params — the train step's core)
-    gfn = jax.grad(lambda p, t, i: loss(p, t, i))
-
-    @jax.jit
-    def many_grad(p, t, i):
-        def body(c, _):
-            g = gfn(jax.tree.map(
-                lambda x: x + jnp.asarray(1e-12 * c, x.dtype)
-                if jnp.issubdtype(x.dtype, jnp.floating) else x, p), t, i)
-            return c + 1e-30 * jnp.sum(
-                jax.tree.leaves(g)[0].astype(jnp.float32)), None
-        c, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=k)
-        return c
-
-    r = many_grad(bf16, text, ids)
-    float(jax.device_get(r))
-    t0 = time.perf_counter()
-    float(jax.device_get(many_grad(bf16, text, ids)))
-    report["loss_fwd_bwd"] = (time.perf_counter() - t0) / k
-
-    # 3. transformer stack alone (fwd and fwd+bwd) on (b, n, d) bf16
+    # 2. transformer stack alone (params + activations both differentiated)
     from dalle_tpu.models.transformer import Transformer
-    tcfg = cfg.transformer()
-    tr = Transformer(tcfg)
+    tr = Transformer(cfg.transformer())
     x = jnp.asarray(rng.standard_normal((b, n, d)), jnp.bfloat16)
-    tp = tr.init(jax.random.PRNGKey(1), x)
-    tpb = cast_floating(tp, jnp.bfloat16)
+    tp = cast_floating(tr.init(jax.random.PRNGKey(1), x), jnp.bfloat16)
     report["transformer_fwd"] = timed_scan(
-        lambda x: tr.apply(tpb, x), (x,), k)
+        lambda p, x: tr.apply(p, x), (tp, x), k)
     report["transformer_fwd_bwd"] = timed_scan(
-        lambda x: tr.apply(tpb, x), (x,), k, grad=True)
+        lambda p, x: tr.apply(p, x), (tp, x), k, grad=True)
 
-    # 4. vocab head + CE alone: x(b,n,d) @ W(d, V) + softmax CE fwd+bwd
+    # 3. vocab head + CE alone (grads wrt x and W — the real training work)
     V = cfg.total_tokens
     W = jnp.asarray(rng.standard_normal((d, V)) * 0.02, jnp.bfloat16)
     labels = jnp.asarray(rng.randint(0, V, (b, n)), jnp.int32)
@@ -127,32 +78,34 @@ def main():
     report["head_ce_fwd"] = timed_scan(head_ce, (x, W), k)
     report["head_ce_fwd_bwd"] = timed_scan(head_ce, (x, W), k, grad=True)
 
-    # 5. attention cores only: 12x attend(b,h,n,dh) (no proj)
+    # 4. attention cores only: 12x attend (no projections; q=k=v inputs all
+    # differentiated — dk/dv matmuls stay live)
     from dalle_tpu.ops.attention import attend
     q = jnp.asarray(rng.standard_normal((b, cfg.heads, n, cfg.dim_head)),
                     jnp.bfloat16)
 
-    def attn12(q):
+    def attn12(q, kk, vv):
         y = q
         for _ in range(cfg.depth):
-            y = attend(y, q, q, causal=True, softmax_f32=False)
+            y = attend(y, kk, vv, causal=True, softmax_f32=False)
         return y
 
-    report["attend_x12_fwd"] = timed_scan(attn12, (q,), k)
-    report["attend_x12_fwd_bwd"] = timed_scan(attn12, (q,), k, grad=True)
+    report["attend_x12_fwd"] = timed_scan(attn12, (q, q, q), k)
+    report["attend_x12_fwd_bwd"] = timed_scan(attn12, (q, q, q), k, grad=True)
 
-    # 6. dense matmul stack reference: 12 layers x (qkv+out+ff) GEMM flops
+    # 5. FF stack reference: weights are differentiated arguments, so the
+    # backward includes dW1/dW2 like real training
     W1 = jnp.asarray(rng.standard_normal((d, 4 * d)) * 0.02, jnp.bfloat16)
     W2 = jnp.asarray(rng.standard_normal((4 * d, d)) * 0.02, jnp.bfloat16)
 
-    def ff12(x):
+    def ff12(x, W1, W2):
         y = x
         for _ in range(cfg.depth):
             y = jax.nn.gelu(y @ W1) @ W2
         return y
 
-    report["ff_x12_fwd"] = timed_scan(ff12, (x,), k)
-    report["ff_x12_fwd_bwd"] = timed_scan(ff12, (x,), k, grad=True)
+    report["ff_x12_fwd"] = timed_scan(ff12, (x, W1, W2), k)
+    report["ff_x12_fwd_bwd"] = timed_scan(ff12, (x, W1, W2), k, grad=True)
 
     for name, dt in report.items():
         print(f"{name:24s} {dt * 1e3:8.2f} ms")

@@ -68,9 +68,7 @@ def test_ranks_distortion_strength(tiny, kind):
         f"kind {kind}: {np.asarray(d_w)} vs {np.asarray(d_s)}")
 
 
-def test_vqgan_trainer_defaults_to_tiny_net(tmp_path):
-    """GAN-mode VQGANTrainer with perceptual_weight > 0 must pick up the
-    shipped weights (perceptual_net='tiny' default), not a random/ones init."""
+def _tiny_net_trainer(tmp_path):
     from dalle_tpu.config import TrainConfig, VQGANConfig
     from dalle_tpu.models.gan import GANLossConfig
     from dalle_tpu.train.trainer_vqgan import VQGANTrainer
@@ -80,10 +78,23 @@ def test_vqgan_trainer_defaults_to_tiny_net(tmp_path):
                       attn_resolutions=(16,))
     tc = TrainConfig(batch_size=8, checkpoint_dir=str(tmp_path),
                      preflight_checkpoint=False)
-    tr = VQGANTrainer(cfg, tc, loss_cfg=GANLossConfig(disc_start=0))
+    return VQGANTrainer(cfg, tc, loss_cfg=GANLossConfig(disc_start=0))
+
+
+def test_vqgan_trainer_defaults_to_tiny_net(tmp_path):
+    """GAN-mode VQGANTrainer with perceptual_weight > 0 must pick up the
+    shipped weights (perceptual_net='tiny' default), not a random/ones init."""
+    tr = _tiny_net_trainer(tmp_path)
     lin0 = np.asarray(tr.state.params["lpips"]["params"]["lin0"])
     assert not np.allclose(lin0, 1.0)
-    # one step trains end-to-end with the perceptual term live
+
+
+@pytest.mark.slow
+def test_vqgan_trainer_tiny_net_step(tmp_path):
+    """One GAN step trains end-to-end with the perceptual term live (the
+    generator+disc+LPIPS compile costs ~80s on this box → slow tier; the
+    wiring check above stays default)."""
+    tr = _tiny_net_trainer(tmp_path)
     imgs = np.random.RandomState(0).rand(8, 32, 32, 3).astype(np.float32)
     m = tr.train_step(imgs * 2 - 1)
     assert np.isfinite(m["loss"])
