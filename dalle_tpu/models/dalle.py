@@ -260,8 +260,10 @@ class DALLE(nn.Module):
                                cache_dtype=jnp.float32):
         """AR-sample the full image token sequence. Returns (b, image_seq_len)
         int32 codebook ids. ``text`` must be (b, text_seq_len).
-        ``cache_dtype=bf16`` halves the KV-cache traffic of the decode loop
-        (sampling itself always runs on f32 logits).
+        ``cache_dtype=bf16`` halves the KV-cache traffic of the decode loop;
+        ``cache_dtype=jnp.int8`` halves it again via per-position symmetric
+        quantization (ops/attention.KVCache — sampling itself always runs on
+        f32 logits).
         (reference generate_images :490-557 minus vae decode/CLIP, which live in
         DalleWithVae)"""
         c = self.cfg

@@ -246,7 +246,9 @@ def shift_prefill_state(x, text_len: int, image_size: int,
     docstring)."""
     b, n, d = x.shape
     d4 = d // 4
-    prev = x[:, -1, :2 * d4]
+    # writes cast to the buffer dtype (the buffers may be narrower than the
+    # activations, e.g. bf16 ring buffers alongside an int8 KV cache)
+    prev = x[:, -1, :2 * d4].astype(state.prev.dtype)
     img_len = max(n - text_len, 0)
     if img_len == 0:
         return ShiftState(state.top, state.left, prev)
@@ -255,8 +257,9 @@ def shift_prefill_state(x, text_len: int, image_size: int,
     # positions n-take..n-1 → ring slots (pos - text_len) % image_size
     pos = jnp.arange(n - take, n) - text_len
     slots = pos % image_size
-    top = state.top.at[:, slots].set(chunk[..., :d4])
-    left = state.left.at[:, slots].set(chunk[..., d4:2 * d4])
+    top = state.top.at[:, slots].set(chunk[..., :d4].astype(state.top.dtype))
+    left = state.left.at[:, slots].set(
+        chunk[..., d4:2 * d4].astype(state.left.dtype))
     return ShiftState(top, left, prev)
 
 
@@ -286,13 +289,13 @@ def shift_decode_step(x_t, state: ShiftState, offset, text_len: int,
     txt_shift = jnp.concatenate((state.prev, cur[..., d2:]), axis=-1)
     shifted = jnp.where(is_text, txt_shift, img_shift)[:, None]
     new_top = jax.lax.dynamic_update_slice_in_dim(
-        state.top, cur_top[:, None], ptr, axis=1)
+        state.top, cur_top[:, None].astype(state.top.dtype), ptr, axis=1)
     new_left = jax.lax.dynamic_update_slice_in_dim(
-        state.left, cur_left[:, None], ptr, axis=1)
+        state.left, cur_left[:, None].astype(state.left.dtype), ptr, axis=1)
     # text-phase steps must not write into the image ring buffers
     state = ShiftState(jnp.where(is_text, state.top, new_top),
                        jnp.where(is_text, state.left, new_left),
-                       cur[..., :d2])
+                       cur[..., :d2].astype(state.prev.dtype))
     return shifted, state
 
 
@@ -549,14 +552,17 @@ class Transformer(nn.Module):
         max_seq = max_seq or c.seq_len + 1
         cache: Dict[str, Any] = {}
         d4 = c.dim // 4
+        # int8 selects *quantized KV storage* (KVCache handles scales); the
+        # token-shift ring buffers hold raw hidden slices and stay bf16
+        shift_dtype = jnp.bfloat16 if dtype == jnp.int8 else dtype
         for ind in range(c.depth):
             cache[f"kv_{ind}"] = KVCache.init(batch, c.heads, max_seq,
                                               c.dim_head, dtype)
             if c.shift_tokens:
                 cache[f"shift_attn_{ind}"] = ShiftState.init(
-                    batch, c.image_fmap_size, d4, dtype)
+                    batch, c.image_fmap_size, d4, shift_dtype)
                 cache[f"shift_ff_{ind}"] = ShiftState.init(
-                    batch, c.image_fmap_size, d4, dtype)
+                    batch, c.image_fmap_size, d4, shift_dtype)
         return cache
 
     def prefill(self, x, cache: Dict[str, Any]):

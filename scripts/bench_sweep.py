@@ -209,7 +209,9 @@ def bench_dvae(batch=64, steps=8):
 
 def bench_generation(batch=64, reps=3):
     """Generation p50 latency, BASELINE config-5-shaped: DALL·E-small, 256
-    image tokens, batch 64, top-k 0.9; f32 vs bf16 decode (weights+cache)."""
+    image tokens, batch 64, top-k 0.9; f32 vs bf16 vs bf16+int8-KV decode
+    (the int8 cache halves the cache-read bandwidth that dominates batched
+    decode)."""
     import jax.numpy as jnp
     from dalle_tpu.config import DalleConfig
     from dalle_tpu.models.dalle import DALLE, init_dalle
@@ -220,9 +222,10 @@ def bench_generation(batch=64, reps=3):
     text = np.zeros((batch, cfg.text_seq_len), np.int32)
     text[:, :4] = 7
 
-    for precision in ("float32", "bfloat16"):
+    for precision in ("float32", "bfloat16", "bf16_int8kv"):
         p = params if precision == "float32" else cast_floating(params, jnp.bfloat16)
-        cache_dtype = jnp.float32 if precision == "float32" else jnp.bfloat16
+        cache_dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+                       "bf16_int8kv": jnp.int8}[precision]
 
         @jax.jit
         def gen(p, text, key):

@@ -147,6 +147,14 @@ class TestGeneration:
         # model is the worst case; real checkpoints agree far more often
         assert agree > 0.5, agree
         assert bf16.shape == f32.shape and bf16.dtype == f32.dtype
+        # int8-quantized KV cache (precision='bf16_int8kv'): same contract
+        int8 = model.apply(cast_floating(params, jnp.bfloat16), text, key,
+                           temperature=1e-12, filter_thres=0.999,
+                           cache_dtype=jnp.int8,
+                           method=DALLE.generate_images_tokens)
+        agree8 = (np.asarray(f32) == np.asarray(int8)).mean()
+        assert agree8 > 0.5, agree8
+        assert int8.shape == f32.shape and int8.dtype == f32.dtype
 
     def test_cfg_changes_samples(self, dalle):
         model, params = dalle

@@ -52,6 +52,50 @@ def test_decode_matches_full(attn_types, shift):
     np.testing.assert_allclose(np.asarray(full), np.asarray(inc), atol=2e-5)
 
 
+def test_int8_kv_cache_roundtrip():
+    """Quantized storage: append → read_kv recovers values to ~amax/254 per
+    row; scales live per (b, h, position)."""
+    from dalle_tpu.ops.attention import KVCache
+    rng = jax.random.PRNGKey(3)
+    k_new, v_new = jax.random.normal(rng, (2, 2, 2, 6, 16))
+    cache = KVCache.init(2, 2, 8, 16, dtype=jnp.int8)
+    cache = cache.append(k_new, v_new, 2)
+    ck, cv = cache.read_kv(dtype=jnp.float32)
+    amax = float(jnp.max(jnp.abs(k_new)))
+    np.testing.assert_allclose(np.asarray(ck[:, :, 2:8]), np.asarray(k_new),
+                               atol=amax / 127)
+    np.testing.assert_allclose(np.asarray(cv[:, :, 2:8]), np.asarray(v_new),
+                               atol=float(jnp.max(jnp.abs(v_new))) / 127)
+    assert (np.asarray(ck[:, :, :2]) == 0).all()     # untouched slots
+
+
+@pytest.mark.parametrize("shift", [False, True])
+def test_int8_kv_decode_close_to_f32(shift):
+    """Cached decode with the int8 KV cache tracks the f32-cache decode
+    within quantization noise (the int8 path halves cache-read bandwidth —
+    the dominant cost of batched decode). shift=True also exercises f32
+    activations against the bf16 token-shift ring buffers that ride along
+    an int8 cache (writes cast to the buffer dtype)."""
+    model, params, x = make(depth=2, shift_tokens=shift)
+    full = decode_all(model, params, x, prefill_len=TEXT + 1)
+
+    n = x.shape[1]
+    cache = model.apply(params, 2, n, jnp.int8,
+                        method=Transformer.init_cache)
+    y0, cache = model.apply(params, x[:, :TEXT + 1], cache,
+                            method=Transformer.prefill)
+    outs = [y0]
+    for t in range(TEXT + 1, n):
+        y, cache = model.apply(params, x[:, t:t + 1], cache, jnp.int32(t),
+                               method=Transformer.decode_step)
+        outs.append(y)
+    inc8 = jnp.concatenate(outs, axis=1)
+    err = float(jnp.max(jnp.abs(inc8 - full)))
+    # int8 KV noise ~1e-2 on N(0,1) activations; the bf16 shift buffers add
+    # bf16 rounding (~8e-3 relative) on the shifted channels
+    assert err < 0.08, err
+
+
 def test_decode_matches_full_with_image_prime():
     """Prefill that already includes image tokens (priming path) must agree —
     this is where the reference's shift-cache prefill is subtly wrong."""
