@@ -418,6 +418,11 @@ class TrainConfig(ConfigBase):
     preflight_checkpoint: bool = True    # ref: legacy/train_dalle.py:591-594
     sample_every_steps: int = 0
     profile_step: int = 0                # >0 → dump a jax.profiler trace + MFU report
+    # >1: run k optimizer steps per device dispatch (lax.scan over stacked
+    # microbatches — trainers' train_steps). Amortizes per-dispatch host
+    # overhead; host-side events (metrics fetch, NaN check, checkpointing)
+    # then happen at k-step granularity
+    scan_steps: int = 1
     # upload each saved checkpoint as a wandb artifact through the metrics
     # writer (ref legacy/train_dalle.py:584-587,667-669); no-op without wandb
     log_artifacts: bool = False

@@ -80,31 +80,73 @@ class BaseTrainer:
             metrics.update(rep)
         return metrics
 
+    def _stack_batches(self, batches, k: int):
+        """Group the batch stream into (stacked?, batch) pairs: full groups
+        of k become stacked tuples for ``train_steps``; a final short group
+        is yielded as plain single batches for ``train_step`` (which is
+        already compiled — a (1, ...) stack would force one extra minutes-
+        long compile of the scan program just to drain the tail)."""
+        import itertools
+        it = iter(batches)
+        while True:
+            group = list(itertools.islice(it, k))
+            if not group:
+                return
+            if len(group) < k:
+                for b in group:
+                    yield False, b
+                return
+            yield True, tuple(np.stack(xs) for xs in zip(*group))
+
     def fit(self, batches, *, steps: Optional[int] = None, log=print,
             sample_fn: Optional[Callable[[int], None]] = None,
             metrics_writer=None):
         """Epoch-agnostic loop over ``batches`` (iterable of tuples fed to
-        ``train_step``) with the reference's parity behaviors."""
+        ``train_step``) with the reference's parity behaviors.
+
+        With ``train_cfg.scan_steps > 1`` full groups of k consecutive
+        batches run through ``train_steps`` (k optimizer steps per device
+        dispatch; the tail drains through ``train_step``); host-side events
+        — metrics fetch, NaN check/rollback, checkpoint/log/sample cadence —
+        then happen at k-step granularity. Cadences use boundary *crossing*
+        (prev//N != cur//N), so a k that does not divide N stretches an
+        event by at most k-1 steps, never to lcm(k, N); a NaN rollback
+        rewinds the whole k-step group to the last good snapshot."""
         tc = self.train_cfg
+        scan_k = max(getattr(tc, "scan_steps", 1), 1)
+        if scan_k > 1:
+            assert hasattr(self, "train_steps"), (
+                f"{type(self).__name__} has no train_steps; scan_steps needs "
+                "the scanned multi-step API")
+            batches = self._stack_batches(batches, scan_k)
+        else:
+            batches = ((False, b) for b in batches)
         meta = self._meta()
         if tc.preflight_checkpoint:
             self.ckpt.preflight(self.state, meta)
         self._snapshot_good()
-        for batch in batches:
-            # profile the REAL next step at profile_step — no hidden extra
-            # update (the reference's flops profile also wraps a live step,
-            # legacy/train_dalle.py:492-499)
-            if tc.profile_step and self._host_step + 1 == tc.profile_step:
+
+        def crossed(prev, cur, every):
+            return every > 0 and prev // every != cur // every
+
+        for stacked, batch in batches:
+            step_call = self.train_steps if stacked else self.train_step
+            k_this = batch[0].shape[0] if stacked else 1
+            prev_step = self._host_step
+            # profile the REAL step containing profile_step — no hidden
+            # extra update (the reference's flops profile also wraps a live
+            # step, legacy/train_dalle.py:492-499)
+            if tc.profile_step and prev_step < tc.profile_step <= prev_step + k_this:
                 logdir = f"{tc.checkpoint_dir}/profile_step{tc.profile_step}"
                 with jax.profiler.trace(logdir):
-                    m = self.train_step(*batch)
+                    m = step_call(*batch)
                 log(f"[profile] step {self._host_step}: trace → {logdir}")
             else:
-                m = self.train_step(*batch)
+                m = step_call(*batch)
             step_num = self._host_step
             # latch the signal flag ONCE per iteration; a save decision must
             # see the same value the metrics-fetch decision does
-            want_save = (step_num % tc.save_every_steps == 0 or
+            want_save = (crossed(prev_step, step_num, tc.save_every_steps) or
                          getattr(self, "_signal_save", False))
             if not m and want_save:
                 m = self._fetch_pending_metrics()
@@ -113,7 +155,7 @@ class BaseTrainer:
                 log(f"[step {step_num}] NaN loss — rolling back to last good state")
                 self._rollback()
             else:
-                if m and step_num % tc.log_every == 0:
+                if m and crossed(prev_step, step_num, tc.log_every):
                     log(f"[step {step_num}] " +
                         " ".join(f"{k}={v:.5g}" for k, v in m.items()))
                 if m and metrics_writer is not None:
@@ -134,8 +176,8 @@ class BaseTrainer:
                             os.path.join(tc.checkpoint_dir, str(step_num)),
                             name=f"trained-{self.model_class.lower()}",
                             metadata={"step": step_num})
-                if getattr(tc, "sample_every_steps", 0) and sample_fn and \
-                        step_num % tc.sample_every_steps == 0:
+                if sample_fn and crossed(prev_step, step_num,
+                                         getattr(tc, "sample_every_steps", 0)):
                     sample_fn(step_num)
             # the steps budget must bound the loop even when steps go NaN
             if steps is not None and step_num >= steps:

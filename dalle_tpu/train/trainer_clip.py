@@ -24,21 +24,30 @@ from .train_state import (TrainState, cast_floating, compute_dtype,
                           make_optimizer)
 
 
-def make_clip_train_step(model: CLIP, dtype=None):
-    """Returns step(state, text, images) -> (state, metrics)."""
-
+def _clip_step_body(model: CLIP, dtype=None):
     def loss_fn(params, text, images):
         x = images if dtype is None else images.astype(dtype)
         return model.apply(cast_floating(params, dtype), text, x,
                            return_loss=True)
 
-    @partial(jax.jit, donate_argnums=(0,))
     def step(state: TrainState, text, images):
         loss, grads = jax.value_and_grad(loss_fn)(state.params, text, images)
         state = state.apply_gradients(grads, value=loss)
         return state, {"loss": loss, "grad_norm": optax.global_norm(grads)}
 
     return step
+
+
+def make_clip_train_step(model: CLIP, dtype=None):
+    """Returns step(state, text, images) -> (state, metrics)."""
+    return partial(jax.jit, donate_argnums=(0,))(_clip_step_body(model, dtype))
+
+
+def make_clip_train_multi_step(model: CLIP, dtype=None):
+    """k steps per dispatch over stacked (texts, imagess) —
+    train_state.make_scanned_steps over the identical step body."""
+    from .train_state import make_scanned_steps
+    return make_scanned_steps(_clip_step_body(model, dtype))
 
 
 class CLIPTrainer(BaseTrainer):
@@ -55,6 +64,7 @@ class CLIPTrainer(BaseTrainer):
                                        tx=tx)
         self.step_fn = make_clip_train_step(
             self.model, dtype=compute_dtype(train_cfg.precision))
+        self._multi_step_fn = None   # built lazily on first train_steps()
         n = count_params(self.state.params)
         self.num_params = n
         tokens_per_sample = (model_cfg.text_seq_len +
@@ -71,6 +81,24 @@ class CLIPTrainer(BaseTrainer):
         text = shard_batch(self.mesh, np.asarray(text, np.int32))
         images = shard_batch(self.mesh, np.asarray(images, np.float32))
         self.state, metrics = self.step_fn(self.state, text, images)
+        return self._finish_step(metrics)
+
+    def train_steps(self, texts: np.ndarray, imagess: np.ndarray):
+        """(k, b, ...) stacked microbatches → k steps in one dispatched scan
+        (identical math to k single dispatches — the step is rng-free)."""
+        assert texts.ndim == 3 and imagess.ndim == 5, (
+            "train_steps wants stacked (k, b, seq) / (k, b, H, W, C)")
+        if self._multi_step_fn is None:
+            self._multi_step_fn = make_clip_train_multi_step(
+                self.model, dtype=compute_dtype(self.train_cfg.precision))
+        from ..parallel import shard_stacked_batch
+        texts = shard_stacked_batch(self.mesh, np.asarray(texts, np.int32))
+        imagess = shard_stacked_batch(self.mesh,
+                                      np.asarray(imagess, np.float32))
+        k = texts.shape[0]
+        self.state, metrics = self._multi_step_fn(self.state,
+                                                  (texts, imagess))
+        self._host_step += k - 1     # _finish_step adds the final +1
         return self._finish_step(metrics)
 
     def similarity(self, text: np.ndarray, images: np.ndarray):

@@ -38,11 +38,7 @@ def anneal_temperature(cfg: AnnealConfig, global_step: int) -> float:
                cfg.temp_min)
 
 
-def make_vae_train_step(model: DiscreteVAE, dtype=None):
-    """Returns step(state, images, key, temp) -> (state, metrics). jit-once;
-    the state is donated so params/moments update in place in HBM. ``dtype``
-    selects the compute precision (params cast per-step; masters stay f32)."""
-
+def _vae_step_body(model: DiscreteVAE, dtype=None):
     def loss_fn(params, images, key, temp):
         if dtype is not None:
             images = images.astype(dtype)
@@ -51,7 +47,6 @@ def make_vae_train_step(model: DiscreteVAE, dtype=None):
             return_recons=True, rngs={"gumbel": key})
         return loss, recons
 
-    @partial(jax.jit, donate_argnums=(0,))
     def step(state: TrainState, images, key, temp):
         (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, images, key, temp)
@@ -59,6 +54,21 @@ def make_vae_train_step(model: DiscreteVAE, dtype=None):
         return state, {"loss": loss, "grad_norm": optax.global_norm(grads)}
 
     return step
+
+
+def make_vae_train_step(model: DiscreteVAE, dtype=None):
+    """Returns step(state, images, key, temp) -> (state, metrics). jit-once;
+    the state is donated so params/moments update in place in HBM. ``dtype``
+    selects the compute precision (params cast per-step; masters stay f32)."""
+    return partial(jax.jit, donate_argnums=(0,))(_vae_step_body(model, dtype))
+
+
+def make_vae_train_multi_step(model: DiscreteVAE, dtype=None):
+    """k steps per dispatch (train_state.make_scanned_steps) over stacked
+    (images, keys, temps) — the identical step body, so with matching key and
+    temperature streams the result equals k single dispatches."""
+    from .train_state import make_scanned_steps
+    return make_scanned_steps(_vae_step_body(model, dtype))
 
 
 @partial(jax.jit, static_argnums=1)
@@ -85,6 +95,7 @@ class VAETrainer(BaseTrainer):
                                        tx=tx)
         self.step_fn = make_vae_train_step(
             self.model, dtype=compute_dtype(train_cfg.precision))
+        self._multi_step_fn = None   # built lazily on first train_steps()
 
         n = count_params(self.state.params)
         self.meter = ThroughputMeter(train_cfg.batch_size, train_cfg.log_every,
@@ -103,6 +114,35 @@ class VAETrainer(BaseTrainer):
         metrics = self._finish_step(metrics)
         if metrics:   # empty when metrics_every skips the host sync this step
             metrics["temperature"] = temp
+        return metrics
+
+    # -- k steps in one device program ---------------------------------------
+    def train_steps(self, images: np.ndarray, _labels=None):
+        """(k, b, H, W, C) stacked microbatches → k optimizer steps in one
+        dispatched scan. Key and temperature streams match ``train_step``
+        exactly (precomputed per host step and scanned as inputs), so the
+        result is identical to k single dispatches. ``_labels`` (stacked
+        captions from the (images, captions) loaders) is ignored, mirroring
+        ``train_step``."""
+        assert images.ndim == 5, "train_steps wants stacked (k, b, H, W, C)"
+        if self._multi_step_fn is None:
+            self._multi_step_fn = make_vae_train_multi_step(
+                self.model, dtype=compute_dtype(self.train_cfg.precision))
+        k = images.shape[0]
+        steps = self._host_step + np.arange(k)
+        keys = jnp.stack([jax.random.fold_in(self.base_key, int(s))
+                          for s in steps])
+        temps = jnp.asarray([anneal_temperature(self.anneal_cfg, int(s))
+                             for s in steps], jnp.float32)
+        from ..parallel import shard_stacked_batch
+        images = shard_stacked_batch(self.mesh,
+                                     np.asarray(images, np.float32))
+        self.state, metrics = self._multi_step_fn(
+            self.state, (images, keys, temps))
+        self._host_step += k - 1     # _finish_step adds the final +1
+        metrics = self._finish_step(metrics)
+        if metrics:
+            metrics["temperature"] = float(temps[-1])
         return metrics
 
     # -- eval utilities ----------------------------------------------------

@@ -95,9 +95,11 @@ class GANTrainState:
 
 def make_vqgan_train_step(model: VQModel, disc: NLayerDiscriminator,
                           lpips: Optional[LPIPS], loss_cfg: GANLossConfig,
-                          dtype=None):
+                          dtype=None, scanned: bool = False):
     """Returns step(state, images, key, temp) -> (state, metrics) implementing
-    both optimizer updates of vqperceptual.py:76-136 in one XLA program."""
+    both optimizer updates of vqperceptual.py:76-136 in one XLA program.
+    ``scanned``: lift the same body into a k-steps-per-dispatch program over
+    stacked (imagess, keys, temps) (train_state.make_scanned_steps)."""
     lc = loss_cfg
     d_loss_fn = hinge_d_loss if lc.disc_loss == "hinge" else vanilla_d_loss
 
@@ -157,7 +159,6 @@ def make_vqgan_train_step(model: VQModel, disc: NLayerDiscriminator,
                "logits_fake": jnp.mean(logits_fake)}
         return d_loss, aux
 
-    @partial(jax.jit, donate_argnums=(0,))
     def step(state: GANTrainState, images, key, temp):
         gen_p, disc_p, lpips_p = (state.params["gen"], state.params["disc"],
                                   state.params["lpips"])
@@ -187,11 +188,14 @@ def make_vqgan_train_step(model: VQModel, disc: NLayerDiscriminator,
                    "logits_fake": d_aux["logits_fake"]}
         return state, metrics
 
-    return step
+    if scanned:
+        from .train_state import make_scanned_steps
+        return make_scanned_steps(step)
+    return partial(jax.jit, donate_argnums=(0,))(step)
 
 
 def make_vq_simple_train_step(model: VQModel, loss_cfg: GANLossConfig,
-                              mode: str, dtype=None):
+                              mode: str, dtype=None, scanned: bool = False):
     """Single-optimizer VQ variants (taming vqgan.py:159-258):
     ``nodisc`` — L1 recon + codebook loss (VQNoDiscModel);
     ``segmentation`` — BCE over label-map logits + codebook loss
@@ -213,14 +217,16 @@ def make_vq_simple_train_step(model: VQModel, loss_cfg: GANLossConfig,
         return rec + lc.codebook_weight * qloss, {"nll_loss": rec,
                                                   "quant_loss": qloss}
 
-    @partial(jax.jit, donate_argnums=(0,))
     def step(state: TrainState, images, targets, key, temp):
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, images, targets, key, temp)
         state = state.apply_gradients(grads, value=loss)
         return state, {"loss": loss, **aux}
 
-    return step
+    if scanned:
+        from .train_state import make_scanned_steps
+        return make_scanned_steps(step)
+    return partial(jax.jit, donate_argnums=(0,))(step)
 
 
 class VQGANTrainer(BaseTrainer):
@@ -333,6 +339,44 @@ class VQGANTrainer(BaseTrainer):
         metrics = self._finish_step(metrics)
         if metrics and self.temp_scheduler is not None:
             metrics["temperature"] = temp
+        return metrics
+
+    # -- k steps in one device program ---------------------------------------
+    def train_steps(self, images: np.ndarray, targets=None):
+        """(k, b, H, W, C) stacked microbatches → k steps (both optimizer
+        updates each) in one dispatched scan. Key and temperature streams
+        match ``train_step`` exactly."""
+        assert images.ndim == 5, "train_steps wants stacked (k, b, H, W, C)"
+        if getattr(self, "_multi_step_fn", None) is None:
+            dt = compute_dtype(self.train_cfg.precision)
+            if self.loss_mode == "gan":
+                self._multi_step_fn = make_vqgan_train_step(
+                    self.model, self.disc, self.lpips, self.loss_cfg,
+                    dtype=dt, scanned=True)
+            else:
+                self._multi_step_fn = make_vq_simple_train_step(
+                    self.model, self.loss_cfg, self.loss_mode, dtype=dt,
+                    scanned=True)
+        from ..parallel import shard_stacked_batch
+        k = images.shape[0]
+        steps = self._host_step + np.arange(k)
+        temps = jnp.asarray(
+            [self.temp_scheduler(int(s)) if self.temp_scheduler is not None
+             else 1.0 for s in steps], jnp.float32)
+        keys = jnp.stack([jax.random.fold_in(self.base_key, int(s))
+                          for s in steps])
+        images = shard_stacked_batch(self.mesh, images.astype(np.float32))
+        if self.loss_mode != "gan":
+            t = images if targets is None else shard_stacked_batch(
+                self.mesh, np.asarray(targets, np.float32))
+            xs = (images, t, keys, temps)
+        else:
+            xs = (images, keys, temps)
+        self.state, metrics = self._multi_step_fn(self.state, xs)
+        self._host_step += k - 1     # _finish_step adds the final +1
+        metrics = self._finish_step(metrics)
+        if metrics and self.temp_scheduler is not None:
+            metrics["temperature"] = float(temps[-1])
         return metrics
 
     # -- eval utilities ----------------------------------------------------

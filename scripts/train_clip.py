@@ -51,6 +51,8 @@ def build_parser():
     train.add_argument("--save_every_n_steps", type=int, default=1000)
     train.add_argument("--seed", type=int, default=42)
     train.add_argument("--steps", type=int, default=None)
+    train.add_argument("--scan_steps", type=int, default=1,
+                       help="k optimizer steps per device dispatch")
     train.add_argument("--no_preflight", action="store_true")
 
     from dalle_tpu.parallel import wrap_arg_parser
@@ -93,7 +95,7 @@ def main(argv=None):
         batch_size=args.batch_size, epochs=args.epochs, seed=args.seed,
         checkpoint_dir=args.output_dir,
         save_every_steps=args.save_every_n_steps,
-        preflight_checkpoint=not args.no_preflight,
+        preflight_checkpoint=not args.no_preflight, scan_steps=args.scan_steps,
         optim=OptimConfig(learning_rate=args.learning_rate,
                           grad_clip_norm=args.clip_grad_norm))
 

@@ -84,6 +84,10 @@ def build_parser():
     train.add_argument("--log_artifacts", action="store_true",
                        help="upload each checkpoint as a wandb artifact (ref :667-669)")
     train.add_argument("--steps", type=int, default=None)
+    train.add_argument("--scan_steps", type=int, default=1,
+                       help="k optimizer steps per device dispatch "
+                            "(lax.scan over stacked microbatches; host "
+                            "events move to k-step granularity)")
     train.add_argument("--no_preflight", action="store_true")
     train.add_argument("--flops_profiler", action="store_true",
                        help="profile at step 200 then exit (ref :492-499)")
@@ -139,7 +143,7 @@ def main(argv=None):
         preflight_checkpoint=not args.no_preflight,
         sample_every_steps=args.sample_every_steps,
         profile_step=200 if args.flops_profiler else 0,
-        log_artifacts=args.log_artifacts,
+        log_artifacts=args.log_artifacts, scan_steps=args.scan_steps,
         optim=OptimConfig(learning_rate=args.learning_rate,
                           grad_clip_norm=args.clip_grad_norm,
                           grad_accum_steps=args.ga_steps,

@@ -52,6 +52,8 @@ def build_parser():
     train.add_argument("--seed", type=int, default=42)
     train.add_argument("--steps", type=int, default=None,
                        help="hard stop after N steps (overrides epochs)")
+    train.add_argument("--scan_steps", type=int, default=1,
+                       help="k optimizer steps per device dispatch")
     train.add_argument("--no_preflight", action="store_true")
     train.add_argument("--sample_every_steps", type=int, default=0,
                        help="log recon grids + codebook histogram every N "
@@ -92,7 +94,7 @@ def main(argv=None):
         keep_n_checkpoints=args.keep_n_checkpoints,
         preflight_checkpoint=not args.no_preflight,
         sample_every_steps=args.sample_every_steps,
-        log_artifacts=args.log_artifacts,
+        log_artifacts=args.log_artifacts, scan_steps=args.scan_steps,
         optim=OptimConfig(learning_rate=args.learning_rate,
                           grad_clip_norm=args.clip_grad_norm,
                           lr_scheduler="exponential",

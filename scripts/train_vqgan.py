@@ -62,6 +62,8 @@ def build_parser():
     train.add_argument("--resume", action="store_true")
     train.add_argument("--seed", type=int, default=42)
     train.add_argument("--steps", type=int, default=None)
+    train.add_argument("--scan_steps", type=int, default=1,
+                       help="k optimizer steps per device dispatch")
     train.add_argument("--no_preflight", action="store_true")
     train.add_argument("--sample_every_steps", type=int, default=0,
                        help="write original/recon grids (taming ImageLogger "
@@ -112,7 +114,7 @@ def main(argv=None):
         keep_n_checkpoints=args.keep_n_checkpoints,
         preflight_checkpoint=not args.no_preflight,
         sample_every_steps=args.sample_every_steps,
-        log_artifacts=args.log_artifacts,
+        log_artifacts=args.log_artifacts, scan_steps=args.scan_steps,
         # taming: Adam(lr, betas=(0.5, 0.9)) for both nets (vqgan.py:121-131)
         optim=OptimConfig(learning_rate=lr, beta1=0.5, beta2=0.9,
                           grad_clip_norm=0.0))
