@@ -64,6 +64,18 @@ class NLayerDiscriminator(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = True):
+        # below 3*2^n, the stride-2 stack reaches <= 2 and the two stride-1
+        # kernel-4/pad-1 convs produce an EMPTY 0x0 map whose mean is
+        # silently NaN (poisoning the whole GAN step) — surface the
+        # misconfiguration instead. At exactly [3*2^n, 4*2^n) the output is
+        # a single 1x1 logit: valid, just not a patch map.
+        min_res = 3 * 2 ** self.n_layers
+        if x.shape[1] < min_res or x.shape[2] < min_res:
+            raise ValueError(
+                f"NLayerDiscriminator(n_layers={self.n_layers}) needs inputs "
+                f">= {min_res}x{min_res}; got {x.shape[1]}x{x.shape[2]} — "
+                "reduce disc_num_layers for small images")
+
         def norm(name):
             if self.use_actnorm:
                 return ActNorm(name=name)

@@ -77,6 +77,14 @@ class TestDiscriminator:
         assert out.shape[0] == 2 and out.shape[-1] == 1
         assert out.shape[1] > 1  # a patch map, not a single logit
 
+    def test_patchgan_rejects_collapsing_resolution(self):
+        """16x16 through n_layers=3 collapses to a 0x0 conv_out map whose
+        mean is silently NaN (poisons the whole GAN step) — must raise."""
+        disc = NLayerDiscriminator(ndf=8, n_layers=3)
+        x = jnp.ones((2, 16, 16, 3))
+        with pytest.raises(ValueError, match="reduce disc_num_layers"):
+            disc.init(jax.random.PRNGKey(0), x, train=True)
+
     def test_actnorm_data_dependent_init(self):
         x = jax.random.normal(jax.random.PRNGKey(0), (8, 4, 4, 3)) * 5 + 2
         an = ActNorm()
