@@ -421,7 +421,9 @@ class TrainConfig(ConfigBase):
     # >1: run k optimizer steps per device dispatch (lax.scan over stacked
     # microbatches — trainers' train_steps). Amortizes per-dispatch host
     # overhead; host-side events (metrics fetch, NaN check, checkpointing)
-    # then happen at k-step granularity
+    # then happen at k-step granularity. Note: a NaN rollback rewinds the
+    # whole k-step group, so larger k widens the rollback blast radius
+    # (up to k batches of progress lost per rollback vs 1 at k=1)
     scan_steps: int = 1
     # upload each saved checkpoint as a wandb artifact through the metrics
     # writer (ref legacy/train_dalle.py:584-587,667-669); no-op without wandb

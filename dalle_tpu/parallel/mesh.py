@@ -67,7 +67,14 @@ def shard_batch(mesh: Mesh, batch, leading_replicated: int = 0):
     lead = (None,) * leading_replicated
 
     def put(x):
-        pspec = P(*lead, *(spec + (None,) * (x.ndim - 1 - leading_replicated)))
+        if x.ndim <= leading_replicated:
+            # per-step scalar/key leaves of a (k, ...) stack have no batch
+            # axis to shard — replicate them instead of building a spec with
+            # more axes than the array has
+            pspec = P()
+        else:
+            pspec = P(*lead,
+                      *(spec + (None,) * (x.ndim - 1 - leading_replicated)))
         return jax.device_put(x, NamedSharding(mesh, pspec))
 
     return jax.tree.map(put, batch)

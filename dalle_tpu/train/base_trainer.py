@@ -80,22 +80,49 @@ class BaseTrainer:
             metrics.update(rep)
         return metrics
 
+    def _step_keys(self, k: int):
+        """The exact per-step rng stream ``train_step`` would draw for the
+        next k host steps — fold_in(base_key, host_step + i) — stacked for
+        scanning. Single source of the scan/single rng-parity invariant
+        (every trainer's ``train_steps`` must consume THIS stream)."""
+        import jax.numpy as jnp
+        return jnp.stack([jax.random.fold_in(self.base_key,
+                                             self._host_step + i)
+                          for i in range(k)])
+
     def _stack_batches(self, batches, k: int):
         """Group the batch stream into (stacked?, batch) pairs: full groups
         of k become stacked tuples for ``train_steps``; a final short group
         is yielded as plain single batches for ``train_step`` (which is
         already compiled — a (1, ...) stack would force one extra minutes-
-        long compile of the scan program just to drain the tail)."""
+        long compile of the scan program just to drain the tail). A group
+        whose members disagree in shape (short batch mid-stream from
+        drop_last=False loaders or webdataset ``batched(partial=True)``)
+        also falls back to singles instead of crashing np.stack (warned
+        once: if every group is ragged, scan_steps is effectively off)."""
         import itertools
+        import warnings
         it = iter(batches)
+        warned = False
         while True:
             group = list(itertools.islice(it, k))
             if not group:
                 return
-            if len(group) < k:
+            homogeneous = all(
+                np.shape(x) == np.shape(group[0][j])
+                for b in group for j, x in enumerate(b))
+            if len(group) < k or not homogeneous:
+                if not homogeneous and not warned:
+                    warnings.warn(
+                        "scan_steps: batch group has mismatched shapes; "
+                        "draining it as single steps (a loader with varying "
+                        "batch shapes disables the scanned fast path)")
+                    warned = True
                 for b in group:
                     yield False, b
-                return
+                if len(group) < k:
+                    return
+                continue
             yield True, tuple(np.stack(xs) for xs in zip(*group))
 
     def fit(self, batches, *, steps: Optional[int] = None, log=print,

@@ -77,25 +77,25 @@ def make_dalle_train_multi_step(model: DALLE, *, null_cond_prob: float = 0.0,
     body consuming a (k, b, ...) microbatch stack. Per-dispatch host overhead
     (20ms-class through remote-device tunnels) amortizes over k steps, and
     the k-1 interior state handoffs never touch the host — the TPU analogue
-    of a captured CUDA graph replay. Math per step is identical to
-    ``make_dalle_train_step`` (same loss/grad/update body; per-step rng =
-    fold_in(call key, step index))."""
+    of a captured CUDA graph replay. Math per step is BIT-identical to
+    ``make_dalle_train_step``: the caller precomputes the exact single-step
+    key stream (fold_in(base_key, host_step + i)) and it is scanned as an
+    input, so toggling scan_steps never changes the rng trajectory even with
+    null_cond_prob > 0 or dropout (same pattern as trainer_vae.train_steps)."""
     loss_fn = _make_dalle_loss_fn(model, null_cond_prob=null_cond_prob,
                                   use_dropout=use_dropout, dtype=dtype)
 
     @partial(jax.jit, donate_argnums=(0,))
-    def steps(state: TrainState, texts, image_ids, key):
+    def steps(state: TrainState, texts, image_ids, keys):
         def body(state, xs):
-            text, ids, i = xs
+            text, ids, key = xs
             (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                state.params, text, ids, jax.random.fold_in(key, i))
+                state.params, text, ids, key)
             new_state = state.apply_gradients(grads, value=loss)
             return new_state, {"loss": loss,
                                "grad_norm": optax.global_norm(grads), **aux}
 
-        k = texts.shape[0]
-        state, ms = jax.lax.scan(body, state,
-                                 (texts, image_ids, jnp.arange(k)))
+        state, ms = jax.lax.scan(body, state, (texts, image_ids, keys))
         metrics = jax.tree.map(lambda x: x[-1], ms)   # last step's metrics
         metrics["loss_mean"] = jnp.mean(ms["loss"])
         return state, metrics
@@ -168,12 +168,12 @@ class DalleTrainer(BaseTrainer):
         if self._multi_step_fn is None:
             self._multi_step_fn = make_dalle_train_multi_step(
                 self.model, **self._multi_step_kw)
-        key = jax.random.fold_in(self.base_key, self._host_step)
+        k = texts.shape[0]
+        keys = self._step_keys(k)
         texts = shard_stacked_batch(self.mesh, np.asarray(texts, np.int32))
         image_ids = shard_stacked_batch(self.mesh,
                                         np.asarray(image_ids, np.int32))
-        k = texts.shape[0]
         self.state, metrics = self._multi_step_fn(self.state, texts,
-                                                  image_ids, key)
+                                                  image_ids, keys)
         self._host_step += k - 1     # _finish_step adds the final +1
         return self._finish_step(metrics)

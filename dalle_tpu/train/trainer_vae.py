@@ -130,8 +130,7 @@ class VAETrainer(BaseTrainer):
                 self.model, dtype=compute_dtype(self.train_cfg.precision))
         k = images.shape[0]
         steps = self._host_step + np.arange(k)
-        keys = jnp.stack([jax.random.fold_in(self.base_key, int(s))
-                          for s in steps])
+        keys = self._step_keys(k)
         temps = jnp.asarray([anneal_temperature(self.anneal_cfg, int(s))
                              for s in steps], jnp.float32)
         from ..parallel import shard_stacked_batch

@@ -363,8 +363,7 @@ class VQGANTrainer(BaseTrainer):
         temps = jnp.asarray(
             [self.temp_scheduler(int(s)) if self.temp_scheduler is not None
              else 1.0 for s in steps], jnp.float32)
-        keys = jnp.stack([jax.random.fold_in(self.base_key, int(s))
-                          for s in steps])
+        keys = self._step_keys(k)
         images = shard_stacked_batch(self.mesh, images.astype(np.float32))
         if self.loss_mode != "gan":
             t = images if targets is None else shard_stacked_batch(

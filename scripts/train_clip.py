@@ -52,7 +52,8 @@ def build_parser():
     train.add_argument("--seed", type=int, default=42)
     train.add_argument("--steps", type=int, default=None)
     train.add_argument("--scan_steps", type=int, default=1,
-                       help="k optimizer steps per device dispatch")
+                       help="k optimizer steps per device dispatch (a NaN "
+                            "rollback rewinds the whole k-step group)")
     train.add_argument("--no_preflight", action="store_true")
 
     from dalle_tpu.parallel import wrap_arg_parser

@@ -87,7 +87,8 @@ def build_parser():
     train.add_argument("--scan_steps", type=int, default=1,
                        help="k optimizer steps per device dispatch "
                             "(lax.scan over stacked microbatches; host "
-                            "events move to k-step granularity)")
+                            "events move to k-step granularity; a NaN "
+                            "rollback rewinds the whole k-step group)")
     train.add_argument("--no_preflight", action="store_true")
     train.add_argument("--flops_profiler", action="store_true",
                        help="profile at step 200 then exit (ref :492-499)")

@@ -63,7 +63,8 @@ def build_parser():
     train.add_argument("--seed", type=int, default=42)
     train.add_argument("--steps", type=int, default=None)
     train.add_argument("--scan_steps", type=int, default=1,
-                       help="k optimizer steps per device dispatch")
+                       help="k optimizer steps per device dispatch (a NaN "
+                            "rollback rewinds the whole k-step group)")
     train.add_argument("--no_preflight", action="store_true")
     train.add_argument("--sample_every_steps", type=int, default=0,
                        help="write original/recon grids (taming ImageLogger "

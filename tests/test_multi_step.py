@@ -122,6 +122,48 @@ def test_fit_with_scan_steps(tmp_path):
         np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6)
 
 
+def test_dalle_train_steps_matches_singles_with_rng(tmp_path):
+    """The advisor-flagged gap: the DALLE scanned path must be bit-identical
+    to k single dispatches in rng modes too (null_cond_prob > 0 + dropout),
+    not just the rng-free config — the per-step keys are precomputed on the
+    host exactly as train_step computes them and scanned as inputs."""
+    from dalle_tpu.config import DalleConfig
+    from dalle_tpu.train.trainer_dalle import DalleTrainer
+
+    cfg = DalleConfig(num_text_tokens=32, text_seq_len=8, dim=32, depth=2,
+                      heads=2, dim_head=16, image_size=16,
+                      image_vocab_size=32, image_fmap_size=4,
+                      attn_dropout=0.1, ff_dropout=0.1)
+    rng = np.random.RandomState(4)
+    texts = rng.randint(1, 32, (3, 8, 8))
+    ids = rng.randint(0, 32, (3, 8, 16))
+
+    tr1 = DalleTrainer(cfg, _tc(tmp_path, "a"), null_cond_prob=0.2)
+    singles = [tr1.train_step(texts[i], ids[i])["loss"] for i in range(3)]
+
+    tr2 = DalleTrainer(cfg, _tc(tmp_path, "b"), null_cond_prob=0.2)
+    m = tr2.train_steps(texts, ids)
+    assert tr2._host_step == 3
+    np.testing.assert_allclose(m["loss"], singles[-1], rtol=1e-6)
+    np.testing.assert_allclose(m["loss_mean"], np.mean(singles), rtol=1e-6)
+    _assert_same_params(tr1.state.params, tr2.state.params)
+
+
+def test_stack_batches_ragged_group_falls_back_to_singles():
+    """A short batch mid-stream (drop_last=False loaders, webdataset
+    batched(partial=True)) must not crash np.stack — the ragged group drains
+    as singles and stacking resumes on the next homogeneous group."""
+    from dalle_tpu.train.base_trainer import BaseTrainer
+
+    full = lambda: (np.zeros((8, 4)), np.zeros((8, 2)))
+    short = lambda: (np.zeros((5, 4)), np.zeros((5, 2)))
+    stream = [full(), short(), full(), full(), full()]
+    out = list(BaseTrainer._stack_batches(None, iter(stream), 2))
+    # group 1 (full, short) is ragged → 2 singles; group 2 stacks; tail single
+    assert [s for s, _ in out] == [False, False, True, False]
+    assert out[2][1][0].shape == (2, 8, 4)
+
+
 def test_clip_train_steps_matches_singles(tmp_path):
     from dalle_tpu.train.trainer_clip import CLIPTrainer
 
