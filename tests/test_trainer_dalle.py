@@ -112,7 +112,18 @@ def test_graft_entry_compiles():
     assert out.shape == ()
 
 
-def test_graft_dryrun_multichip():
+def test_graft_dryrun_multichip(monkeypatch):
+    # the DCN throughput smoke (two extra subprocess fleets) runs in the
+    # slow-tier variant below and in the driver's own dryrun invocation
+    monkeypatch.setenv("GRAFT_DRYRUN_SKIP_DCN", "1")
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+    import __graft_entry__ as ge
+    ge.dryrun_multichip(8)
+
+
+@pytest.mark.slow
+def test_graft_dryrun_multichip_full(monkeypatch):
+    monkeypatch.delenv("GRAFT_DRYRUN_SKIP_DCN", raising=False)
     sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
     import __graft_entry__ as ge
     ge.dryrun_multichip(8)
