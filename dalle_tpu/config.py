@@ -234,6 +234,11 @@ class TransformerConfig(ConfigBase):
     sparse_attn_kernel: int = 5          # conv_like unfold kernel
     sparse_block_size: int = 128         # block-sparse tile (TPU lane-adapted; ref uses 16)
     sparse_num_random_blocks: int = 0    # 0 → seq_len // block // 4 like the reference
+    # base seed for 'sparse' random-block patterns; each sparse layer draws
+    # its own pattern from seed + layer_index (DeepSpeed
+    # VariableSparsityConfig parity — per-layer variation, not one shared
+    # pattern)
+    sparse_mask_seed: int = 0
     reversible: bool = False
     use_remat: bool = True               # jax.checkpoint over blocks
     stable: bool = False                 # stable softmax + DivideMax
@@ -285,6 +290,7 @@ class DalleConfig(ConfigBase):
     attn_softmax_f32: bool = True
     sparse_block_size: int = 128
     sparse_attn_kernel: int = 5
+    sparse_mask_seed: int = 0   # per-layer patterns: seed + layer_index
     # filled from the vae at model build time
     image_size: int = 128
     image_vocab_size: int = 8192   # vae num_tokens
@@ -315,6 +321,7 @@ class DalleConfig(ConfigBase):
             shared_ff_ids=self.shared_ff_ids, use_pallas=self.use_pallas,
             attn_softmax_f32=self.attn_softmax_f32,
             sparse_block_size=self.sparse_block_size, sparse_attn_kernel=self.sparse_attn_kernel,
+            sparse_mask_seed=self.sparse_mask_seed,
         )
 
 

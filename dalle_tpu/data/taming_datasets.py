@@ -211,6 +211,120 @@ class SFLCKR(SegmentationPairs):
         super().__init__(images_root, masks_root, size, n_labels)
 
 
+# --------------------------------------------------------------------------
+# prepare helpers: build the expected directory trees from ALREADY-DOWNLOADED
+# archives — the no-network half of the reference's download/untar machinery
+# (imagenet.py:134-242 _prepare; bdu.is_prepared/mark_prepared ".ready" flag).
+# The network half (academictorrents / heibox fetches) is deliberately out of
+# scope for a TPU-pod data root.
+# --------------------------------------------------------------------------
+
+_READY = ".ready"
+
+
+def _extract_tar(archive, dest) -> None:
+    """extractall with the safe 'data' filter where available (3.12+ /
+    late 3.10/3.11 backports); older interpreters in our >=3.10 range lack
+    the kwarg."""
+    import tarfile
+
+    with tarfile.open(archive, "r:*") as tar:
+        try:
+            tar.extractall(path=dest, filter="data")
+        except TypeError:
+            tar.extractall(path=dest)
+
+
+def is_prepared(root) -> bool:
+    """taming.data.utils.is_prepared equivalent: the ``.ready`` flag file."""
+    return (Path(root) / _READY).exists()
+
+
+def mark_prepared(root) -> None:
+    Path(root).mkdir(parents=True, exist_ok=True)
+    (Path(root) / _READY).touch()
+
+
+def _write_filelist(root: Path, datadir: Path) -> int:
+    """filelist.txt of sorted datadir-relative JPEG paths
+    (imagenet.py:168-173)."""
+    files = sorted(str(p.relative_to(datadir))
+                   for p in datadir.rglob("*")
+                   if p.suffix.upper() == ".JPEG")
+    (root / "filelist.txt").write_text("\n".join(files) + "\n")
+    return len(files)
+
+
+def prepare_imagenet_train(archive: str, root: str) -> int:
+    """ILSVRC2012_img_train.tar (a tar of per-synset sub-tars) → the
+    ``root/data/nXXXXXXXX/*.JPEG`` tree ImageNetTrain reads + filelist.txt +
+    ``.ready`` (imagenet.py:134-176 minus the torrent fetch). Returns the
+    image count. Idempotent: a prepared root is left untouched."""
+    root_p = Path(root)
+    if is_prepared(root_p):
+        return sum(1 for _ in open(root_p / "filelist.txt"))
+    datadir = root_p / "data"
+    datadir.mkdir(parents=True, exist_ok=True)
+    _extract_tar(archive, datadir)
+    for subpath in sorted(datadir.glob("*.tar")):
+        subdir = datadir / subpath.stem          # nXXXXXXXX.tar → nXXXXXXXX/
+        subdir.mkdir(exist_ok=True)
+        _extract_tar(subpath, subdir)
+        subpath.unlink()
+    n = _write_filelist(root_p, datadir)
+    mark_prepared(root_p)
+    return n
+
+
+def prepare_imagenet_validation(archive: str, synset_map: str,
+                                root: str) -> int:
+    """ILSVRC2012_img_val.tar (flat JPEGs) + validation_synset.txt
+    ("<file> <synset>" lines) → synset-foldered ``root/data`` + filelist.txt
+    + ``.ready`` (imagenet.py:192-242 minus the two downloads)."""
+    import shutil
+
+    root_p = Path(root)
+    if is_prepared(root_p):
+        return sum(1 for _ in open(root_p / "filelist.txt"))
+    datadir = root_p / "data"
+    datadir.mkdir(parents=True, exist_ok=True)
+    _extract_tar(archive, datadir)
+    synset_dict = dict(line.split()
+                       for line in Path(synset_map).read_text().splitlines()
+                       if line.strip())
+    for s in sorted(set(synset_dict.values())):
+        (datadir / s).mkdir(exist_ok=True)
+    for fname, synset in synset_dict.items():
+        src = datadir / fname
+        if src.exists():
+            shutil.move(str(src), str(datadir / synset / fname))
+    n = _write_filelist(root_p, datadir)
+    mark_prepared(root_p)
+    return n
+
+
+def prepare_coco(root: str, images_zip: Optional[str] = None,
+                 annotations_zip: Optional[str] = None,
+                 stuffthingmaps_zip: Optional[str] = None) -> None:
+    """Unpack already-downloaded COCO zips (train2017/val2017 images,
+    annotations_trainval2017, stuffthingmaps) into the taming layout
+    (coco.py CocoImagesAndCaptionsTrain/Examples expect
+    ``root/{train2017,val2017,annotations,stuffthingmaps}``). Pass any subset;
+    each zip's internal paths already carry the right prefixes. Idempotent:
+    a prepared root is left untouched."""
+    import zipfile
+
+    root_p = Path(root)
+    if is_prepared(root_p):
+        return
+    root_p.mkdir(parents=True, exist_ok=True)
+    for z in (images_zip, annotations_zip, stuffthingmaps_zip):
+        if z:
+            with zipfile.ZipFile(z) as zf:
+                zf.extractall(root_p)
+    mark_prepared(root_p)
+
+
 class FacesHQ:
     """CelebAHQ + FFHQ concatenated (taming/data/faceshq.py FacesHQTrain):
     two file lists with a ``class`` flag distinguishing the sources."""

@@ -33,6 +33,7 @@ import numpy as np
 import optax
 
 from ..config import DalleConfig
+from ..ops.quantize_weights import QDense
 from ..ops.sampling import gumbel_sample, prob_mask_like, top_k_filter
 from .transformer import DivideMax, Transformer
 
@@ -91,7 +92,7 @@ class DALLE(nn.Module):
         else:
             self.text_emb = nn.Embed(self.num_text_tokens, c.dim, name="text_emb")
             self.image_emb = nn.Embed(c.image_vocab_size, c.dim, name="image_emb")
-            self.head = nn.Dense(self.total_tokens, name="to_logits")
+            self.head = QDense(self.total_tokens, name="to_logits")
 
         if not c.rotary_emb:
             self.text_pos_emb = nn.Embed(c.text_seq_len + 1, c.dim,
@@ -113,20 +114,36 @@ class DALLE(nn.Module):
         self.logits_allow = jnp.asarray(~forbidden)
 
     # -- embedding helpers -------------------------------------------------
+    def _shared_rows(self, ids):
+        """Gather from the tied table; int8 tables (decode weight quant,
+        ops/quantize_weights.py) dequantize per gathered row — only the int8
+        bytes cross HBM."""
+        tab = self.shared_emb
+        rows = jnp.take(tab, ids, axis=0)
+        if tab.dtype == jnp.int8:
+            scale = self.get_variable("quant", "shared_emb_scale")
+            dt = self.logits_bias.dtype
+            rows = rows.astype(dt) * jnp.take(scale, ids, axis=0).astype(dt)
+        return rows
+
     def _embed_text_ids(self, ids):
         if self.cfg.share_input_output_emb:
-            return jnp.take(self.shared_emb, ids, axis=0)
+            return self._shared_rows(ids)
         return self.text_emb(ids)
 
     def _embed_image_ids(self, ids):
         if self.cfg.share_input_output_emb:
-            return jnp.take(self.shared_emb, ids + self.num_text_tokens, axis=0)
+            return self._shared_rows(ids + self.num_text_tokens)
         return self.image_emb(ids)
 
     def _logits(self, x):
         x = self.final_norm(x)
         if self.cfg.share_input_output_emb:
-            return x @ self.shared_emb.T + self.logits_bias
+            tab = self.shared_emb
+            if tab.dtype == jnp.int8:
+                scale = self.get_variable("quant", "shared_emb_scale")
+                tab = tab.astype(x.dtype) * scale.astype(x.dtype)
+            return x @ tab.T + self.logits_bias
         return self.head(x)
 
     def remap_and_bos(self, text):
@@ -257,13 +274,16 @@ class DALLE(nn.Module):
     def generate_images_tokens(self, text, key, *, filter_thres: float = 0.5,
                                temperature: float = 1.0, cond_scale: float = 1.0,
                                image_prime: Optional[jnp.ndarray] = None,
-                               cache_dtype=jnp.float32):
+                               cache_dtype=jnp.float32,
+                               topk_approx: bool = False):
         """AR-sample the full image token sequence. Returns (b, image_seq_len)
         int32 codebook ids. ``text`` must be (b, text_seq_len).
         ``cache_dtype=bf16`` halves the KV-cache traffic of the decode loop;
         ``cache_dtype=jnp.int8`` halves it again via per-position symmetric
         quantization (ops/attention.KVCache — sampling itself always runs on
-        f32 logits).
+        f32 logits). ``topk_approx`` swaps the exact per-step top-k sort for
+        TPU's approximate top-k unit (ops/sampling.top_k_filter) — the sort
+        is ~17% of decode wall time at batch 64.
         (reference generate_images :490-557 minus vae decode/CLIP, which live in
         DalleWithVae)"""
         c = self.cfg
@@ -282,7 +302,8 @@ class DALLE(nn.Module):
 
         def sample_from(logits, k):
             band = logits[:, self.num_text_tokens:]  # image band only
-            filtered = top_k_filter(band, thres=filter_thres)
+            filtered = top_k_filter(band, thres=filter_thres,
+                                    approx=topk_approx)
             return gumbel_sample(k, filtered, temperature=temperature).astype(jnp.int32)
 
         def body(carry, i):
@@ -302,7 +323,8 @@ class DALLE(nn.Module):
         init = (logits, cache, null_cache if use_cfg else jnp.zeros(()), key)
         (last_logits, *_), toks = nn.scan(
             lambda m, carry, i: body(carry, i),
-            variable_broadcast="params", split_rngs={"params": False},
+            variable_broadcast=("params", "quant"),
+            split_rngs={"params": False},
             length=n_steps - 1)(self, init, jnp.arange(n_steps - 1))
         # final token sampled from the last logits (no decode needed after it)
         final = sample_from(last_logits, jax.random.fold_in(key, n_steps))
@@ -352,7 +374,8 @@ class DALLE(nn.Module):
         n_new = c.text_seq_len - start
         (last_logits, *_), toks = nn.scan(
             lambda m, carry, i: body(carry, i),
-            variable_broadcast="params", split_rngs={"params": False},
+            variable_broadcast=("params", "quant"),
+            split_rngs={"params": False},
             length=n_new - 1)(self, (logits, cache, key), jnp.arange(n_new - 1))
         final = sample_text(last_logits, jax.random.fold_in(key, n_new))
         toks = jnp.moveaxis(toks, 0, 1)

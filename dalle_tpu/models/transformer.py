@@ -36,6 +36,7 @@ import numpy as np
 from ..config import TransformerConfig
 from ..ops.attention import KVCache, attend, cached_attend
 from ..ops.attn_masks import build_mask
+from ..ops.quantize_weights import QDense
 from ..ops.rotary import apply_rotary, dalle_pos_emb
 
 
@@ -43,7 +44,7 @@ def _block_body(mdl, x, key_mask, ind: int, deterministic: bool):
     """One attn+ff residual pair — module-first so ``nn.remat`` can lift it
     (flax replays dropout rngs inside the recompute automatically, replacing
     the reference's manual RNG save/restore, reversible.py:20-50)."""
-    t = mdl.layer_types[ind]
+    t = mdl.mask_keys[ind]
     x = x + mdl.attn_layers[ind](x, key_mask=key_mask, rotary=mdl.rotary,
                                  np_mask=mdl.np_masks[t],
                                  mask_spec=mdl.mask_specs[t],
@@ -78,8 +79,10 @@ class GEGLUFeedForward(nn.Module):
     dropout: float = 0.0
 
     def setup(self):
-        self.w1 = nn.Dense(self.dim * self.mult * 2, name="w1")
-        self.w2 = nn.Dense(self.dim, name="w2")
+        # QDense ≡ nn.Dense until handed an int8 kernel (decode weight
+        # quantization, ops/quantize_weights.py)
+        self.w1 = QDense(self.dim * self.mult * 2, name="w1")
+        self.w2 = QDense(self.dim, name="w2")
         self.drop = nn.Dropout(self.dropout)
 
     def __call__(self, x, deterministic: bool = True):
@@ -115,8 +118,8 @@ class Attention(nn.Module):
 
     def setup(self):
         inner = self.heads * self.dim_head
-        self.to_qkv = nn.Dense(inner * 3, use_bias=False, name="to_qkv")
-        self.to_out = nn.Dense(self.dim, name="to_out")
+        self.to_qkv = QDense(inner * 3, use_bias=False, name="to_qkv")
+        self.to_out = QDense(self.dim, name="to_out")
         self.drop = nn.Dropout(self.dropout)
 
     def _split(self, qkv, n):
@@ -379,37 +382,45 @@ class Transformer(nn.Module):
 
         # static masks (None for 'full' — plain causal handled in attend);
         # kept as NUMPY (the pallas path needs host-side masks for block-list
-        # construction; the dense path converts per-trace, folded by XLA)
+        # construction; the dense path converts per-trace, folded by XLA).
+        # Deterministic mask types share one entry per type; 'sparse' gets a
+        # per-LAYER entry with seed = sparse_mask_seed + layer_index, so each
+        # sparse layer draws its own random-block pattern (DeepSpeed
+        # VariableSparsityConfig parity — one shared pattern would silently
+        # narrow the reference semantics)
+        mask_keys = [f"sparse_{ind}" if t == "sparse" else t
+                     for ind, t in enumerate(type_per_layer)]
         masks: Dict[str, Optional[np.ndarray]] = {}
-        for t in set(type_per_layer):
-            if t == "full" or not c.causal:
-                masks[t] = None
-            else:
-                masks[t] = build_mask(
-                    t, self.text_len, fmap, kernel_size=c.sparse_attn_kernel,
-                    block=c.sparse_block_size,
-                    num_random_blocks=c.sparse_num_random_blocks)
-        self.np_masks = masks
-        # structured-mask specs: the pallas kernels compute axial/conv
-        # element visibility from iotas instead of loading a mask table
-        # (ops/flash_attention.py elem_fn_from_spec)
         specs: Dict[str, Optional[tuple]] = {}
-        for t in set(type_per_layer):
-            if not c.causal or masks.get(t) is None:
-                specs[t] = None
-            elif t in ("axial_row", "axial_col"):
-                specs[t] = ("axial", self.text_len, fmap,
-                            0 if t == "axial_row" else 1)
+        for ind, (mk, t) in enumerate(zip(mask_keys, type_per_layer)):
+            if mk in masks:
+                continue
+            if t == "full" or not c.causal:
+                masks[mk], specs[mk] = None, None
+                continue
+            masks[mk] = build_mask(
+                t, self.text_len, fmap, kernel_size=c.sparse_attn_kernel,
+                block=c.sparse_block_size,
+                num_random_blocks=c.sparse_num_random_blocks,
+                seed=c.sparse_mask_seed + ind)
+            # structured-mask specs: the pallas kernels compute axial/conv
+            # element visibility from iotas instead of loading a mask table
+            # (ops/flash_attention.py elem_fn_from_spec)
+            if t in ("axial_row", "axial_col"):
+                specs[mk] = ("axial", self.text_len, fmap,
+                             0 if t == "axial_row" else 1)
             elif t == "conv_like":
-                specs[t] = ("conv", self.text_len, fmap,
-                            c.sparse_attn_kernel, 1)
+                specs[mk] = ("conv", self.text_len, fmap,
+                             c.sparse_attn_kernel, 1)
             elif t == "sparse":
                 # block-aligned random-block pattern: kernel tiles coincide
                 # with the pattern's block grid, no element mask needed
-                specs[t] = ("block", c.sparse_block_size)
+                specs[mk] = ("block", c.sparse_block_size)
             else:
-                specs[t] = None
+                specs[mk] = None
+        self.np_masks = masks
         self.mask_specs = specs
+        self.mask_keys = mask_keys
 
         shared_attn: Dict[Any, Tuple[Attention, str]] = {}
         shared_ff: Dict[Any, GEGLUFeedForward] = {}
@@ -536,7 +547,7 @@ class Transformer(nn.Module):
 
     def _apply_attn_layer(self, h, ind: int, key_mask=None,
                           deterministic: bool = True):
-        t = self.layer_types[ind]
+        t = self.mask_keys[ind]
         return self.attn_layers[ind](h, key_mask=key_mask, rotary=self.rotary,
                                      np_mask=self.np_masks[t],
                                      mask_spec=self.mask_specs[t],
@@ -570,7 +581,7 @@ class Transformer(nn.Module):
         c = self.cfg
         cache = dict(cache)
         for ind in range(c.depth):
-            attn_l, ff_l, t = self.attn_layers[ind], self.ff_layers[ind], self.layer_types[ind]
+            attn_l, ff_l, t = self.attn_layers[ind], self.ff_layers[ind], self.mask_keys[ind]
             y, kv, ss = attn_l.prefill(x, cache[f"kv_{ind}"],
                                        cache.get(f"shift_attn_{ind}"),
                                        rotary=self.rotary,
@@ -592,7 +603,7 @@ class Transformer(nn.Module):
         c = self.cfg
         cache = dict(cache)
         for ind in range(c.depth):
-            attn_l, ff_l, t = self.attn_layers[ind], self.ff_layers[ind], self.layer_types[ind]
+            attn_l, ff_l, t = self.attn_layers[ind], self.ff_layers[ind], self.mask_keys[ind]
             y, kv, ss = attn_l.decode(x_t, cache[f"kv_{ind}"],
                                       cache.get(f"shift_attn_{ind}"), offset,
                                       rotary=self.rotary,

@@ -97,7 +97,8 @@ class DalleWithVae:
                         img: Optional[jnp.ndarray] = None,
                         num_init_img_tokens: Optional[int] = None,
                         clip: Optional[tuple] = None,
-                        precision: str = "float32"):
+                        precision: str = "float32",
+                        topk_approx: bool = False):
         """text (b, text_seq_len) → images (b, H, W, C) in [0,1]; optionally
         (images, clip_scores). ``img`` primes the first 43.75% of image tokens
         (reference :510-519, OpenAI's 14/32 rows). ``precision="bfloat16"``
@@ -105,8 +106,14 @@ class DalleWithVae:
         bandwidth-bound on both, so this roughly halves latency;
         ``precision="bf16_int8kv"`` additionally quantizes the KV cache to
         int8 with per-position scales (1.44x faster again at batch 64 on
-        v5e, quantization noise well under sampling temperature); sampling
-        stays on f32 logits in every mode."""
+        v5e, quantization noise well under sampling temperature);
+        ``precision="int8w"`` further stores every matmul kernel (and the
+        tied table) as int8 with per-channel scales, halving decode weight
+        traffic (ops/quantize_weights.py). ``topk_approx`` swaps the exact
+        per-step top-k sort for TPU's approximate top-k unit
+        (ops/sampling.top_k_filter). Sampling stays on f32 logits in every
+        mode; token-exact accuracy on a trained model is validated per mode
+        by scripts/eval_decode_precisions.py."""
         prime = None
         if img is not None:
             n_prime = num_init_img_tokens
@@ -115,31 +122,41 @@ class DalleWithVae:
             assert n_prime < self.model.cfg.image_seq_len
             prime = self.vae.get_codebook_indices(img)[:, :n_prime]
         if precision not in ("float32", "f32", "bfloat16", "bf16",
-                             "bf16_int8kv"):
+                             "bf16_int8kv", "int8w"):
             # a typo would otherwise fall through to the ~3x-slower f32 path
             # with no signal that the requested fast mode never engaged
             raise ValueError(f"unknown precision {precision!r}; expected "
-                             "float32 | bfloat16 | bf16_int8kv")
+                             "float32 | bfloat16 | bf16_int8kv | int8w")
         params, cache_dtype = self.params, jnp.float32
-        if precision in ("bfloat16", "bf16", "bf16_int8kv"):
-            # cast once and cache — re-casting the full tree per call would
-            # serialize GBs of casts ahead of every batch's decode loop. The
-            # cache keeps the source tree object and compares identity, so a
-            # checkpoint reload / EMA swap on the same wrapper recasts instead
-            # of reusing stale weights
-            cached = getattr(self, "_bf16_params", None)
-            if cached is None or cached[0] is not self.params:
-                from ..train.train_state import cast_floating
-                object.__setattr__(self, "_bf16_params",
-                                   (self.params,
-                                    cast_floating(self.params, jnp.bfloat16)))
-            params = self._bf16_params[1]
-            cache_dtype = (jnp.int8 if precision == "bf16_int8kv"
+        if precision in ("bfloat16", "bf16", "bf16_int8kv", "int8w"):
+            # cast/quantize once and cache — re-transforming the full tree
+            # per call would serialize GBs of work ahead of every batch's
+            # decode loop. The cache keys on (source tree identity, mode), so
+            # a checkpoint reload / EMA swap on the same wrapper re-derives
+            # instead of reusing stale weights
+            mode = "int8w" if precision == "int8w" else "bf16"
+            cache = getattr(self, "_fast_params", None)
+            if cache is None or cache[0] is not self.params:
+                # source tree changed (checkpoint reload / EMA swap): drop
+                # every derived mode
+                cache = (self.params, {})
+                object.__setattr__(self, "_fast_params", cache)
+            if mode not in cache[1]:
+                if mode == "int8w":
+                    # int8 matmul kernels + int8 shared table, everything
+                    # else bf16 (ops/quantize_weights.py)
+                    from ..ops.quantize_weights import quantize_params_int8
+                    cache[1][mode] = quantize_params_int8(self.params)
+                else:
+                    from ..train.train_state import cast_floating
+                    cache[1][mode] = cast_floating(self.params, jnp.bfloat16)
+            params = cache[1][mode]
+            cache_dtype = (jnp.int8 if precision in ("bf16_int8kv", "int8w")
                            else jnp.bfloat16)
         ids = self.model.apply(
             params, text, key, filter_thres=filter_thres,
             temperature=temperature, cond_scale=cond_scale, image_prime=prime,
-            cache_dtype=cache_dtype,
+            cache_dtype=cache_dtype, topk_approx=topk_approx,
             method=DALLE.generate_images_tokens)
         images = self.vae.decode(ids)
         if clip is not None:

@@ -1,0 +1,138 @@
+"""int8 weight quantization for the decode fast path.
+
+Single-token decode is bandwidth-bound on *weights* (each step streams every
+matmul kernel from HBM for one token's worth of FLOPs), so storing kernels as
+int8 with per-output-channel scales halves the dominant HBM traffic — the
+TPU-native analogue of the CUDA int8 inference kernels the torch ecosystem
+reaches for. XLA fuses the int8→bf16 convert + scale multiply into the
+matmul's operand load, so no separate dequant pass ever materializes.
+
+Design: quantized weights live in the SAME params tree (the int8 array
+replaces the float kernel leaf — flax only validates structure, not dtype)
+and the per-channel scales ride a separate ``quant`` variable collection
+mirroring the module paths. Training, checkpoints, and every float apply are
+untouched: ``QDense`` behaves exactly like ``nn.Dense`` (same param names,
+shapes, init streams, dtype promotion) until it sees an int8 kernel.
+
+Symmetric per-output-channel quantization: scale_j = max_i |W_ij| / 127,
+Q_ij = round(W_ij / scale_j). No zero points — weights are near-centered and
+symmetric quant keeps the dequant a single fused multiply.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from flax.linen.dtypes import promote_dtype
+
+
+class QDense(nn.Module):
+    """Drop-in ``nn.Dense`` (same param names/shapes/init/promotion) that
+    dequantizes on the fly when its kernel arrives as int8 with a
+    ``quant/kernel_scale`` companion (see ``quantize_params_int8``)."""
+
+    features: int
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (jnp.shape(x)[-1], self.features))
+        bias = (self.param("bias", nn.initializers.zeros, (self.features,))
+                if self.use_bias else None)
+        dims = (((x.ndim - 1,), (0,)), ((), ()))
+        if kernel.dtype == jnp.int8:
+            if not self.has_variable("quant", "kernel_scale"):
+                raise ValueError(
+                    f"{self.name}: int8 kernel without a 'quant' collection "
+                    "— quantize with quantize_params_int8 and pass its "
+                    "variables dict to apply()")
+            scale = self.get_variable("quant", "kernel_scale")
+            # convert+scale fuse into the matmul operand load; only the int8
+            # bytes cross HBM
+            kernel = kernel.astype(x.dtype) * scale.astype(x.dtype)
+            y = jax.lax.dot_general(x, kernel, dims)
+            return y if bias is None else y + bias.astype(y.dtype)
+        x, kernel, bias = promote_dtype(x, kernel, bias, dtype=None)
+        y = jax.lax.dot_general(x, kernel, dims)
+        return y if bias is None else y + bias
+
+
+def quantize_kernel_int8(w, axis: int = 0):
+    """(int8 q, f32 scale broadcastable against q): symmetric per-channel
+    over ``axis`` (the contraction axis — scales attach to the outputs)."""
+    w = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(w), axis=axis, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _is_quantizable(path: tuple, leaf) -> bool:
+    return (path and path[-1] == "kernel" and hasattr(leaf, "ndim")
+            and leaf.ndim == 2 and jnp.issubdtype(leaf.dtype, jnp.floating))
+
+
+def quantize_params_int8(variables: dict,
+                         select: Optional[Callable[[tuple], bool]] = None,
+                         compute_dtype=jnp.bfloat16) -> dict:
+    """Variables dict → variables dict with selected 2-D float ``kernel``
+    leaves replaced by int8 + a mirrored ``quant`` collection of scales.
+    Non-kernel float leaves are cast to ``compute_dtype`` (the usual decode
+    policy). ``select`` filters by path tuple (default: every 2-D kernel —
+    only modules built on :class:`QDense` can consume the result; plain
+    ``nn.Dense`` kernels must be excluded by the caller's ``select``).
+
+    Also quantizes a DALLE ``shared_emb`` table (per-row scales serve both
+    the embedding gather and the tied logits matmul — models/dalle.py)."""
+    import flax
+
+    params = flax.core.unfreeze(variables["params"])
+    quant: dict = {}
+
+    def copy_tree(d):
+        # fresh dict spine (unfreeze of a plain dict is shallow — mutating
+        # it in place would alias the caller's live params tree)
+        return {k: copy_tree(v) if isinstance(v, dict) else v
+                for k, v in d.items()}
+
+    new_params = copy_tree(params)
+
+    def set_in(tree, path, value):
+        for k in path[:-1]:
+            tree = tree[k]
+        tree[path[-1]] = value
+
+    def insert_scale(dirs: tuple, name: str, value):
+        tree = quant
+        for k in dirs:
+            tree = tree.setdefault(k, {})
+        tree[name] = value
+
+    for keypath, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        path = tuple(getattr(k, "key", getattr(k, "idx", None))
+                     for k in keypath)
+        if _is_quantizable(path, leaf) and (select is None or select(path)):
+            q, scale = quantize_kernel_int8(leaf, axis=0)
+            set_in(new_params, path, q)
+            insert_scale(path[:-1], "kernel_scale", scale)
+        elif path and path[-1] == "shared_emb" and (select is None
+                                                    or select(path)):
+            # per-row scales: rows are output channels of the tied logits
+            # matmul (x @ W.T) AND the gathered embedding vectors
+            q, scale = quantize_kernel_int8(leaf, axis=1)
+            set_in(new_params, path, q)
+            insert_scale(path[:-1], "shared_emb_scale", scale)
+        elif (hasattr(leaf, "dtype")
+              and jnp.issubdtype(leaf.dtype, jnp.floating)
+              and compute_dtype is not None):
+            set_in(new_params, path, leaf.astype(compute_dtype))
+
+    out = dict(variables)
+    out["params"] = new_params
+    if quant:
+        out["quant"] = quant
+    return out

@@ -215,22 +215,30 @@ def bench_generation(batch=64, reps=3):
     import jax.numpy as jnp
     from dalle_tpu.config import DalleConfig
     from dalle_tpu.models.dalle import DALLE, init_dalle
+    from dalle_tpu.ops.quantize_weights import quantize_params_int8
     from dalle_tpu.train.train_state import cast_floating
 
     cfg = DalleConfig(**SMALL)
     model, params = init_dalle(cfg, jax.random.PRNGKey(0))
     text = np.zeros((batch, cfg.text_seq_len), np.int32)
     text[:, :4] = 7
+    bf16 = cast_floating(params, jnp.bfloat16)
 
-    for precision in ("float32", "bfloat16", "bf16_int8kv"):
-        p = params if precision == "float32" else cast_floating(params, jnp.bfloat16)
+    for precision in ("float32", "bfloat16", "bf16_int8kv", "int8w",
+                      "int8kv_fast_topk"):
+        p = {"float32": params, "bfloat16": bf16, "bf16_int8kv": bf16,
+             "int8w": None, "int8kv_fast_topk": bf16}[precision]
+        if p is None:
+            p = quantize_params_int8(params)   # int8 kernels, bf16 elsewhere
         cache_dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
-                       "bf16_int8kv": jnp.int8}[precision]
+                       "bf16_int8kv": jnp.int8, "int8w": jnp.int8,
+                       "int8kv_fast_topk": jnp.int8}[precision]
+        approx = precision == "int8kv_fast_topk"
 
         @jax.jit
         def gen(p, text, key):
             return model.apply(p, text, key, filter_thres=0.9,
-                               cache_dtype=cache_dtype,
+                               cache_dtype=cache_dtype, topk_approx=approx,
                                method=DALLE.generate_images_tokens)
 
         ids = gen(p, text, jax.random.PRNGKey(0))

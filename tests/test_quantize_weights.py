@@ -1,0 +1,127 @@
+"""int8 decode weight quantization (ops/quantize_weights.py): QDense must be
+bit-identical to nn.Dense in float mode, dequantized matmuls must track the
+float results within per-channel quant noise, and the wrapper precision modes
+must produce valid samples from the same trained params tree."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_tpu.ops.quantize_weights import (QDense, quantize_kernel_int8,
+                                            quantize_params_int8)
+
+
+def test_qdense_matches_nn_dense_exactly():
+    """Same param names, shapes, init stream, and float math — swapping
+    nn.Dense for QDense must not change any existing model or checkpoint."""
+    x = jnp.asarray(np.random.RandomState(0).rand(4, 16), jnp.float32)
+    for use_bias in (True, False):
+        a = nn.Dense(8, use_bias=use_bias)
+        b = QDense(8, use_bias=use_bias)
+        va = a.init(jax.random.PRNGKey(7), x)
+        vb = b.init(jax.random.PRNGKey(7), x)
+        for (ka, la), (kb, lb) in zip(
+                sorted(jax.tree_util.tree_flatten_with_path(va)[0],
+                       key=str),
+                sorted(jax.tree_util.tree_flatten_with_path(vb)[0],
+                       key=str)):
+            assert str(ka) == str(kb)
+            np.testing.assert_array_equal(la, lb)
+        np.testing.assert_array_equal(a.apply(va, x), b.apply(vb, x))
+
+
+def test_quantize_kernel_roundtrip_error_bounded():
+    rng = np.random.RandomState(1)
+    w = jnp.asarray(rng.standard_normal((64, 32)) * 0.1, jnp.float32)
+    q, scale = quantize_kernel_int8(w, axis=0)
+    assert q.dtype == jnp.int8 and scale.shape == (1, 32)
+    deq = q.astype(jnp.float32) * scale
+    # symmetric per-channel int8: error ≤ scale/2 per element
+    assert float(jnp.max(jnp.abs(deq - w) / scale)) <= 0.5 + 1e-6
+
+
+def test_qdense_int8_close_to_float():
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.rand(4, 32), jnp.float32)
+    m = QDense(16)
+    v = m.init(jax.random.PRNGKey(0), x)
+    out_f = m.apply(v, x)
+    qv = quantize_params_int8(v, compute_dtype=None)
+    out_q = m.apply(qv, x)
+    # relative error bounded by int8 resolution over the contraction
+    err = float(jnp.max(jnp.abs(out_f - out_q)))
+    ref = float(jnp.max(jnp.abs(out_f)))
+    assert err < 0.02 * max(ref, 1.0), (err, ref)
+
+
+def test_quantize_params_does_not_mutate_source():
+    x = jnp.ones((2, 8))
+    m = QDense(4)
+    v = m.init(jax.random.PRNGKey(0), x)
+    before = np.asarray(v["params"]["kernel"]).copy()
+    qv = quantize_params_int8(v)
+    assert qv["params"]["kernel"].dtype == jnp.int8
+    np.testing.assert_array_equal(v["params"]["kernel"], before)
+    assert v["params"]["kernel"].dtype == jnp.float32
+
+
+def test_qdense_int8_without_scales_raises():
+    x = jnp.ones((2, 8))
+    m = QDense(4)
+    v = m.init(jax.random.PRNGKey(0), x)
+    v2 = {"params": {"kernel": jnp.zeros((8, 4), jnp.int8),
+                     "bias": v["params"]["bias"]}}
+    with pytest.raises(ValueError, match="quant"):
+        m.apply(v2, x)
+
+
+@pytest.mark.parametrize("share", [False, True])
+def test_dalle_int8w_decode_runs(share):
+    """End-to-end: quantized variables drive the full cached decode loop
+    (prefill + nn.scan) in both head modes (tied table / Dense head)."""
+    from dalle_tpu.config import DalleConfig
+    from dalle_tpu.models.dalle import DALLE, init_dalle
+
+    cfg = DalleConfig(num_text_tokens=32, text_seq_len=8, dim=32, depth=2,
+                      heads=2, dim_head=16, image_size=16,
+                      image_vocab_size=32, image_fmap_size=4,
+                      share_input_output_emb=share)
+    model, params = init_dalle(cfg, jax.random.PRNGKey(0))
+    text = jnp.asarray(np.random.RandomState(0).randint(1, 32, (2, 8)))
+    qv = quantize_params_int8(params)
+    assert "quant" in qv
+    ids = model.apply(qv, text, jax.random.PRNGKey(1), filter_thres=0.9,
+                      cache_dtype=jnp.int8,
+                      method=DALLE.generate_images_tokens)
+    assert ids.shape == (2, 16) and ids.dtype == jnp.int32
+    assert bool((ids >= 0).all()) and bool((ids < 32).all())
+
+
+def test_wrapper_int8w_precision_mode():
+    from dalle_tpu.config import DalleConfig, DVAEConfig
+    from dalle_tpu.models.dvae import init_dvae
+    from dalle_tpu.models.dalle import init_dalle
+    from dalle_tpu.models.wrapper import DalleWithVae, DiscreteVAEAdapter
+
+    vcfg = DVAEConfig(image_size=16, num_tokens=32, codebook_dim=16,
+                      num_layers=2, hidden_dim=8, num_resnet_blocks=0)
+    vmodel, vparams = init_dvae(vcfg, jax.random.PRNGKey(0))
+    vae = DiscreteVAEAdapter(vmodel, vparams)
+    dcfg = DalleConfig(num_text_tokens=32, text_seq_len=8, dim=32, depth=2,
+                      heads=2, dim_head=16, image_size=16,
+                      image_vocab_size=32, image_fmap_size=4)
+    model, params = init_dalle(dcfg, jax.random.PRNGKey(1))
+    dv = DalleWithVae(model, params, vae)
+    text = jnp.asarray(np.random.RandomState(0).randint(1, 32, (2, 8)))
+    out = dv.generate_images(text, jax.random.PRNGKey(2), precision="int8w")
+    assert out.shape == (2, 16, 16, 3) and bool(jnp.isfinite(out).all())
+    # per-mode cache: alternating modes must not re-derive either tree
+    out2 = dv.generate_images(text, jax.random.PRNGKey(2), precision="bf16",
+                              topk_approx=True)
+    assert set(dv._fast_params[1]) == {"int8w", "bf16"}
+    tree_int8w = dv._fast_params[1]["int8w"]
+    dv.generate_images(text, jax.random.PRNGKey(2), precision="int8w")
+    assert dv._fast_params[1]["int8w"] is tree_int8w
+    assert out2.shape == (2, 16, 16, 3)

@@ -111,3 +111,102 @@ def test_numpy_paths_dtype_conventions(tmp_path):
         if ref is None:
             ref = img
         assert np.abs(img - ref).max() < 0.02, f"{name} diverges from uint8"
+
+
+# -- prepare helpers (the no-network half of imagenet.py:134-242) ------------
+
+def _tiny_jpeg(path, seed=0):
+    from PIL import Image
+    rng = np.random.RandomState(seed)
+    Image.fromarray(rng.randint(0, 255, (20, 20, 3), np.uint8)).save(
+        path, format="JPEG")
+
+
+def test_prepare_imagenet_train_builds_synset_tree(tmp_path):
+    import tarfile
+    from dalle_tpu.data.taming_datasets import (ImageNetTrain, is_prepared,
+                                                prepare_imagenet_train)
+
+    # archive of per-synset sub-tars, like ILSVRC2012_img_train.tar
+    work = tmp_path / "work"
+    for si, syn in enumerate(("n01440764", "n01443537")):
+        d = work / syn
+        d.mkdir(parents=True)
+        for i in range(2):
+            _tiny_jpeg(d / f"{syn}_{i}.JPEG", seed=si * 10 + i)
+    archive = tmp_path / "train.tar"
+    with tarfile.open(archive, "w") as tar:
+        for syn in ("n01440764", "n01443537"):
+            sub = tmp_path / f"{syn}.tar"
+            with tarfile.open(sub, "w") as st:
+                for p in sorted((work / syn).iterdir()):
+                    st.add(p, arcname=p.name)
+            tar.add(sub, arcname=f"{syn}.tar")
+
+    root = tmp_path / "prepared"
+    n = prepare_imagenet_train(str(archive), str(root))
+    assert n == 4 and is_prepared(root)
+    files = (root / "filelist.txt").read_text().splitlines()
+    assert len(files) == 4 and files == sorted(files)
+    assert not list((root / "data").glob("*.tar"))   # sub-tars cleaned up
+    ds = ImageNetTrain(str(root / "data"), size=16)
+    assert len(ds) == 4
+    item = ds[0]
+    assert item["image"].shape == (16, 16, 3)
+    assert item["synset"] == "n01440764" and item["class_label"] == 0
+    # idempotent: second call must not re-extract
+    assert prepare_imagenet_train(str(archive), str(root)) == 4
+
+
+def test_prepare_imagenet_validation_reorganizes_by_synset(tmp_path):
+    import tarfile
+    from dalle_tpu.data.taming_datasets import (ImageNetValidation,
+                                                prepare_imagenet_validation)
+
+    flat = tmp_path / "flat"
+    flat.mkdir()
+    names = [f"ILSVRC2012_val_0000000{i}.JPEG" for i in range(1, 5)]
+    for i, nm in enumerate(names):
+        _tiny_jpeg(flat / nm, seed=i)
+    archive = tmp_path / "val.tar"
+    with tarfile.open(archive, "w") as tar:
+        for nm in names:
+            tar.add(flat / nm, arcname=nm)
+    synmap = tmp_path / "validation_synset.txt"
+    synmap.write_text("\n".join(
+        f"{nm} {'n01440764' if i % 2 == 0 else 'n01443537'}"
+        for i, nm in enumerate(names)) + "\n")
+
+    root = tmp_path / "prepared"
+    n = prepare_imagenet_validation(str(archive), str(synmap), str(root))
+    assert n == 4
+    ds = ImageNetValidation(str(root / "data"), size=16)
+    assert len(ds) == 4
+    assert {it["synset"] for it in (ds[i] for i in range(4))} == {
+        "n01440764", "n01443537"}
+
+
+def test_prepare_coco_layout(tmp_path):
+    import json as _json
+    import zipfile
+    from dalle_tpu.data.taming_datasets import CocoCaptions, prepare_coco
+
+    img_zip = tmp_path / "train2017.zip"
+    with zipfile.ZipFile(img_zip, "w") as zf:
+        p = tmp_path / "im.jpg"
+        _tiny_jpeg(p)
+        zf.write(p, "train2017/000000000001.jpg")
+    ann = {"images": [{"id": 1, "file_name": "000000000001.jpg"}],
+           "annotations": [{"image_id": 1, "caption": "a tiny test image"}]}
+    ann_zip = tmp_path / "annotations.zip"
+    with zipfile.ZipFile(ann_zip, "w") as zf:
+        zf.writestr("annotations/captions_train2017.json", _json.dumps(ann))
+
+    root = tmp_path / "coco"
+    prepare_coco(str(root), images_zip=str(img_zip),
+                 annotations_zip=str(ann_zip))
+    ds = CocoCaptions(str(root / "train2017"),
+                      str(root / "annotations/captions_train2017.json"),
+                      size=16)
+    assert len(ds) == 1
+    assert ds[0]["caption"] == "a tiny test image"

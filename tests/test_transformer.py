@@ -167,3 +167,23 @@ def test_sparse_variant_runs():
     model, params, x = make(depth=1, attn_types=("sparse",))
     out = model.apply(params, x)
     assert out.shape == x.shape
+
+
+def test_sparse_layers_draw_distinct_patterns():
+    """DeepSpeed VariableSparsityConfig parity: each 'sparse' layer gets its
+    own random-block pattern (seed = sparse_mask_seed + layer index), not one
+    shared table; deterministic types still share one mask per type."""
+    # block 4 over seq 24 → a 6x6 block grid with 2 random blocks per row:
+    # the default 128-block would cover this tiny seq with one all-True
+    # block and no randomness to vary
+    kw = dict(attn_types=("sparse", "axial_row"), sparse_block_size=4,
+              sparse_num_random_blocks=2)
+    model, params, x = make(depth=4, **kw)
+    bound = model.bind(params)
+    assert list(bound.mask_keys) == ["sparse_0", "axial_row",
+                                     "sparse_2", "axial_row"]
+    m0, m2 = bound.np_masks["sparse_0"], bound.np_masks["sparse_2"]
+    assert (m0 != m2).any()
+    # same base seed → reproducible patterns
+    model2, params2, _ = make(depth=4, **kw)
+    assert (model2.bind(params2).np_masks["sparse_0"] == m0).all()
