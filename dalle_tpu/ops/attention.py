@@ -16,8 +16,9 @@ TPU notes:
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional
+from typing import Optional
 
+import flax.struct
 import jax
 import jax.numpy as jnp
 
@@ -87,65 +88,109 @@ def _quantize_int8(x):
     return q.astype(jnp.int8), scale
 
 
-class KVCache(NamedTuple):
+@flax.struct.dataclass
+class KVCache:
     """Preallocated decode cache for one attention layer.
 
-    ``dtype=jnp.int8`` stores quantized keys/values with per-(b, h, position)
-    f32 scales — halving the cache-read bandwidth that dominates batched
-    decode (the dequant multiply fuses into the attention matmul's operand
-    load). f32/bf16 dtypes store exactly.
+    Storage is ONE merged buffer (b, max_seq, 2*h*d) — sequence-major with
+    K in the first h*d lanes and V in the rest — so the Pallas decode kernel
+    (ops/decode_attention.py) streams a single contiguous block per batch
+    row, and each decoded position appends with a single
+    dynamic-update-slice (separate K/V buffers measured 2x the per-step
+    update cost in the b64 decode profile). ``read_kv`` presents the
+    conventional (b, h, S, d) view for the dense paths.
+
+    ``dtype=jnp.int8`` stores quantized rows with per-(b, h, position) f32
+    scales in a merged (b, 2h, max_seq) array (K scales rows 0..h) —
+    halving the cache-read bandwidth that dominates batched decode.
+    f32/bf16 dtypes store exactly.
     """
-    k: jnp.ndarray       # (b, h, max_seq, d) — storage dtype
-    v: jnp.ndarray       # (b, h, max_seq, d)
-    k_scale: Optional[jnp.ndarray] = None   # (b, h, max_seq, 1) f32; int8 only
-    v_scale: Optional[jnp.ndarray] = None
+    kv: jnp.ndarray      # (b, max_seq, 2*h*d) — storage dtype
+    scale: Optional[jnp.ndarray] = None   # (b, 2h, max_seq) f32; int8 only
+    heads: int = flax.struct.field(pytree_node=False, default=1)
 
     @classmethod
     def init(cls, batch: int, heads: int, max_seq: int, dim_head: int,
              dtype=jnp.float32) -> "KVCache":
-        z = jnp.zeros((batch, heads, max_seq, dim_head), dtype=dtype)
+        z = jnp.zeros((batch, max_seq, 2 * heads * dim_head), dtype=dtype)
         if dtype == jnp.int8:
-            s = jnp.zeros((batch, heads, max_seq, 1), jnp.float32)
-            return cls(z, z, s, s)
-        return cls(z, z)
+            s = jnp.zeros((batch, 2 * heads, max_seq), jnp.float32)
+            return cls(z, s, heads=heads)
+        return cls(z, heads=heads)
+
+    @staticmethod
+    def _flatten(x):
+        """(b,h,n,d) → (b,n,h*d) rows."""
+        b, h, n, d = x.shape
+        return x.transpose(0, 2, 1, 3).reshape(b, n, h * d)
 
     def append(self, k_new: jnp.ndarray, v_new: jnp.ndarray, offset) -> "KVCache":
         """Write (b,h,n,d) new keys/values at position ``offset`` (scalar)."""
-        if self.k.dtype == jnp.int8:
+        if self.kv.dtype == jnp.int8:
             kq, ks = _quantize_int8(k_new)
             vq, vs = _quantize_int8(v_new)
-            at, at_s = (0, 0, offset, 0), (0, 0, offset, 0)
-            return KVCache(
-                jax.lax.dynamic_update_slice(self.k, kq, at),
-                jax.lax.dynamic_update_slice(self.v, vq, at),
-                jax.lax.dynamic_update_slice(self.k_scale, ks, at_s),
-                jax.lax.dynamic_update_slice(self.v_scale, vs, at_s))
-        k = jax.lax.dynamic_update_slice(self.k, k_new.astype(self.k.dtype), (0, 0, offset, 0))
-        v = jax.lax.dynamic_update_slice(self.v, v_new.astype(self.v.dtype), (0, 0, offset, 0))
-        return KVCache(k, v)
+            rows = jnp.concatenate([self._flatten(kq), self._flatten(vq)],
+                                   axis=2)
+            sc = jnp.concatenate([ks[..., 0], vs[..., 0]], axis=1)  # (b,2h,n)
+            return self.replace(
+                kv=jax.lax.dynamic_update_slice(self.kv, rows,
+                                                (0, offset, 0)),
+                scale=jax.lax.dynamic_update_slice(self.scale, sc,
+                                                   (0, 0, offset)))
+        rows = jnp.concatenate(
+            [self._flatten(k_new.astype(self.kv.dtype)),
+             self._flatten(v_new.astype(self.kv.dtype))], axis=2)
+        return self.replace(
+            kv=jax.lax.dynamic_update_slice(self.kv, rows, (0, offset, 0)))
 
     def read_kv(self, dtype=None):
-        """(k, v) ready for attention — dequantized when stored int8.
+        """(k, v) as (b, h, S, d), dequantized when stored int8.
         ``dtype``: compute dtype of the dequantized values (default bf16 for
         int8 storage; pass the query dtype to match the matmul)."""
-        if self.k.dtype == jnp.int8:
+        b, S, hd2 = self.kv.shape
+        h = self.heads
+        kv = self.kv.reshape(b, S, 2, h, hd2 // (2 * h))
+        k = kv[:, :, 0].transpose(0, 2, 1, 3)
+        v = kv[:, :, 1].transpose(0, 2, 1, 3)
+        if self.kv.dtype == jnp.int8:
             dt = dtype or jnp.bfloat16
-            return (self.k.astype(dt) * self.k_scale.astype(dt),
-                    self.v.astype(dt) * self.v_scale.astype(dt))
-        return self.k, self.v
+            ks = self.scale[:, :h, :, None]        # (b,h,S,1)
+            vs = self.scale[:, h:, :, None]
+            return (k.astype(dt) * ks.astype(dt),
+                    v.astype(dt) * vs.astype(dt))
+        return k, v
 
 
 def cached_attend(q: jnp.ndarray, cache: KVCache, length, *,
                   static_mask: Optional[jnp.ndarray] = None,
                   stable: bool = False,
                   qpos=None,
-                  scale: Optional[float] = None) -> jnp.ndarray:
+                  scale: Optional[float] = None,
+                  use_kernel: Optional[bool] = None) -> jnp.ndarray:
     """Single-step decode: q is (b,h,1,d); attends to cache[:length].
 
     ``length`` is a traced scalar — the full (b,h,max,d) cache participates in the
     matmul and positions ≥ length are masked, keeping shapes static under scan.
     ``qpos`` (defaults to length-1) indexes the static_mask row.
+
+    On TPU with lane-tiled shapes this runs the Pallas decode kernel
+    (ops/decode_attention.py — XLA's lowering of this op is the decode
+    loop's dominant cost at ~2.3x the HBM roofline); ``use_kernel``
+    overrides the auto-selection.
     """
+    from .decode_attention import decode_attend_kernel, decode_kernel_supported
+    if use_kernel is None:
+        use_kernel = (jax.default_backend() == "tpu"
+                      and decode_kernel_supported(q, cache, stable=stable))
+    if use_kernel:
+        row = None
+        if static_mask is not None:
+            if qpos is None:
+                qpos = length - 1
+            row = jax.lax.dynamic_index_in_dim(static_mask, qpos, axis=0,
+                                               keepdims=False)[: cache.kv.shape[1]]
+        return decode_attend_kernel(q, cache, length, mask_row=row,
+                                    scale=scale, out_dtype=q.dtype)
     if scale is None:
         scale = q.shape[-1] ** -0.5
     q = q * scale

@@ -1,0 +1,161 @@
+"""Pallas single-token decode attention — the hot op of batched generation.
+
+Profiling the b64 DALL·E-small decode loop on v5e (NEXT.md r4) shows XLA's
+lowering of cached attention (dequant-multiply + dot as kLoop fusions over
+the int8 cache) at ~100 us/layer-step against a ~44 us HBM roofline — 67% of
+the whole decode loop. Alternatives measured on-chip before landing here:
+post-scale dequant restructures and int8 MXU dots in XLA (equal or worse),
+a per-(b,h)-program Pallas kernel (3x worse — per-program DMA overhead),
+and per-head in-kernel dots (1.7x worse — M=1 MXU staging). The winning
+shape, ~59 us/iter standalone (74% of roofline):
+
+  * ONE program per batch row over a sequence-major (S, h*d) cache block —
+    a single contiguous DMA per tensor per program.
+  * All heads in ONE MXU dot via a block-diagonal query: Q_bd (h, h*d) has
+    q_h in diagonal block h, so Q_bd @ K^T computes every head's scores
+    simultaneously; the output side uses the same mask plus a constant
+    (h*d, d) gather matrix to extract each head's diagonal block.
+  * int8 dequant folds into per-(h, S) row scales AFTER the score dot and
+    into the probability rows BEFORE the output dot (exact: scales are
+    constant along the contractions).
+  * validity (j < length) and optional static-mask rows evaluate on an
+    in-kernel iota; softmax is f32 throughout.
+
+Works for int8 (with per-position scales), bf16, and f32 caches. The caller
+(ops/attention.cached_attend) self-selects the kernel on TPU when shapes
+tile (see ``decode_kernel_supported``) and falls back to the dense XLA path
+otherwise — numerics match the dense path within f32 softmax tolerance
+(tests/test_decode_attention.py, interpret mode + on-chip).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e9
+
+# per-program VMEM budget for the K+V blocks (double-buffered by the
+# pipeline; the chip's scoped-vmem ceiling is 16M)
+_VMEM_BUDGET = 6 * 1024 * 1024
+
+
+def _decode_kernel(len_ref, q_ref, kv_ref, sc_ref, row_ref, o_ref, *,
+                   scale, heads):
+    h = heads
+    S = kv_ref.shape[1]
+    hd = kv_ref.shape[2] // 2
+    d = hd // h
+
+    # f32 caches keep exact f32 dot math; int8/bf16 storage computes in bf16
+    # (already at/below storage precision; bandwidth-bound either way)
+    dot_dt = (jnp.float32 if kv_ref.dtype == jnp.float32 else jnp.bfloat16)
+
+    q = q_ref[0].astype(jnp.float32) * scale                   # (h, d)
+    qt = jnp.concatenate([q] * h, axis=1)                      # (h, h*d)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (h, hd), 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, (h, hd), 0)
+    bd = (lane // d) == row                                    # block-diag mask
+    qbd = jnp.where(bd, qt, 0.0).astype(dot_dt)
+
+    k = kv_ref[0, :, :hd].astype(dot_dt)                       # (S, h*d)
+    s = jax.lax.dot_general(qbd, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # (h, S)
+    if sc_ref is not None:
+        s = s * sc_ref[0, :h]                                  # fold K dequant
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (h, S), 1)
+    valid = kpos < len_ref[0]
+    if row_ref is not None:
+        valid &= row_ref[0] != 0
+    s = jnp.where(valid, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.where(valid, jnp.exp(s - m), 0.0)                  # (h, S)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    if sc_ref is not None:
+        p = p * sc_ref[0, h:]                                  # fold V dequant
+
+    v = kv_ref[0, :, hd:].astype(dot_dt)                       # (S, h*d)
+    obd = jax.lax.dot_general(p.astype(dot_dt), v,
+                              (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)  # (h, h*d)
+    gr = jax.lax.broadcasted_iota(jnp.int32, (hd, d), 0)
+    gc = jax.lax.broadcasted_iota(jnp.int32, (hd, d), 1)
+    gather = ((gr % d) == gc).astype(jnp.float32)              # (h*d, d)
+    o = jax.lax.dot_general(jnp.where(bd, obd, 0.0), gather,
+                            (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # (h, d)
+    o_ref[0] = (o / jnp.where(l > 0, l, 1.0)).astype(o_ref.dtype)
+
+
+def decode_attend_kernel(q, cache, length, *,
+                         mask_row: Optional[jnp.ndarray] = None,
+                         scale: Optional[float] = None,
+                         out_dtype=None,
+                         interpret: Optional[bool] = None):
+    """q (b,h,1,d) × KVCache (sequence-major layout — ops/attention.KVCache)
+    → (b,h,1,d). ``length`` is a traced scalar; ``mask_row`` an optional (S,)
+    bool/int validity row (the static mask row for this query position)."""
+    b, h, _, d = q.shape
+    S = cache.kv.shape[1]
+    hd2 = cache.kv.shape[2]
+    if scale is None:
+        scale = d ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    out_dtype = out_dtype or q.dtype
+
+    quant = cache.scale is not None
+    full = pl.BlockSpec((1, S, hd2), lambda ib, *_: (ib, 0, 0))
+    qspec = pl.BlockSpec((1, h, d), lambda ib, *_: (ib, 0, 0))
+    in_specs = [qspec, full]
+    args = [q[:, :, 0, :], cache.kv]
+    if quant:
+        in_specs += [pl.BlockSpec((1, 2 * h, S), lambda ib, *_: (ib, 0, 0))]
+        args += [cache.scale]
+    if mask_row is not None:
+        in_specs += [pl.BlockSpec((1, S), lambda ib, *_: (0, 0))]
+        args += [mask_row.astype(jnp.int32)[None, :]]
+
+    def kern(len_ref, *refs):
+        q_ref, kv_ref = refs[0], refs[1]
+        nxt = 2
+        sc_ref = row_ref = None
+        if quant:
+            sc_ref = refs[nxt]
+            nxt += 1
+        if mask_row is not None:
+            row_ref = refs[nxt]
+            nxt += 1
+        _decode_kernel(len_ref, q_ref, kv_ref, sc_ref, row_ref,
+                       refs[nxt], scale=scale, heads=h)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b,),
+        in_specs=in_specs,
+        out_specs=qspec,
+    )
+    out = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), out_dtype),
+        interpret=interpret,
+    )(jnp.asarray(length, jnp.int32).reshape(1), *args)
+    return out[:, :, None, :]
+
+
+def decode_kernel_supported(q, cache, *, stable: bool) -> bool:
+    """Shape/mode gate for the kernel path (caller falls back to dense XLA
+    otherwise): 1-token query, lane-tiled cache, merged K+V block within the
+    per-program VMEM budget, no stable-softmax variant (its pre-division
+    changes the math the kernel hardcodes)."""
+    b, h, i, d = q.shape
+    S, hd2 = cache.kv.shape[1], cache.kv.shape[2]
+    itemsize = jnp.dtype(cache.kv.dtype).itemsize
+    return (i == 1 and not stable and S % 128 == 0 and S >= 128
+            and (hd2 // 2) % 128 == 0 and d % 8 == 0
+            and S * hd2 * itemsize <= _VMEM_BUDGET)

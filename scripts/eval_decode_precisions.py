@@ -63,14 +63,15 @@ def train_rainbow(args):
         [np.asarray(vae.get_codebook_indices(imgs[s:s + 64]))
          for s in range(0, len(imgs), 64)])
     tok = Token([c.split() for c in caps])
-    text = tok.parse(seq_len=tok.sequence_len)
+    seq_len = max(args.pad_text_to or 0, tok.sequence_len)
+    text = tok.parse(seq_len=seq_len)
 
     order = rng.permutation(len(ds))
     n_train = max(int(len(ds) * args.train_frac), args.batch_size)
     tr_idx = order[:n_train]
 
     dcfg = DalleConfig(num_text_tokens=tok.num_pairs,
-                       text_seq_len=tok.sequence_len, dim=args.dim,
+                       text_seq_len=seq_len, dim=args.dim,
                        depth=args.depth, heads=4, dim_head=args.dim // 4,
                        image_size=args.image_size,
                        image_vocab_size=args.num_tokens,
@@ -124,6 +125,10 @@ def main(argv=None):
                     help="captions scored (train split — the notebook's "
                          "token-accuracy bar is the train split)")
     ap.add_argument("--timing_iters", type=int, default=5)
+    ap.add_argument("--pad_text_to", type=int, default=None,
+                    help="pad text_seq_len up to this (e.g. 64 with "
+                         "image_size 32 gives total_seq 128 so the Pallas "
+                         "decode kernel engages on TPU)")
     ap.add_argument("--outdir", type=str, default="/tmp/eval_decode_prec")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--small", action="store_true",

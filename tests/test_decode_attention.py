@@ -1,0 +1,92 @@
+"""Pallas decode-attention kernel ≡ the dense cached_attend path (interpret
+mode on CPU; the on-chip Mosaic build is exercised by the TPU bench and
+DALLE_TPU_TESTS=1 runs)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_tpu.ops.attention import KVCache, cached_attend
+from dalle_tpu.ops.decode_attention import (decode_attend_kernel,
+                                            decode_kernel_supported)
+
+
+def _cache(rng, b, h, S, d, dtype):
+    c = KVCache.init(b, h, S, d, dtype)
+    k = jnp.asarray(rng.standard_normal((b, h, S, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, h, S, d)), jnp.float32)
+    return c.append(k, v, 0)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16, jnp.int8])
+def test_kernel_matches_dense(dtype):
+    rng = np.random.RandomState(0)
+    b, h, S, d = 2, 4, 256, 64
+    cache = _cache(rng, b, h, S, d, dtype)
+    q = jnp.asarray(rng.standard_normal((b, h, 1, d)), jnp.float32)
+    length = jnp.int32(135)
+    dense = cached_attend(q, cache, length, use_kernel=False)
+    kern = decode_attend_kernel(q, cache, length, interpret=True)
+    np.testing.assert_allclose(np.asarray(kern), np.asarray(dense),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_kernel_matches_dense_with_mask_row():
+    rng = np.random.RandomState(1)
+    b, h, S, d = 2, 2, 128, 64
+    cache = _cache(rng, b, h, S, d, jnp.float32)
+    q = jnp.asarray(rng.standard_normal((b, h, 1, d)), jnp.float32)
+    mask = jnp.asarray(rng.rand(S, S) > 0.4)
+    length, qpos = jnp.int32(90), jnp.int32(89)
+    dense = cached_attend(q, cache, length, static_mask=mask, qpos=qpos,
+                          use_kernel=False)
+    row = jax.lax.dynamic_index_in_dim(mask, qpos, 0, keepdims=False)
+    kern = decode_attend_kernel(q, cache, length, mask_row=row,
+                                interpret=True)
+    np.testing.assert_allclose(np.asarray(kern), np.asarray(dense),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_cached_attend_kernel_flag_roundtrip():
+    """use_kernel=True routes through the kernel (interpret on CPU) and
+    agrees with the dense default."""
+    rng = np.random.RandomState(2)
+    cache = _cache(rng, 1, 2, 128, 64, jnp.int8)
+    q = jnp.asarray(rng.standard_normal((1, 2, 1, 64)), jnp.float32)
+    dense = cached_attend(q, cache, jnp.int32(70))
+    kern = cached_attend(q, cache, jnp.int32(70), use_kernel=True)
+    np.testing.assert_allclose(np.asarray(kern), np.asarray(dense),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_cache_roundtrip_layout():
+    """Sequence-major storage presents the conventional (b,h,S,d) view and
+    append/read_kv round-trips exactly (f32) / within quant noise (int8)."""
+    rng = np.random.RandomState(3)
+    b, h, S, d = 2, 3, 16, 8
+    k = rng.standard_normal((b, h, S, d)).astype(np.float32)
+    v = rng.standard_normal((b, h, S, d)).astype(np.float32)
+    c = KVCache.init(b, h, S, d).append(jnp.asarray(k), jnp.asarray(v), 0)
+    ck, cv = c.read_kv()
+    np.testing.assert_array_equal(np.asarray(ck), k)
+    np.testing.assert_array_equal(np.asarray(cv), v)
+    c8 = KVCache.init(b, h, S, d, jnp.int8).append(
+        jnp.asarray(k), jnp.asarray(v), 0)
+    ck8, _ = c8.read_kv(dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(ck8), k, atol=0.02)
+
+
+def test_supported_gate():
+    q = jnp.zeros((1, 2, 1, 64))
+    ok = KVCache.init(1, 2, 256, 64)
+    assert decode_kernel_supported(q, ok, stable=False)
+    assert not decode_kernel_supported(q, KVCache.init(1, 2, 200, 64),
+                                       stable=False)   # S not lane-tiled
+    assert not decode_kernel_supported(q, ok, stable=True)
+    assert not decode_kernel_supported(jnp.zeros((1, 2, 2, 64)), ok,
+                                       stable=False)   # multi-token q
+    # h*d not lane-tiled
+    assert not decode_kernel_supported(jnp.zeros((1, 2, 1, 16)),
+                                       KVCache.init(1, 2, 256, 16),
+                                       stable=False)
