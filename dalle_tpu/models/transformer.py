@@ -156,9 +156,20 @@ class Attention(nn.Module):
                                  zigzag=True,
                                  mask_spec=mask_spec if np_mask is not None
                                  else None)
-        elif self.use_pallas and key_mask is None and not self.is_initializing():
+        elif (self.use_pallas == "persist" and key_mask is None
+              and self.causal and not self.stable
+              and not self.is_initializing()):
+            # whole-sequence VMEM-resident kernel: the mid-length tier where
+            # block-grid flash loses to dense (ops/persistent_attention.py)
+            from ..ops.persistent_attention import persistent_attention
+            out = persistent_attention(q, k, v, np_mask).astype(x.dtype)
+        elif (self.use_pallas in (True, "flash") and key_mask is None
+              and not self.is_initializing()):
             # (init uses the dense path: params are identical and eager pallas
-            # execution during un-jitted init is needlessly slow)
+            # execution during un-jitted init is needlessly slow. NOT a bare
+            # truthiness test: a "persist" request whose gate above rejected
+            # it — stable/non-causal — must fall to dense, not to the flash
+            # kernel that loses to dense at these lengths)
             from ..ops.flash_attention import flash_attention
             out = flash_attention(q, k, v, mask=np_mask, mask_spec=mask_spec,
                                   causal=self.causal)
@@ -373,7 +384,8 @@ class Transformer(nn.Module):
         # "auto" resolves against the measured v5e crossover: flash kernels
         # for seq ≥ 2048 on TPU, dense below (ops/flash_attention.py)
         from ..ops.flash_attention import resolve_use_pallas
-        use_pallas = resolve_use_pallas(c.use_pallas, c.seq_len)
+        use_pallas = resolve_use_pallas(c.use_pallas, c.seq_len,
+                                        dim_head=c.dim_head)
 
         attn_types = tuple(c.attn_types) or ("full",)
         type_per_layer = list(islice(cycle(attn_types), c.depth))

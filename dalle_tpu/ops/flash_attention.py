@@ -466,26 +466,48 @@ def sparsity_fraction(n: int, block_q: int = 128, block_k: int = 128,
 PALLAS_AUTO_MIN_SEQ = 2048
 
 
-def resolve_use_pallas(setting, seq_len: int, backend: Optional[str] = None) -> bool:
-    """Resolve a config's ``use_pallas`` ("auto" | on | off, bools and their
-    string forms accepted for config round-trips) into the per-model bool.
-    "auto" applies the measured crossover: flash for seq ≥ 2048 on TPU, dense
-    below (and always dense off-TPU, where the kernels run interpret-mode) —
-    so default long-sequence configs hit the flash path with no flag, the way
-    the reference's sparse layers defaulted onto its CUDA kernel
-    (attention.py:339-398)."""
-    if isinstance(setting, bool):
-        return setting
+def resolve_use_pallas(setting, seq_len: int, backend: Optional[str] = None,
+                       dim_head: int = 64):
+    """Resolve a config's ``use_pallas`` ("auto" | "persist" | on | off,
+    bools and their string forms accepted for config round-trips) into the
+    per-model mode: "flash" | "persist" | False.
+
+    "auto" applies the measured crossover on TPU: the block-grid flash
+    kernels for seq ≥ 2048 (the r2-measured crossover — 1.4-4.3x over
+    dense), dense below (and always dense off-TPU, where the kernels run
+    interpret-mode). The VMEM-persistent whole-sequence kernel
+    (ops/persistent_attention.py) is opt-in via "persist": it beats dense
+    1.6x as a standalone op at n=513 but loses ~19% END-TO-END — the
+    pallas-call boundary breaks XLA's layout fusion around it
+    (docs/PERF_SMALL.md r4 addendum) — so auto keeps dense for the
+    mid-length tier."""
+    from .persistent_attention import persistent_fits
+    if setting is True:
+        return "flash"
+    if setting is False:
+        return False
     s = str(setting).lower()
+    # only the backend-dependent branches may query the backend: resolving a
+    # plain "on"/"off" string must not initialize the XLA client as a side
+    # effect of config parsing
     if s == "auto":
         if backend is None:
             backend = jax.default_backend()
-        return seq_len >= PALLAS_AUTO_MIN_SEQ and backend == "tpu"
+        if backend != "tpu":
+            return False
+        if seq_len >= PALLAS_AUTO_MIN_SEQ:
+            return "flash"
+        return False
+    if s == "persist":
+        if backend is None:
+            backend = jax.default_backend()
+        return ("persist" if backend == "tpu"
+                and persistent_fits(seq_len, dim_head) else False)
     if s in ("1", "true", "on", "yes"):
-        return True
+        return "flash"
     if s in ("0", "false", "off", "no", "none"):
         return False
-    raise ValueError(f"use_pallas must be auto/on/off, got {setting!r}")
+    raise ValueError(f"use_pallas must be auto/persist/on/off, got {setting!r}")
 
 
 def _auto_block(n: int, has_mask: bool) -> int:

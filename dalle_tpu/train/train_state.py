@@ -9,6 +9,7 @@ inside the jitted step, so they compile into the same XLA program as the psum.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Optional
 
 import flax.struct
@@ -68,7 +69,19 @@ def make_lr_schedule(cfg: OptimConfig):
     return sched
 
 
+@functools.lru_cache(maxsize=128)
 def make_optimizer(cfg: OptimConfig) -> optax.GradientTransformation:
+    """Memoized on the (frozen, hashable) config: two trainers with equal
+    OptimConfigs share ONE GradientTransformation object. This matters
+    beyond allocation thrift — optax transforms are NamedTuples of fresh
+    closures, and the tx rides TrainState's static treedef, so distinct tx
+    objects force jit recompiles of otherwise-identical train steps (the
+    test suite builds equal-config trainer pairs constantly; sharing the tx
+    makes the second trainer's compile a cache hit)."""
+    return _build_optimizer(cfg)
+
+
+def _build_optimizer(cfg: OptimConfig) -> optax.GradientTransformation:
     sched = make_lr_schedule(cfg)
     if cfg.optimizer == "adam":
         core = optax.adam(sched, b1=cfg.beta1, b2=cfg.beta2, eps=cfg.eps)

@@ -16,6 +16,7 @@ MultiSteps transform inside the same program rather than an engine feature.
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 
 import jax
@@ -50,6 +51,7 @@ def _make_dalle_loss_fn(model: DALLE, *, null_cond_prob: float,
     return loss_fn
 
 
+@functools.lru_cache(maxsize=64)
 def make_dalle_train_step(model: DALLE, *, null_cond_prob: float = 0.0,
                           use_dropout: bool = False, dtype=None):
     """Returns step(state, text, image_ids, key) -> (state, metrics). jit-once
@@ -71,6 +73,7 @@ def make_dalle_train_step(model: DALLE, *, null_cond_prob: float = 0.0,
     return step
 
 
+@functools.lru_cache(maxsize=64)
 def make_dalle_train_multi_step(model: DALLE, *, null_cond_prob: float = 0.0,
                                 use_dropout: bool = False, dtype=None):
     """k optimizer steps in ONE device program: ``lax.scan`` over the step

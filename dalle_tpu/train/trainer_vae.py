@@ -16,6 +16,7 @@ determinism.
 from __future__ import annotations
 
 import math
+import functools
 from functools import partial
 from typing import Optional
 
@@ -56,6 +57,7 @@ def _vae_step_body(model: DiscreteVAE, dtype=None):
     return step
 
 
+@functools.lru_cache(maxsize=64)
 def make_vae_train_step(model: DiscreteVAE, dtype=None):
     """Returns step(state, images, key, temp) -> (state, metrics). jit-once;
     the state is donated so params/moments update in place in HBM. ``dtype``
@@ -63,6 +65,7 @@ def make_vae_train_step(model: DiscreteVAE, dtype=None):
     return partial(jax.jit, donate_argnums=(0,))(_vae_step_body(model, dtype))
 
 
+@functools.lru_cache(maxsize=64)
 def make_vae_train_multi_step(model: DiscreteVAE, dtype=None):
     """k steps per dispatch (train_state.make_scanned_steps) over stacked
     (images, keys, temps) — the identical step body, so with matching key and
