@@ -225,13 +225,31 @@ _READY = ".ready"
 def _extract_tar(archive, dest) -> None:
     """extractall with the safe 'data' filter where available (3.12+ /
     late 3.10/3.11 backports); older interpreters in our >=3.10 range lack
-    the kwarg."""
+    the kwarg, so the fallback path re-implements the traversal checks
+    (reject absolute paths, ``..`` components, and links escaping dest)."""
     import tarfile
 
     with tarfile.open(archive, "r:*") as tar:
         try:
             tar.extractall(path=dest, filter="data")
         except TypeError:
+            for member in tar.getmembers():
+                name = Path(member.name)
+                if name.is_absolute() or ".." in name.parts:
+                    raise ValueError(
+                        f"unsafe path in archive {archive!r}: {member.name!r}")
+                if member.islnk() or member.issym():
+                    link = Path(member.linkname)
+                    if link.is_absolute() or ".." in link.parts:
+                        raise ValueError(
+                            f"unsafe link in archive {archive!r}: "
+                            f"{member.name!r} -> {member.linkname!r}")
+                elif not (member.isfile() or member.isdir()):
+                    # the 'data' filter also rejects FIFOs/devices — a FIFO
+                    # at an image path would block the first dataset pass
+                    raise ValueError(
+                        f"unsupported member type in archive {archive!r}: "
+                        f"{member.name!r}")
             tar.extractall(path=dest)
 
 

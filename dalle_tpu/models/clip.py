@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import optax
 
 from ..config import ClipConfig, TransformerConfig
+from ..ops.quantize_weights import assert_float_params
 from ..ops.sampling import masked_mean
 from .transformer import Transformer
 
@@ -55,6 +56,7 @@ class CLIP(nn.Module):
 
     def embed_text(self, text):
         """(b, text_seq_len) ids → (b, dim_latent) L2-normalized."""
+        assert_float_params(self)
         mask = text != 0
         x = self.text_emb(text) + self.text_pos_emb(jnp.arange(text.shape[1]))
         x = self.text_transformer(x, key_mask=mask)
@@ -64,6 +66,7 @@ class CLIP(nn.Module):
 
     def embed_image(self, image):
         """(b, H, W, C) NHWC floats → (b, dim_latent) L2-normalized."""
+        assert_float_params(self)
         c = self.cfg
         p = c.visual_patch_size
         b, h, w, ch = image.shape

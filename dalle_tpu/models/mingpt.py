@@ -24,6 +24,7 @@ import numpy as np
 
 from ..config import ConfigBase
 from ..ops.attention import KVCache, attend, cached_attend
+from ..ops.quantize_weights import assert_float_params
 from ..ops.sampling import gumbel_sample
 
 
@@ -119,6 +120,7 @@ class GPT(nn.Module):
 
     def __call__(self, idx, embeddings: Optional[jnp.ndarray] = None,
                  deterministic: bool = True):
+        assert_float_params(self)
         x = self.tok_emb(idx)
         if embeddings is not None:
             x = jnp.concatenate([embeddings, x], axis=1)
@@ -139,6 +141,7 @@ class GPT(nn.Module):
     def decode_one(self, token, pos, cache):
         """token: (b, 1) int32; pos: scalar position of this token.
         Returns (logits (b, vocab), new cache)."""
+        assert_float_params(self)
         x = self.tok_emb(token)
         x = x + jax.lax.dynamic_slice_in_dim(self.pos_emb, pos, 1, axis=1)
         new_cache = []
@@ -150,6 +153,7 @@ class GPT(nn.Module):
     def prefill(self, idx, cache):
         """Run the prompt through the cache one layer at a time (full-sequence
         matmuls, not a scan): returns (logits of last position, cache, length)."""
+        assert_float_params(self)
         x = self.tok_emb(idx)
         n = x.shape[1]
         x = x + self.pos_emb[:, :n]

@@ -156,6 +156,12 @@ def decode_kernel_supported(q, cache, *, stable: bool) -> bool:
     b, h, i, d = q.shape
     S, hd2 = cache.kv.shape[1], cache.kv.shape[2]
     itemsize = jnp.dtype(cache.kv.dtype).itemsize
+    # per-program VMEM: merged K+V block + (2h, S) f32 scale block on the
+    # int8 path + the (1, S) i32 mask row (counted unconditionally — it is
+    # noise next to the KV block and keeps this gate mask-agnostic)
+    vmem_bytes = S * hd2 * itemsize + S * 4
+    if cache.kv.dtype == jnp.int8:
+        vmem_bytes += 2 * h * S * 4
     return (i == 1 and not stable and S % 128 == 0 and S >= 128
             and (hd2 // 2) % 128 == 0 and d % 8 == 0
-            and S * hd2 * itemsize <= _VMEM_BUDGET)
+            and vmem_bytes <= _VMEM_BUDGET)

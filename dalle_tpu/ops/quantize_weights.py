@@ -61,6 +61,21 @@ class QDense(nn.Module):
         return y if bias is None else y + bias
 
 
+def assert_float_params(module: nn.Module) -> None:
+    """Trace-time guard for plain-``nn.Dense`` consumers (CLIP, minGPT):
+    an int8 tree from :func:`quantize_params_int8` is only consumable by
+    :class:`QDense` — ``nn.Dense``'s promote_dtype would cast the int8
+    kernel to float WITHOUT its scale and silently produce garbage. Call
+    from a bound module's apply path; costs one tree walk at trace time."""
+    for leaf in jax.tree_util.tree_leaves(module.variables.get("params", {})):
+        if hasattr(leaf, "dtype") and leaf.dtype == jnp.int8:
+            raise ValueError(
+                f"{type(module).__name__} holds int8 params but is built on "
+                "plain nn.Dense, which cannot apply the quant scales — int8 "
+                "weight quantization is only supported for QDense-based "
+                "models (DALLE). Re-load float params for this model.")
+
+
 def quantize_kernel_int8(w, axis: int = 0):
     """(int8 q, f32 scale broadcastable against q): symmetric per-channel
     over ``axis`` (the contraction axis — scales attach to the outputs)."""

@@ -109,8 +109,10 @@ class BaseTrainer:
             if not group:
                 return
             homogeneous = all(
-                np.shape(x) == np.shape(group[0][j])
-                for b in group for j, x in enumerate(b))
+                len(b) == len(group[0]) and all(
+                    np.shape(x) == np.shape(group[0][j])
+                    for j, x in enumerate(b))
+                for b in group)
             if len(group) < k or not homogeneous:
                 if not homogeneous and not warned:
                     warnings.warn(
