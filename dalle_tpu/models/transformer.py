@@ -134,6 +134,34 @@ class Attention(nn.Module):
         converts it to a jnp constant — a single source of truth so the two
         backends can never disagree."""
         b, n, _ = x.shape
+        if (self.use_pallas == "fused" and key_mask is None and self.causal
+                and not self.stable and self.sp_mesh is None
+                and not self.is_initializing()):
+            # fused-boundary kernel: operand is the qkv projection's own
+            # (b, n, 3·h·d) layout, head split/merge live inside the kernel
+            # (ops/fused_attention.py — the r5 answer to the persistent
+            # kernel's 60 ms/step boundary tax). Rotary rides the same
+            # layout: applied on the (b, n, 3h, d) VIEW — a reshape, not
+            # the head-split transpose the dense path pays. The fits check
+            # re-validates with the RUNTIME n (resolve saw cfg.seq_len) so
+            # a stale/defaulted resolve can never reach a failing Mosaic
+            # compile — unfit shapes fall through to dense.
+            from ..ops.fused_attention import (fused_fits,
+                                               fused_qkv_attention, use_spec)
+            ships_table = np_mask is not None and not use_spec(mask_spec)
+            if fused_fits(n, self.dim_head, self.heads,
+                          has_mask=ships_table):
+                qkv = self.to_qkv(x)
+                if rotary is not None:
+                    rot = rotary[:n][:, None]          # (n, 1, rot_dim)
+                    qkv = apply_rotary(
+                        rot, qkv.reshape(b, n, 3 * self.heads, self.dim_head)
+                    ).reshape(b, n, -1)
+                out = fused_qkv_attention(
+                    qkv, np_mask, self.heads, None, None,
+                    mask_spec).astype(x.dtype)
+                return self.drop(self.to_out(out),
+                                 deterministic=deterministic)
         q, k, v = self._split(self.to_qkv(x), n)
         if rotary is not None:
             rot = rotary[:n][None, None]
@@ -385,7 +413,7 @@ class Transformer(nn.Module):
         # for seq ≥ 2048 on TPU, dense below (ops/flash_attention.py)
         from ..ops.flash_attention import resolve_use_pallas
         use_pallas = resolve_use_pallas(c.use_pallas, c.seq_len,
-                                        dim_head=c.dim_head)
+                                        dim_head=c.dim_head, heads=c.heads)
 
         attn_types = tuple(c.attn_types) or ("full",)
         type_per_layer = list(islice(cycle(attn_types), c.depth))

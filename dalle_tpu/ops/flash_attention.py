@@ -467,10 +467,10 @@ PALLAS_AUTO_MIN_SEQ = 2048
 
 
 def resolve_use_pallas(setting, seq_len: int, backend: Optional[str] = None,
-                       dim_head: int = 64):
-    """Resolve a config's ``use_pallas`` ("auto" | "persist" | on | off,
-    bools and their string forms accepted for config round-trips) into the
-    per-model mode: "flash" | "persist" | False.
+                       dim_head: int = 64, heads: int = 8):
+    """Resolve a config's ``use_pallas`` ("auto" | "fused" | "persist" | on |
+    off, bools and their string forms accepted for config round-trips) into
+    the per-model mode: "flash" | "fused" | "persist" | False.
 
     "auto" applies the measured crossover on TPU: the block-grid flash
     kernels for seq ≥ 2048 (the r2-measured crossover — 1.4-4.3x over
@@ -479,8 +479,10 @@ def resolve_use_pallas(setting, seq_len: int, backend: Optional[str] = None,
     (ops/persistent_attention.py) is opt-in via "persist": it beats dense
     1.6x as a standalone op at n=513 but loses ~19% END-TO-END — the
     pallas-call boundary breaks XLA's layout fusion around it
-    (docs/PERF_SMALL.md r4 addendum) — so auto keeps dense for the
-    mid-length tier."""
+    (docs/PERF_SMALL.md r4 addendum). "fused" selects its r5 successor
+    (ops/fused_attention.py) whose boundary is the qkv projection's own
+    (b, n, 3·h·d) layout."""
+    from .fused_attention import fused_fits
     from .persistent_attention import persistent_fits
     if setting is True:
         return "flash"
@@ -497,7 +499,20 @@ def resolve_use_pallas(setting, seq_len: int, backend: Optional[str] = None,
             return False
         if seq_len >= PALLAS_AUTO_MIN_SEQ:
             return "flash"
+        # mid-length tier: the fused-boundary kernel measures 0.458 vs 0.391
+        # MFU end-to-end on DALL·E-small (r5; the per-(b,h) persistent kernel
+        # lost this same comparison to boundary tax in r4). Configs whose
+        # backward exceeds scoped VMEM (e.g. h·d ≥ 1024 at n=513 — the
+        # medium/1.4B shapes) keep dense.
+        if fused_fits(seq_len, dim_head, heads, has_mask=True):
+            return "fused"
         return False
+    if s == "fused":
+        if backend is None:
+            backend = jax.default_backend()
+        return ("fused" if backend == "tpu"
+                and fused_fits(seq_len, dim_head, heads, has_mask=True)
+                else False)
     if s == "persist":
         if backend is None:
             backend = jax.default_backend()
@@ -507,7 +522,8 @@ def resolve_use_pallas(setting, seq_len: int, backend: Optional[str] = None,
         return "flash"
     if s in ("0", "false", "off", "no", "none"):
         return False
-    raise ValueError(f"use_pallas must be auto/persist/on/off, got {setting!r}")
+    raise ValueError(
+        f"use_pallas must be auto/fused/persist/on/off, got {setting!r}")
 
 
 def _auto_block(n: int, has_mask: bool) -> int:

@@ -1,0 +1,228 @@
+"""Fused-boundary whole-sequence attention: qkv lands as (b, n, 3·h·d).
+
+The r4 VMEM-persistent kernel (ops/persistent_attention.py) won 1.6x
+standalone and halved in-model attention time, yet LOST 19% end-to-end:
+its custom-call boundary forced the (b, h, n, d) head-split layout to
+materialize, costing ~60 ms/step of XLA loop-fusion/formatting/slice work
+that the dense path folds into the attention einsums (docs/PERF_SMALL.md
+r4 addendum). This kernel moves the boundary to where the data already
+is: the operand is the qkv projection's own output layout (b, n, 3·h·d)
+and the result is the pre-to_out merged layout (b, n, h·d) — the head
+split/merge, scaling, causal mask, and softmax all live INSIDE the
+kernel, so XLA sees a matmul → custom-call → matmul chain with no layout
+work between. Rotary stays outside but is applied on the (b, n, 3h, d)
+VIEW of the projection output (a reshape, not a transpose — see
+models/transformer.py Attention.__call__).
+
+Grid: one program per batch row (the decode kernel's "fewer, bigger
+programs" lesson — ops/decode_attention.py), heads unrolled inside.
+Backward is a second per-batch-row kernel recomputing scores from the
+saved qkv operand, emitting dqkv in the same (n, 3·h·d) merged layout the
+to_qkv backward wants; residual memory stays O(n·h·d).
+
+Reference bar: the dense Attention hot path this replaces
+(dalle_pytorch/attention.py:58-99).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e9
+
+# per-program live set must fit scoped VMEM (16M on v5e). Calibrated against
+# the compiler's own reports: Mosaic DOUBLE-BUFFERS the operand/output block
+# windows, so the backward pass (the larger one) costs ~2×(qkv + do + dqkv)
+# bf16 windows + the merged bf16 grad accumulators + ~3 (n, n) f32 score
+# tiles (+ the double-buffered int8 mask window when present). The small
+# config (n=513, h·d=512) compiles at ~12M; medium (h·d=1024) was reported
+# at 25.68M by the compiler — the budget below accepts the former and
+# rejects the latter with headroom.
+_VMEM_BUDGET = 14 * 1024 * 1024
+
+
+def fused_fits(n: int, dim_head: int, heads: int,
+               has_mask: bool = False) -> bool:
+    """Backward-pass VMEM bound (the larger of the two passes)."""
+    hd = heads * dim_head
+    bytes_ = 34 * n * hd + 12 * n * n + (2 * n * n if has_mask else 0)
+    return bytes_ <= _VMEM_BUDGET
+
+
+def use_spec(mask_spec) -> bool:
+    """Structured (axial/conv) specs are pure functions of (qpos, kpos): the
+    kernel computes them from iotas and skips the (n, n) table operand
+    entirely (same reasoning as flash_attention.elem_fn_from_spec — the
+    table window would cost as much VMEM traffic as a score tile). Tabled
+    'block' random-sparse patterns have no such function and ship the
+    table."""
+    return mask_spec is not None and mask_spec[0] in ("axial", "conv")
+
+
+def _valid(mask_ref, n, elem_fn=None):
+    ri = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)
+    ci = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
+    if elem_fn is not None:
+        # spec visibility does not include causality (the tables do)
+        return elem_fn(ri, ci) & (ci <= ri)
+    if mask_ref is not None:
+        return mask_ref[...] != 0         # mask already includes causality
+    return ci <= ri
+
+
+def _fwd_kernel(qkv_ref, *rest, scale, n, h, d, has_mask, elem_fn=None):
+    mask_ref, o_ref = (rest[0], rest[1]) if has_mask else (None, rest[0])
+    qkv = qkv_ref[0]                      # (n, 3hd) bf16
+    hd = h * d
+    valid = _valid(mask_ref, n, elem_fn)
+    outs = []
+    for i in range(h):
+        q = qkv[:, i * d:(i + 1) * d]
+        k = qkv[:, hd + i * d:hd + (i + 1) * d]
+        v = qkv[:, 2 * hd + i * d:2 * hd + (i + 1) * d]
+        qs = (q.astype(jnp.float32) * scale).astype(jnp.bfloat16)
+        s = jax.lax.dot_general(qs, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)   # (n, n)
+        s = jnp.where(valid, s, NEG_INF)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        o = jax.lax.dot_general((p / l).astype(jnp.bfloat16), v,
+                                (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        outs.append(o.astype(o_ref.dtype))
+    o_ref[0] = jnp.concatenate(outs, axis=-1)
+
+
+def _bwd_kernel(qkv_ref, do_ref, *rest, scale, n, h, d, has_mask,
+                elem_fn=None):
+    mask_ref, dqkv_ref = (rest[0], rest[1]) if has_mask else (None, rest[0])
+    qkv = qkv_ref[0]                      # (n, 3hd) bf16
+    do_all = do_ref[0]                    # (n, hd) bf16
+    hd = h * d
+    valid = _valid(mask_ref, n, elem_fn)
+    dqs, dks, dvs = [], [], []
+    for i in range(h):
+        q = qkv[:, i * d:(i + 1) * d]
+        k = qkv[:, hd + i * d:hd + (i + 1) * d]
+        v = qkv[:, 2 * hd + i * d:2 * hd + (i + 1) * d]
+        do16 = do_all[:, i * d:(i + 1) * d]
+        do32 = do16.astype(jnp.float32)
+        qs = (q.astype(jnp.float32) * scale).astype(jnp.bfloat16)
+        s = jax.lax.dot_general(qs, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = jnp.where(valid, s, NEG_INF)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        e = jnp.exp(s - m)
+        p = e / jnp.sum(e, axis=-1, keepdims=True)                  # (n, n)
+        p16 = p.astype(jnp.bfloat16)
+        dp = jax.lax.dot_general(do16, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        o = jax.lax.dot_general(p16, v, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        delta = jnp.sum(o * do32, axis=-1, keepdims=True)
+        ds = (p * (dp - delta)).astype(jnp.bfloat16)
+        dq = jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32) * scale
+        dk = jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32) * scale
+        dv = jax.lax.dot_general(p16, do16, (((0,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        dqs.append(dq.astype(dqkv_ref.dtype))
+        dks.append(dk.astype(dqkv_ref.dtype))
+        dvs.append(dv.astype(dqkv_ref.dtype))
+    dqkv_ref[0] = jnp.concatenate(dqs + dks + dvs, axis=-1)
+
+
+def _interp(interpret):
+    return jax.default_backend() != "tpu" if interpret is None else interpret
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
+def fused_qkv_attention(qkv, mask=None, heads: int = 8,
+                        scale: Optional[float] = None,
+                        interpret: Optional[bool] = None,
+                        mask_spec=None):
+    """Causal multi-head attention straight off the qkv projection.
+
+    qkv: (b, n, 3·h·d) in [q_0..q_{h-1} | k_0.. | v_0..] head-major slices
+    (the ``_split`` convention, models/transformer.py) → (b, n, h·d) merged
+    output ready for to_out. ``mask`` is an optional host-side (n, n) numpy
+    bool table (True = attend, causality included); None = plain causal.
+    A structured ``mask_spec`` (axial/conv — see use_spec) replaces the
+    table with an in-kernel iota test and the table is not shipped."""
+    return _fused_fwd(qkv, mask, heads, scale, interpret, mask_spec)[0]
+
+
+def _layout(b, n, hd3, hd, mask):
+    qkv_spec = pl.BlockSpec((1, n, hd3), lambda ib: (ib, 0, 0))
+    out_spec = pl.BlockSpec((1, n, hd), lambda ib: (ib, 0, 0))
+    extra = ([pl.BlockSpec((n, n), lambda ib: (0, 0))]
+             if mask is not None else [])
+    return qkv_spec, out_spec, extra
+
+
+def _spec_elem(mask, mask_spec):
+    """(mask-to-ship, elem_fn) after spec substitution."""
+    if use_spec(mask_spec):
+        from .flash_attention import elem_fn_from_spec
+        return None, elem_fn_from_spec(mask_spec)
+    return mask, None
+
+
+def _fused_fwd(qkv, mask, heads, scale, interpret, mask_spec=None):
+    b, n, hd3 = qkv.shape
+    hd = hd3 // 3
+    d = hd // heads
+    if scale is None:
+        scale = d ** -0.5
+    mask, elem_fn = _spec_elem(mask, mask_spec)
+    qkv_spec, out_spec, extra = _layout(b, n, hd3, hd, mask)
+    args = [qkv.astype(jnp.bfloat16)]
+    if mask is not None:
+        args.append(jnp.asarray(mask, jnp.int8))
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, n=n, h=heads, d=d,
+                          has_mask=mask is not None, elem_fn=elem_fn),
+        grid=(b,),
+        in_specs=[qkv_spec] + extra,
+        out_specs=out_spec,
+        out_shape=jax.ShapeDtypeStruct((b, n, hd), qkv.dtype),
+        interpret=_interp(interpret),
+    )(*args)
+    return out, (qkv,)
+
+
+def _fused_bwd(mask, heads, scale, interpret, mask_spec, res, do):
+    (qkv,) = res
+    b, n, hd3 = qkv.shape
+    hd = hd3 // 3
+    d = hd // heads
+    if scale is None:
+        scale = d ** -0.5
+    mask, elem_fn = _spec_elem(mask, mask_spec)
+    qkv_spec, out_spec, extra = _layout(b, n, hd3, hd, mask)
+    args = [qkv.astype(jnp.bfloat16), do.astype(jnp.bfloat16)]
+    if mask is not None:
+        args.append(jnp.asarray(mask, jnp.int8))
+    dqkv = pl.pallas_call(
+        functools.partial(_bwd_kernel, scale=scale, n=n, h=heads, d=d,
+                          has_mask=mask is not None, elem_fn=elem_fn),
+        grid=(b,),
+        in_specs=[qkv_spec, out_spec] + extra,
+        out_specs=qkv_spec,
+        out_shape=jax.ShapeDtypeStruct((b, n, hd3), qkv.dtype),
+        interpret=_interp(interpret),
+    )(*args)
+    return (dqkv,)
+
+
+fused_qkv_attention.defvjp(
+    lambda qkv, mask, heads, scale, interpret, mask_spec:
+        _fused_fwd(qkv, mask, heads, scale, interpret, mask_spec),
+    _fused_bwd)

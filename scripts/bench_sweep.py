@@ -96,6 +96,15 @@ def main():
             run("small_scan8_chunk256_b64", dict(SMALL, loss_chunk=256), 64,
                 steps=16, scan_k=8)
             run("small_b64", SMALL, 64)
+        elif w == "small_fused":
+            # r5: the fused-boundary kernel (ops/fused_attention.py) vs the
+            # shipped-best dense recipe, same scan8+chunk256 harness
+            run("small_fused_scan8_chunk256_b64",
+                dict(SMALL, use_pallas="fused", loss_chunk=256), 64,
+                steps=16, scan_k=8)
+            run("small_fused_noremat_scan8_chunk256_b64",
+                dict(SMALL, use_pallas="fused", use_remat=False,
+                     loss_chunk=256), 64, steps=16, scan_k=8)
         elif w == "small128":
             run("small_b128", SMALL, 128)
         elif w == "small_opt":

@@ -116,13 +116,17 @@ def test_jit_and_vmap_compatible():
 
 
 def test_use_pallas_auto_policy():
-    """use_pallas='auto' pins the measured v5e crossover (NEXT.md table):
-    flash at seq ≥ 2048 on TPU, dense below and off-TPU; explicit on/off and
+    """use_pallas='auto' pins the measured v5e crossovers (NEXT.md table):
+    flash at seq ≥ 2048 on TPU, the fused-boundary kernel at mid lengths
+    where it fits (r5), dense otherwise and off-TPU; explicit on/off and
     legacy bool config round-trips override."""
     from dalle_tpu.ops.flash_attention import resolve_use_pallas
-    assert resolve_use_pallas("auto", 4352, backend="tpu")
-    assert resolve_use_pallas("auto", 2048, backend="tpu")
-    assert not resolve_use_pallas("auto", 512, backend="tpu")
+    assert resolve_use_pallas("auto", 4352, backend="tpu") == "flash"
+    assert resolve_use_pallas("auto", 2048, backend="tpu") == "flash"
+    assert resolve_use_pallas("auto", 512, backend="tpu") == "fused"
+    # shapes whose fused backward busts scoped VMEM stay dense
+    assert not resolve_use_pallas("auto", 512, backend="tpu",
+                                  dim_head=128, heads=14)
     assert not resolve_use_pallas("auto", 4352, backend="cpu")
     assert resolve_use_pallas("on", 128, backend="cpu")
     assert resolve_use_pallas(True, 128)

@@ -1,0 +1,164 @@
+"""Fused-boundary attention (ops/fused_attention.py): forward and custom_vjp
+backward ≡ split + dense attend + autodiff, straight off the (b, n, 3·h·d)
+qkv layout (interpret mode on CPU; the on-chip build is exercised by the TPU
+bench)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dalle_tpu.ops.attention import attend
+from dalle_tpu.ops.fused_attention import fused_fits, fused_qkv_attention
+
+
+def _split(qkv, heads):
+    b, n, hd3 = qkv.shape
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    shape = (b, n, heads, hd3 // 3 // heads)
+    return [t.reshape(shape).transpose(0, 2, 1, 3) for t in (q, k, v)]
+
+
+def _merge(out):
+    b, h, n, d = out.shape
+    return out.transpose(0, 2, 1, 3).reshape(b, n, h * d)
+
+
+def _dense(qkv, heads, mask=None):
+    q, k, v = _split(qkv, heads)
+    static = None if mask is None else jnp.asarray(mask)
+    return _merge(attend(q, k, v, causal=True, static_mask=static))
+
+
+def test_forward_matches_dense_causal():
+    rng = np.random.RandomState(0)
+    qkv = jnp.asarray(rng.standard_normal((2, 48, 3 * 2 * 16)), jnp.float32)
+    out = fused_qkv_attention(qkv, None, 2, None, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(_dense(qkv, 2)),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_forward_matches_dense_with_mask():
+    from dalle_tpu.ops.attn_masks import axial_mask
+    rng = np.random.RandomState(1)
+    n = 4 + 16
+    qkv = jnp.asarray(rng.standard_normal((2, n, 3 * 2 * 16)), jnp.float32)
+    mask = axial_mask(4, 4, axis=0)
+    out = fused_qkv_attention(qkv, mask, 2, None, True)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_dense(qkv, 2, mask)),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_spec_path_matches_table_path():
+    """Structured axial/conv specs compute visibility from iotas in-kernel
+    (no table operand) and must agree with the shipped-table path AND dense,
+    fwd and bwd."""
+    from dalle_tpu.ops.attn_masks import build_mask
+    rng = np.random.RandomState(3)
+    text_len, fmap = 4, 4
+    n = text_len + fmap * fmap
+    qkv = jnp.asarray(rng.standard_normal((2, n, 3 * 2 * 16)), jnp.float32)
+    do = jnp.asarray(rng.standard_normal((2, n, 2 * 16)), jnp.float32)
+    for kind, spec in [
+            ("axial_row", ("axial", text_len, fmap, 0)),
+            ("axial_col", ("axial", text_len, fmap, 1)),
+            ("conv_like", ("conv", text_len, fmap, 3, 1))]:
+        mask = build_mask(kind, text_len, fmap, kernel_size=3)
+        via_table = fused_qkv_attention(qkv, mask, 2, None, True)
+        via_spec = fused_qkv_attention(qkv, mask, 2, None, True, spec)
+        np.testing.assert_allclose(np.asarray(via_spec),
+                                   np.asarray(via_table),
+                                   rtol=2e-2, atol=2e-2, err_msg=kind)
+        np.testing.assert_allclose(np.asarray(via_spec),
+                                   np.asarray(_dense(qkv, 2, mask)),
+                                   rtol=2e-2, atol=2e-2, err_msg=kind)
+        gs = jax.grad(lambda a: jnp.sum(
+            fused_qkv_attention(a, mask, 2, None, True, spec) * do))(qkv)
+        gd = jax.grad(lambda a: jnp.sum(_dense(a, 2, mask) * do))(qkv)
+        np.testing.assert_allclose(np.asarray(gs), np.asarray(gd),
+                                   rtol=5e-2, atol=5e-2, err_msg=kind)
+
+
+def test_backward_matches_autodiff():
+    rng = np.random.RandomState(2)
+    qkv = jnp.asarray(rng.standard_normal((2, 48, 3 * 2 * 16)), jnp.float32)
+    do = jnp.asarray(rng.standard_normal((2, 48, 2 * 16)), jnp.float32)
+
+    gk = jax.grad(lambda a: jnp.sum(
+        fused_qkv_attention(a, None, 2, None, True) * do))(qkv)
+    gd = jax.grad(lambda a: jnp.sum(_dense(a, 2) * do))(qkv)
+    # bf16 in-kernel dots vs f32 dense autodiff
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(gd),
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_resolve_tiers():
+    from dalle_tpu.ops.flash_attention import resolve_use_pallas
+    assert resolve_use_pallas("fused", 513, backend="tpu") == "fused"
+    assert resolve_use_pallas("fused", 2048, backend="tpu") is False
+    assert resolve_use_pallas("fused", 513, backend="cpu") is False
+    # auto now selects fused at mid lengths on TPU where it fits (r5:
+    # 0.458 vs 0.391 MFU on DALL·E-small); flash ≥ 2048 unchanged; shapes
+    # whose backward busts scoped VMEM (medium/flagship h·d) stay dense
+    assert resolve_use_pallas("auto", 513, backend="tpu") == "fused"
+    assert resolve_use_pallas("auto", 513, backend="tpu",
+                              dim_head=64, heads=16) is False
+    assert resolve_use_pallas("auto", 513, backend="tpu",
+                              dim_head=128, heads=14) is False
+    assert resolve_use_pallas("auto", 4096, backend="tpu") == "flash"
+    assert fused_fits(513, 64, 8) and not fused_fits(2048, 64, 8)
+    assert not fused_fits(513, 64, 16)
+
+
+def test_transformer_fused_mode_matches_dense():
+    """use_pallas='fused' routes the training forward (rotary ON — the
+    (b, n, 3h, d)-view rotary application) through the kernel and matches
+    the dense default."""
+    from dalle_tpu.config import TransformerConfig
+    from dalle_tpu.models.transformer import Transformer
+
+    kw = dict(seq_len=24, dim=32, depth=2, heads=2, dim_head=16,
+              image_fmap_size=4, rotary_emb=True)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 25, 32))
+    m1 = Transformer(TransformerConfig(use_pallas=False, **kw))
+    params = m1.init(jax.random.PRNGKey(1), x)
+    ref = m1.apply(params, x)
+    m2 = Transformer(TransformerConfig(use_pallas="fused", **kw))
+    import dalle_tpu.ops.flash_attention as fa
+    orig = fa.resolve_use_pallas
+    fa.resolve_use_pallas = lambda *a, **k2: "fused"
+    try:
+        out = m2.apply(params, x)
+    finally:
+        fa.resolve_use_pallas = orig
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=3e-2, atol=3e-2)
+
+
+def test_transformer_fused_grads_match_dense():
+    """End-to-end grads through the fused kernel ≡ dense autodiff (the
+    integration contract VERDICT r4 #1 names)."""
+    from dalle_tpu.config import TransformerConfig
+    from dalle_tpu.models.transformer import Transformer
+
+    kw = dict(seq_len=24, dim=32, depth=1, heads=2, dim_head=16,
+              image_fmap_size=4, rotary_emb=True)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 25, 32))
+    m1 = Transformer(TransformerConfig(use_pallas=False, **kw))
+    params = m1.init(jax.random.PRNGKey(1), x)
+
+    def loss(mod):
+        return lambda p: jnp.sum(mod.apply(p, x) ** 2)
+
+    gd = jax.grad(loss(m1))(params)
+    m2 = Transformer(TransformerConfig(use_pallas="fused", **kw))
+    import dalle_tpu.ops.flash_attention as fa
+    orig = fa.resolve_use_pallas
+    fa.resolve_use_pallas = lambda *a, **k2: "fused"
+    try:
+        gk = jax.grad(loss(m2))(params)
+    finally:
+        fa.resolve_use_pallas = orig
+    for a, b in zip(jax.tree.leaves(gk), jax.tree.leaves(gd)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=6e-2, atol=6e-2)

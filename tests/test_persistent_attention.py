@@ -60,10 +60,11 @@ def test_backward_matches_autodiff():
 def test_auto_policy_tiers():
     from dalle_tpu.ops.flash_attention import resolve_use_pallas
     assert resolve_use_pallas("auto", 4096, backend="tpu") == "flash"
-    # persist measured SLOWER end-to-end (docs/PERF_SMALL.md r4): auto keeps
-    # dense at mid lengths; "persist" is opt-in and VMEM-gated
-    assert resolve_use_pallas("auto", 513, backend="tpu") is False
-    assert resolve_use_pallas("auto", 128, backend="tpu") is False
+    # persist measured SLOWER end-to-end (docs/PERF_SMALL.md r4); its r5
+    # fused-boundary successor WINS (0.458 vs 0.391 MFU) and auto now
+    # selects it at mid lengths where it fits; "persist" stays opt-in
+    assert resolve_use_pallas("auto", 513, backend="tpu") == "fused"
+    assert resolve_use_pallas("auto", 128, backend="tpu") == "fused"
     assert resolve_use_pallas("persist", 513, backend="tpu") == "persist"
     assert resolve_use_pallas("persist", 1280, backend="tpu") is False
     assert resolve_use_pallas("persist", 513, backend="cpu") is False
