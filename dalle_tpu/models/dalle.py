@@ -247,9 +247,11 @@ class DALLE(nn.Module):
 
     # -- generation --------------------------------------------------------
     def _prefill(self, text, image_prime: Optional[jnp.ndarray], batch: int,
-                 dtype=jnp.float32):
+                 dtype=jnp.float32, extra_slots: int = 0):
         c = self.cfg
-        cache = self.transformer.init_cache(batch, c.total_seq_len, dtype)
+        cache = self.transformer.init_cache(batch,
+                                            c.total_seq_len + extra_slots,
+                                            dtype)
         text_b = self.remap_and_bos(text)
         tokens = self.embed_text(text_b)
         if image_prime is not None and image_prime.shape[1] > 0:
@@ -333,6 +335,137 @@ class DALLE(nn.Module):
         if image_prime is not None and n_prime > 0:
             out = jnp.concatenate([image_prime, out], axis=1)
         return out
+
+    def generate_images_tokens_speculative(
+            self, text, key, *, gamma: int = 4, draft: str = "row",
+            filter_thres: float = 0.5, temperature: float = 1.0,
+            cache_dtype=jnp.float32, topk_approx: bool = False,
+            return_stats: bool = False):
+        """Draft-free speculative AR sampling: each round drafts ``gamma``
+        tokens with a zero-cost image prior, verifies them in ONE windowed
+        forward (w = gamma+1 tokens ≈ the cost of a single decode step —
+        batched decode is weight/KV-bandwidth-bound, so extra window tokens
+        ride the same HBM streams), and commits the accepted prefix + one
+        token. Rows accept independently (per-row cache offsets/lengths).
+
+        Sampling semantics are EXACT for any draft quality: token t is
+        always argmax(top_k(logits_t)/T + gumbel(key_t_row)) with
+        logits_t computed from the committed prefix — rejected drafts only
+        cost wasted work, never bias (gamma=0 degenerates to the sequential
+        loop and must produce identical tokens; asserted by
+        tests/test_speculative.py). Keys are per-(step, row) fold-ins —
+        a different stream from generate_images_tokens' split chain, so
+        outputs match that path distributionally, not bitwise.
+
+        ``draft``: "row" = the committed token one grid-row above (the
+        2D-autoregressive prior — vertically continuous images accept
+        long runs); "repeat" = repeat the last sampled token (flat-region
+        prior). Reference bar: the strictly sequential generate_images loop
+        (dalle_pytorch/dalle_pytorch.py:523-546).
+
+        ``return_stats``: also return (rounds_used, committed_total) —
+        committed_total / (batch · rounds_used) is the per-row acceptance
+        rate in committed tokens per round."""
+        c = self.cfg
+        b = text.shape[0]
+        n_steps = c.image_seq_len
+        fmap = c.image_fmap_size
+        assert gamma >= 0
+        assert draft in ("row", "repeat")
+        if draft == "row":
+            assert gamma < fmap, (
+                f"'row' draft needs gamma < image_fmap_size ({fmap}); the "
+                f"row-above token of a draft slot must already be committed")
+        w = gamma + 1
+        arange_b = jnp.arange(b)
+
+        logits0, cache, prefix_len = self._prefill(
+            text, None, b, dtype=cache_dtype, extra_slots=gamma)
+
+        def sample_rows(logits, t_idx):
+            """Token at per-row step ``t_idx`` from (b, V) logits — the
+            committed key discipline key(step, row)."""
+            keys = jax.vmap(lambda t, r: jax.random.fold_in(
+                jax.random.fold_in(key, t), r))(t_idx, arange_b)
+            band = logits[:, self.num_text_tokens:]
+            filt = top_k_filter(band, thres=filter_thres, approx=topk_approx)
+            g = jax.vmap(lambda kk: jax.random.gumbel(
+                kk, (filt.shape[-1],), jnp.float32))(keys)
+            scaled = filt.astype(jnp.float32) / max(temperature, 1e-10)
+            return jnp.argmax(scaled + g, axis=-1).astype(jnp.int32)
+
+        def draft_tokens(tok0, out_buf, t_idx):
+            if gamma == 0:
+                return jnp.zeros((b, 0), jnp.int32)
+            p = t_idx[:, None] + jnp.arange(1, gamma + 1)[None, :]  # (b, γ)
+            if draft == "row":
+                src = jnp.clip(p - fmap, 0, n_steps - 1)
+                above = jnp.take_along_axis(out_buf, src, axis=1)
+                return jnp.where(p - fmap >= 0, above, tok0[:, None])
+            return jnp.broadcast_to(tok0[:, None], (b, gamma))
+
+        img_allow = self.logits_allow[c.text_seq_len]   # every image row ==
+
+        def finish_rows(y):
+            if c.stable:
+                y = self.norm_by_max(y)
+            logits = self._logits(y)
+            return jnp.where(img_allow[None, None], logits, MASK_VALUE)
+
+        def body(carry):
+            out_buf, t_idx, logits, cache, rounds, committed_total = carry
+            t_eff = jnp.minimum(t_idx, n_steps - 1)   # finished rows idle
+            tok0 = sample_rows(logits, t_eff)
+            drafts = draft_tokens(tok0, out_buf, t_eff)
+            window = jnp.concatenate([tok0[:, None], drafts], axis=1)
+            emb = self._embed_image_ids(window)
+            if not c.rotary_emb:
+                img_pos = t_eff[:, None] + jnp.arange(w)[None, :]
+                emb = emb + jnp.take(self.image_pos_emb(),
+                                     jnp.clip(img_pos, 0, n_steps - 1),
+                                     axis=0)
+            emb = self._stabilize(emb)
+            y, cache = self.transformer.decode_window(
+                emb, cache, prefix_len + t_eff)
+            logits_w = finish_rows(y)                    # (b, w, V)
+            cands = jnp.stack(
+                [sample_rows(logits_w[:, j], t_eff + 1 + j)
+                 for j in range(w)], axis=1)             # tokens t+1..t+w
+            if gamma > 0:
+                eq = (drafts == cands[:, :gamma]).astype(jnp.int32)
+                acc = jnp.cumprod(eq, axis=1).sum(axis=1)   # (b,) 0..γ
+            else:
+                acc = jnp.zeros((b,), jnp.int32)
+            # commit window[:, j] at index t+j for j ≤ acc (window[j] ==
+            # cands[j-1] wherever accepted); drop out-of-range / finished
+            idx = t_eff[:, None] + jnp.arange(w)[None, :]
+            keep = ((jnp.arange(w)[None, :] <= acc[:, None])
+                    & (idx < n_steps) & (t_idx[:, None] < n_steps))
+            safe_idx = jnp.where(keep, idx, n_steps)
+            out_buf = out_buf.at[arange_b[:, None], safe_idx].set(
+                window, mode="drop")
+            # carry logits after the LAST committed token: exact, because
+            # cache slots ≤ t+acc hold exactly the committed tokens
+            new_logits = jnp.take_along_axis(
+                logits_w, acc[:, None, None], axis=1)[:, 0]
+            # clamp at the sequence end: an accepted run crossing n_steps
+            # only commits the in-range part (its writes were dropped above)
+            step = jnp.where(t_idx < n_steps,
+                             jnp.minimum(acc + 1, n_steps - t_idx), 0)
+            return (out_buf, t_idx + step, new_logits, cache, rounds + 1,
+                    committed_total + step.sum())
+
+        def cond(carry):
+            return jnp.any(carry[1] < n_steps)
+
+        init = (jnp.zeros((b, n_steps), jnp.int32), jnp.zeros((b,), jnp.int32),
+                logits0, cache, jnp.zeros((), jnp.int32),
+                jnp.zeros((), jnp.int32))
+        out_buf, _, _, _, rounds, committed = jax.lax.while_loop(
+            cond, body, init)
+        if return_stats:
+            return out_buf, rounds, committed
+        return out_buf
 
     def generate_texts_tokens(self, key, text: Optional[jnp.ndarray] = None, *,
                               batch: int = 1, filter_thres: float = 0.5,

@@ -34,7 +34,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import TransformerConfig
-from ..ops.attention import KVCache, attend, cached_attend
+from ..ops.attention import (KVCache, attend, cached_attend,
+                             cached_attend_window)
 from ..ops.attn_masks import build_mask
 from ..ops.quantize_weights import QDense
 from ..ops.rotary import apply_rotary, dalle_pos_emb
@@ -235,6 +236,29 @@ class Attention(nn.Module):
         out = out.transpose(0, 2, 1, 3).reshape(b, 1, -1)
         return self.to_out(out), cache
 
+    def decode_window(self, x_w, cache: KVCache, offsets, *, rotary=None):
+        """Speculative verify step: ``w`` tokens per row at PER-ROW absolute
+        positions ``offsets[b] .. offsets[b]+w-1`` (offsets: (b,) traced) —
+        batch rows diverge because they accept different draft lengths.
+        Causality within the window + against the per-row cache prefix is
+        enforced by cached_attend_window; rotary rows are gathered per
+        (row, slot). Full attention only (no static masks — see
+        cached_attend_window)."""
+        b, w, _ = x_w.shape
+        q, k, v = self._split(self.to_qkv(x_w), w)
+        if rotary is not None:
+            # clamp: a window starting at the final position overshoots the
+            # table by up to w-1 slots (jnp.take's fill mode would NaN them);
+            # overshoot slots only ever hold rejected/never-committed drafts
+            pos = jnp.clip(offsets[:, None] + jnp.arange(w)[None, :],
+                           0, rotary.shape[0] - 1)               # (b, w)
+            rot = jnp.take(rotary, pos, axis=0)[:, None]         # (b,1,w,rot)
+            q, k, v = (apply_rotary(rot, t) for t in (q, k, v))
+        cache = cache.append_rows(k, v, offsets)
+        out = cached_attend_window(q, cache, offsets, stable=self.stable)
+        out = out.transpose(0, 2, 1, 3).reshape(b, w, -1)
+        return self.to_out(out), cache
+
 
 class ShiftState(NamedTuple):
     """Ring buffers for cached token-shift decode: the (top, left) quarter-chunks
@@ -395,6 +419,16 @@ class TransformerLayer(nn.Module):
         else:
             y = self.fn(y)
         return self._post(y), kv, shift_state
+
+    def decode_window(self, x_w, kv: Optional[KVCache], offsets, **kw):
+        """w-token speculative step (no token-shift: the ring buffers are
+        inherently one-token-sequential — gated at the Transformer level)."""
+        y = self.norm(x_w)
+        if isinstance(self.fn, Attention):
+            y, kv = self.fn.decode_window(y, kv, offsets, **kw)
+        else:
+            y = self.fn(y)
+        return self._post(y), kv
 
 
 class Transformer(nn.Module):
@@ -635,6 +669,29 @@ class Transformer(nn.Module):
                 cache[f"shift_ff_{ind}"] = ss
             x = x + y
         return x, cache
+
+    def decode_window(self, x_w, cache: Dict[str, Any], offsets):
+        """w tokens per row at per-row positions ``offsets`` (b,) — the
+        speculative verify forward (models/dalle.py). Requires full
+        attention and no token-shift (both hold for every generation config
+        the samplers build; sparse masks would need per-row mask gathers and
+        shift ring buffers are one-token-sequential by construction)."""
+        c = self.cfg
+        assert not c.shift_tokens, (
+            "speculative decode does not support shift_tokens")
+        assert all(k == "full" for k in self.mask_keys), (
+            "speculative decode supports full attention only, got "
+            f"{set(self.mask_keys)}")
+        cache = dict(cache)
+        for ind in range(c.depth):
+            attn_l, ff_l = self.attn_layers[ind], self.ff_layers[ind]
+            y, kv = attn_l.decode_window(x_w, cache[f"kv_{ind}"], offsets,
+                                         rotary=self.rotary)
+            cache[f"kv_{ind}"] = kv
+            x_w = x_w + y
+            y, _ = ff_l.decode_window(x_w, None, offsets)
+            x_w = x_w + y
+        return x_w, cache
 
     def decode_step(self, x_t, cache: Dict[str, Any], offset):
         """One token at traced position ``offset``. Returns (y_t, cache).

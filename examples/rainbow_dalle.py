@@ -105,6 +105,8 @@ def main(argv=None):
     dt.fit(dalle_batches(), steps=args.dalle_steps)
 
     # ---- stage 3: token-exact accuracy per split (cells 41-44) -----------
+    metrics = {}
+
     def accuracy(split_idx, name, n=32):
         sel = split_idx[:n]
         ids = dt.model.apply(dt.state.params, jnp.asarray(text[sel]),
@@ -115,6 +117,8 @@ def main(argv=None):
         per_pos = (np.asarray(ids) == codes[sel]).mean(axis=0)
         print(f"{name}: token-exact {exact:.3f}; "
               f"positions >0.8: {(per_pos > 0.8).mean():.2f}")
+        metrics[f"{name}_exact"] = float(exact)
+        metrics[f"{name}_pos_frac"] = float((per_pos > 0.8).mean())
         return np.asarray(ids)
 
     accuracy(tr_idx, "train")
@@ -128,8 +132,8 @@ def main(argv=None):
         for i, im in enumerate((out * 255).clip(0, 255).astype("uint8")):
             Image.fromarray(im).save(os.path.join(args.outdir, f"gen_{i}.png"))
         print(f"wrote samples to {args.outdir}")
-    return 0
+    return metrics
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(0 if main() else 1)

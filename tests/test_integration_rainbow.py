@@ -99,3 +99,30 @@ def test_rainbow_end_to_end(tmp_path):
     out = dv.generate_images(jnp.asarray(text[:2]), jax.random.PRNGKey(1),
                              temperature=0.5, filter_thres=0.9)
     assert out.shape == (2, 16, 16, 3) and bool(jnp.isfinite(out).all())
+
+
+@pytest.mark.slow
+def test_rainbow_heldout_generalization(tmp_path):
+    """The reference notebook's VALIDATION capability (VERDICT r4 #2,
+    rainbow_dalle.ipynb cells 23-44): train DALL·E on a 30% split of the
+    compositional shapes set and measure token-exact accuracy on the 70% of
+    caption combinations it never saw. Reference numbers: train ≈ 1.0,
+    held-out ≈ 0.3, per-position > 0.8. This framework's full-scale run
+    (examples/rainbow_dalle.py defaults, 1×v5e, r5) measured train 0.833 /
+    held-out 0.750 token-exact — recorded in NEXT.md. In-suite scale is
+    trimmed for the CPU mesh; the band asserts generalization is far above
+    the chance floor (1/num_tokens), not the full-scale numbers."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "examples"))
+    from rainbow_dalle import main as rainbow_main
+
+    metrics = rainbow_main([
+        "--image_size", "16", "--num_tokens", "32", "--vae_steps", "220",
+        "--dalle_steps", "450", "--dim", "96", "--depth", "3",
+        "--train_frac", "0.3", "--outdir", str(tmp_path)])
+    chance = 1.0 / 32
+    assert metrics["train_exact"] > 0.5, metrics
+    assert metrics["held-out_exact"] > 6 * chance, metrics   # ≫ chance floor
+    assert metrics["held-out_pos_frac"] >= 0.1, metrics

@@ -1,0 +1,98 @@
+"""Speculative multi-token decode (DALLE.generate_images_tokens_speculative):
+the acceptance machinery must be EXACT — gamma=0 (pure sequential under the
+same per-(step,row) key discipline) and any gamma>0 produce identical token
+sequences for any draft quality, trained or not. Reference bar: the strictly
+sequential generate_images loop (dalle_pytorch/dalle_pytorch.py:523-546)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_tpu.config import DalleConfig
+from dalle_tpu.models.dalle import DALLE, init_dalle
+
+CFG = dict(num_text_tokens=32, text_seq_len=6, dim=32, depth=2, heads=2,
+           dim_head=16, image_size=16, image_vocab_size=24, image_fmap_size=4)
+
+
+def _model(**kw):
+    cfg = DalleConfig(**{**CFG, **kw})
+    return init_dalle(cfg, jax.random.PRNGKey(0), batch=2)
+
+
+def _gen(model, params, text, key, **kw):
+    return np.asarray(model.apply(
+        params, text, key,
+        method=DALLE.generate_images_tokens_speculative, **kw))
+
+
+@pytest.mark.parametrize("draft", ["row", "repeat"])
+def test_gamma_matches_sequential_untrained(draft):
+    """Untrained model: acceptance ≈ chance, yet outputs must be identical —
+    rejection must never bias the sampled sequence."""
+    model, params = _model()
+    text = jnp.asarray([[3, 4, 5, 0, 0, 0], [7, 8, 0, 0, 0, 0]], jnp.int32)
+    key = jax.random.PRNGKey(42)
+    seq = _gen(model, params, text, key, gamma=0)
+    for gamma in (1, 3):
+        spec = _gen(model, params, text, key, gamma=gamma, draft=draft)
+        np.testing.assert_array_equal(spec, seq)
+    assert seq.shape == (2, 16) and (seq >= 0).all() and (seq < 24).all()
+
+
+def test_gamma_matches_sequential_axial_posemb():
+    """rotary off → axial positional embedding path through the window."""
+    model, params = _model(rotary_emb=False)
+    text = jnp.asarray([[3, 4, 5, 0, 0, 0], [7, 8, 0, 0, 0, 0]], jnp.int32)
+    key = jax.random.PRNGKey(7)
+    seq = _gen(model, params, text, key, gamma=0)
+    spec = _gen(model, params, text, key, gamma=2)
+    np.testing.assert_array_equal(spec, seq)
+
+
+def test_int8_cache_matches_and_stats():
+    """int8 KV storage through append_rows + window attend; stats plumbed."""
+    model, params = _model()
+    text = jnp.asarray([[3, 4, 5, 0, 0, 0], [7, 8, 0, 0, 0, 0]], jnp.int32)
+    key = jax.random.PRNGKey(3)
+    seq = _gen(model, params, text, key, gamma=0, cache_dtype=jnp.int8)
+    out, rounds, committed = model.apply(
+        params, text, key, gamma=3, cache_dtype=jnp.int8, return_stats=True,
+        method=DALLE.generate_images_tokens_speculative)
+    np.testing.assert_array_equal(np.asarray(out), seq)
+    assert int(committed) == 2 * 16
+    # worst case one token per row per round
+    assert 1 <= int(rounds) <= 16
+
+
+def test_trained_model_accepts_drafts():
+    """A model overfit to a constant image accepts 'repeat' drafts at a high
+    rate — rounds must drop well below the sequential count."""
+    import optax
+    model, params = _model()
+    text = jnp.asarray([[3, 4, 5, 0, 0, 0], [3, 4, 5, 0, 0, 0]], jnp.int32)
+    img = jnp.full((2, 16), 5, jnp.int32)     # constant image: repeat-friendly
+    tx = optax.adam(2e-3)
+    state = tx.init(params)
+
+    @jax.jit
+    def step(params, state):
+        def loss_fn(p):
+            loss, _ = model.apply(p, text, img, return_loss=True)
+            return loss
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        upd, state2 = tx.update(grads, state)
+        return optax.apply_updates(params, upd), state2, loss
+
+    for _ in range(150):
+        params, state, loss = step(params, state)
+    key = jax.random.PRNGKey(1)
+    seq = _gen(model, params, text, key, gamma=0, temperature=0.2)
+    out, rounds, committed = model.apply(
+        params, text, key, gamma=3, draft="repeat", temperature=0.2,
+        return_stats=True, method=DALLE.generate_images_tokens_speculative)
+    np.testing.assert_array_equal(np.asarray(out), seq)
+    assert (np.asarray(out) == 5).mean() > 0.9, "model failed to overfit"
+    # 16 tokens at ≥2 committed/round on average → ≤ 8-ish rounds; allow slack
+    assert int(rounds) <= 10, f"no speculation win on overfit model: {rounds}"
