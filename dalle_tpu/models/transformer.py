@@ -147,20 +147,26 @@ class Attention(nn.Module):
             # re-validates with the RUNTIME n (resolve saw cfg.seq_len) so
             # a stale/defaulted resolve can never reach a failing Mosaic
             # compile — unfit shapes fall through to dense.
-            from ..ops.fused_attention import (fused_fits,
-                                               fused_qkv_attention, use_spec)
-            ships_table = np_mask is not None and not use_spec(mask_spec)
-            if fused_fits(n, self.dim_head, self.heads,
-                          has_mask=ships_table):
+            from ..ops.fused_attention import (fused_fits, fused_fwd_fits,
+                                               fused_qkv_attention,
+                                               fused_qkv_attention_xbwd)
+            if fused_fits(n, self.dim_head, self.heads):
+                fn = fused_qkv_attention           # Pallas fwd + Pallas bwd
+            elif fused_fwd_fits(n, self.dim_head, self.heads):
+                # shapes whose backward busts scoped VMEM (medium h·d):
+                # Pallas fwd + boundary-free XLA bwd
+                fn = fused_qkv_attention_xbwd
+            else:
+                fn = None
+            if fn is not None:
                 qkv = self.to_qkv(x)
                 if rotary is not None:
                     rot = rotary[:n][:, None]          # (n, 1, rot_dim)
                     qkv = apply_rotary(
                         rot, qkv.reshape(b, n, 3 * self.heads, self.dim_head)
                     ).reshape(b, n, -1)
-                out = fused_qkv_attention(
-                    qkv, np_mask, self.heads, None, None,
-                    mask_spec).astype(x.dtype)
+                out = fn(qkv, np_mask, self.heads, None, None,
+                         mask_spec).astype(x.dtype)
                 return self.drop(self.to_out(out),
                                  deterministic=deterministic)
         q, k, v = self._split(self.to_qkv(x), n)

@@ -482,7 +482,7 @@ def resolve_use_pallas(setting, seq_len: int, backend: Optional[str] = None,
     (docs/PERF_SMALL.md r4 addendum). "fused" selects its r5 successor
     (ops/fused_attention.py) whose boundary is the qkv projection's own
     (b, n, 3·h·d) layout."""
-    from .fused_attention import fused_fits
+    from .fused_attention import fused_fits, fused_fwd_fits
     from .persistent_attention import persistent_fits
     if setting is True:
         return "flash"
@@ -503,15 +503,19 @@ def resolve_use_pallas(setting, seq_len: int, backend: Optional[str] = None,
         # MFU end-to-end on DALL·E-small (r5; the per-(b,h) persistent kernel
         # lost this same comparison to boundary tax in r4). Configs whose
         # backward exceeds scoped VMEM (e.g. h·d ≥ 1024 at n=513 — the
-        # medium/1.4B shapes) keep dense.
-        if fused_fits(seq_len, dim_head, heads, has_mask=True):
+        # medium/1.4B shapes) keep dense: the fwd-kernel/XLA-bwd fallback
+        # measured 0.512 vs dense 0.525 on medium (PERF_SMALL r5 addendum 2),
+        # so auto only takes the full-kernel tier.
+        if fused_fits(seq_len, dim_head, heads):
             return "fused"
         return False
     if s == "fused":
         if backend is None:
             backend = jax.default_backend()
+        # explicit request also admits the fwd-kernel/XLA-bwd tier
+        # (Attention picks the concrete variant from the runtime shape)
         return ("fused" if backend == "tpu"
-                and fused_fits(seq_len, dim_head, heads, has_mask=True)
+                and fused_fwd_fits(seq_len, dim_head, heads)
                 else False)
     if s == "persist":
         if backend is None:

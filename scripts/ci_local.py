@@ -9,7 +9,8 @@ steps that need the network (pip installs), which are SKIPPED with a
 recorded reason. A green run proves the workflow's commands are executable
 as written against this checkout.
 
-Run: python scripts/ci_local.py [--fast]   (--fast trims pytest to -m "not slow")
+Run: python scripts/ci_local.py   (the workflow's pytest step already runs
+the fast tier — pyproject addopts default to -m "not slow")
 """
 
 import argparse
