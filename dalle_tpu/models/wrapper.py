@@ -98,7 +98,9 @@ class DalleWithVae:
                         num_init_img_tokens: Optional[int] = None,
                         clip: Optional[tuple] = None,
                         precision: str = "float32",
-                        topk_approx: bool = False):
+                        topk_approx: bool = False,
+                        speculative: int = 0,
+                        draft: str = "row"):
         """text (b, text_seq_len) → images (b, H, W, C) in [0,1]; optionally
         (images, clip_scores). ``img`` primes the first 43.75% of image tokens
         (reference :510-519, OpenAI's 14/32 rows). ``precision="bfloat16"``
@@ -113,7 +115,14 @@ class DalleWithVae:
         per-step top-k sort for TPU's approximate top-k unit
         (ops/sampling.top_k_filter). Sampling stays on f32 logits in every
         mode; token-exact accuracy on a trained model is validated per mode
-        by scripts/eval_decode_precisions.py."""
+        by scripts/eval_decode_precisions.py.
+
+        ``speculative=γ > 0`` decodes via the draft-and-verify sampler
+        (DALLE.generate_images_tokens_speculative — measured p50 0.366 →
+        0.281 s at b64/γ=2 on a trained model, sampling exact for any draft
+        quality); requires cond_scale == 1.0 and no image priming, and uses
+        a per-(step, row) key stream (same distribution as the sequential
+        loop, different bits)."""
         prime = None
         if img is not None:
             n_prime = num_init_img_tokens
@@ -153,11 +162,25 @@ class DalleWithVae:
             params = cache[1][mode]
             cache_dtype = (jnp.int8 if precision in ("bf16_int8kv", "int8w")
                            else jnp.bfloat16)
-        ids = self.model.apply(
-            params, text, key, filter_thres=filter_thres,
-            temperature=temperature, cond_scale=cond_scale, image_prime=prime,
-            cache_dtype=cache_dtype, topk_approx=topk_approx,
-            method=DALLE.generate_images_tokens)
+        if speculative > 0:
+            if cond_scale != 1.0 or prime is not None:
+                # not an assert: -O must not silently drop the user's CFG
+                raise ValueError(
+                    "speculative decode supports cond_scale=1.0 and no "
+                    "image priming (CFG would need a second verified window "
+                    "per round)")
+            ids = self.model.apply(
+                params, text, key, gamma=speculative, draft=draft,
+                filter_thres=filter_thres, temperature=temperature,
+                cache_dtype=cache_dtype, topk_approx=topk_approx,
+                method=DALLE.generate_images_tokens_speculative)
+        else:
+            ids = self.model.apply(
+                params, text, key, filter_thres=filter_thres,
+                temperature=temperature, cond_scale=cond_scale,
+                image_prime=prime, cache_dtype=cache_dtype,
+                topk_approx=topk_approx,
+                method=DALLE.generate_images_tokens)
         images = self.vae.decode(ids)
         if clip is not None:
             clip_model, clip_params = clip

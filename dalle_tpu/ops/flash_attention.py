@@ -504,8 +504,9 @@ def resolve_use_pallas(setting, seq_len: int, backend: Optional[str] = None,
         # lost this same comparison to boundary tax in r4). Configs whose
         # backward exceeds scoped VMEM (e.g. h·d ≥ 1024 at n=513 — the
         # medium/1.4B shapes) keep dense: the fwd-kernel/XLA-bwd fallback
-        # measured 0.512 vs dense 0.525 on medium (PERF_SMALL r5 addendum 2),
-        # so auto only takes the full-kernel tier.
+        # measured PARITY on medium (+0.6-0.8% paired, inside the ±3%
+        # session noise — PERF_SMALL r5 addendum 2), not worth auto
+        # admission; only the full-kernel tier auto-selects.
         if fused_fits(seq_len, dim_head, heads):
             return "fused"
         return False

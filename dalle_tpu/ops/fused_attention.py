@@ -46,11 +46,9 @@ NEG_INF = -1e9
 _VMEM_BUDGET = 14 * 1024 * 1024
 
 
-def fused_fits(n: int, dim_head: int, heads: int,
-               has_mask: bool = True) -> bool:
-    """Backward-pass VMEM bound (the larger of the two passes). The int8
-    validity-table window (2·n² double-buffered) is always shipped;
-    ``has_mask`` is kept for signature stability."""
+def fused_fits(n: int, dim_head: int, heads: int) -> bool:
+    """Backward-pass VMEM bound (the larger of the two passes); the int8
+    validity-table window (2·n² double-buffered) is always shipped."""
     hd = heads * dim_head
     bytes_ = 34 * n * hd + 12 * n * n + 2 * n * n
     return bytes_ <= _VMEM_BUDGET
@@ -235,8 +233,7 @@ fused_qkv_attention.defvjp(
 # boundary tax was a property of materializing (b, h, n, d) AROUND an
 # opaque kernel, not of the dense math itself).
 
-def fused_fwd_fits(n: int, dim_head: int, heads: int,
-                   has_mask: bool = True) -> bool:
+def fused_fwd_fits(n: int, dim_head: int, heads: int) -> bool:
     """Forward-pass VMEM bound: 2x (qkv + out) bf16 windows + score tiles
     + the always-shipped int8 validity-table window."""
     hd = heads * dim_head

@@ -45,6 +45,15 @@ def build_parser():
     ap.add_argument("--int8w", action="store_true",
                     help="int8 matmul weights + int8 KV for the decode loop "
                          "(per-channel scales; halves weight HBM traffic)")
+    ap.add_argument("--speculative", type=int, default=0, metavar="GAMMA",
+                    help="draft-and-verify decode with GAMMA drafts/round "
+                         "(measured 0.366->0.281s p50 at b64/gamma=2 on a "
+                         "trained model; sampling-exact; needs "
+                         "cond_scale=1.0)")
+    ap.add_argument("--draft", type=str, default="row",
+                    choices=("row", "repeat"),
+                    help="speculative draft prior: token one grid-row above "
+                         "| repeat last token")
     ap.add_argument("--fast_topk", action="store_true",
                     help="approximate per-step top-k via the TPU topk unit "
                          "(exact sort is ~17%% of decode time at batch 64)")
@@ -145,7 +154,8 @@ def main(argv=None):
                 precision=("int8w" if args.int8w
                            else "bf16_int8kv" if args.kv_int8
                            else "bfloat16" if args.bf16 else "float32"),
-                topk_approx=args.fast_topk)
+                topk_approx=args.fast_topk,
+                speculative=args.speculative, draft=args.draft)
             if clip is not None:
                 # reranking needs the whole set — accumulate
                 imgs, scores = out

@@ -66,6 +66,31 @@ def test_int8_cache_matches_and_stats():
     assert 1 <= int(rounds) <= 16
 
 
+def test_wrapper_speculative_route():
+    """DalleWithVae.generate_images(speculative=γ) routes through the
+    draft-and-verify sampler end-to-end (ids → VAE decode), and rejects
+    CFG."""
+    from dalle_tpu.config import DVAEConfig
+    from dalle_tpu.models.dvae import DiscreteVAE
+    from dalle_tpu.models.wrapper import DalleWithVae, DiscreteVAEAdapter
+
+    model, params = _model()
+    vcfg = DVAEConfig(image_size=16, num_tokens=24, codebook_dim=16,
+                      num_layers=2, hidden_dim=16, num_resnet_blocks=1)
+    vae_model = DiscreteVAE(vcfg)
+    vparams = vae_model.init(jax.random.PRNGKey(0),
+                             jnp.zeros((1, 16, 16, 3)))
+    vae = DiscreteVAEAdapter(vae_model, vparams)
+    dv = DalleWithVae(model, params, vae)
+    text = jnp.asarray([[3, 4, 5, 0, 0, 0], [7, 8, 0, 0, 0, 0]], jnp.int32)
+    out = dv.generate_images(text, jax.random.PRNGKey(2), speculative=2,
+                             precision="bf16_int8kv")
+    assert out.shape == (2, 16, 16, 3) and bool(jnp.isfinite(out).all())
+    with pytest.raises(ValueError):
+        dv.generate_images(text, jax.random.PRNGKey(2), speculative=2,
+                           cond_scale=2.0)
+
+
 def test_trained_model_accepts_drafts():
     """A model overfit to a constant image accepts 'repeat' drafts at a high
     rate — rounds must drop well below the sequential count."""
