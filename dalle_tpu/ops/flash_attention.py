@@ -499,14 +499,11 @@ def resolve_use_pallas(setting, seq_len: int, backend: Optional[str] = None,
             return False
         if seq_len >= PALLAS_AUTO_MIN_SEQ:
             return "flash"
-        # mid-length tier: the fused-boundary kernel measures 0.458 vs 0.391
-        # MFU end-to-end on DALL·E-small (r5; the per-(b,h) persistent kernel
-        # lost this same comparison to boundary tax in r4). Configs whose
-        # backward exceeds scoped VMEM (e.g. h·d ≥ 1024 at n=513 — the
-        # medium/1.4B shapes) keep dense: the fwd-kernel/XLA-bwd fallback
-        # measured PARITY on medium (+0.6-0.8% paired, inside the ±3%
-        # session noise — PERF_SMALL r5 addendum 2), not worth auto
-        # admission; only the full-kernel tier auto-selects.
+        # mid-length tier: the fused-boundary kernel measures 0.458 vs
+        # 0.391 MFU on DALL·E-small and 0.638 vs 0.523 on medium (the
+        # merged backward compiles under the RAISED Mosaic vmem ceiling —
+        # PERF_SMALL r5 addenda). fused_fits stops where the win stops:
+        # the flagship h·d=1792 shape measured parity and stays dense.
         if fused_fits(seq_len, dim_head, heads):
             return "fused"
         return False

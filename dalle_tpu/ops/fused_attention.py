@@ -44,14 +44,50 @@ NEG_INF = -1e9
 # at 25.68M by the compiler — the budget below accepts the former and
 # rejects the latter with headroom.
 _VMEM_BUDGET = 14 * 1024 * 1024
+# Mosaic's default scoped-vmem ceiling is 16M, but it is a COMPILER DEFAULT,
+# not hardware: pallas_call(compiler_params=CompilerParams(vmem_limit_bytes=
+# 32M)) compiles the medium (h·d=1024) merged backward that the default
+# rejected at 25.68M demand (r5). Kernels whose estimated demand exceeds the
+# default budget request the raised limit; the hard gate below keeps shapes
+# that would bust even that (flagship h·d=1792 bwd ≈ 35M) on dense.
+# raised tiers for the ceiling request: 32M covers the medium merged
+# backward (25.68M demand); 48M serves near-budget estimates that need the
+# extra headroom (see _compiler_params) and explicit experiments at the
+# flagship-head shape (~35M — compiles, measured PARITY: 0.622 vs 0.622 at
+# d=128, where dense attention is already MXU-efficient). The AUTO budget
+# admits shapes whose merged kernel measured a win: small (+17%) and
+# medium (+22%, 0.638 vs 0.523 MFU); the flagship headline stays dense.
+_VMEM_RAISED_LIMITS = ((30 * 1024 * 1024, 32 * 1024 * 1024),
+                       (44 * 1024 * 1024, 48 * 1024 * 1024))
+_VMEM_RAISED_BUDGET = 30 * 1024 * 1024
+
+
+def _bwd_bytes(n: int, hd: int) -> int:
+    return 34 * n * hd + 12 * n * n + 2 * n * n
 
 
 def fused_fits(n: int, dim_head: int, heads: int) -> bool:
-    """Backward-pass VMEM bound (the larger of the two passes); the int8
-    validity-table window (2·n² double-buffered) is always shipped."""
-    hd = heads * dim_head
-    bytes_ = 34 * n * hd + 12 * n * n + 2 * n * n
-    return bytes_ <= _VMEM_BUDGET
+    """Backward-pass VMEM bound (the larger of the two passes) against the
+    RAISED Mosaic limit; the int8 validity-table window (2·n²
+    double-buffered) is always shipped."""
+    return _bwd_bytes(n, heads * dim_head) <= _VMEM_RAISED_BUDGET
+
+
+def _compiler_params(bytes_estimate: int):
+    """Request the smallest raised scoped-vmem ceiling with ≥25% headroom
+    over the ESTIMATE — the formula underestimates the compiler's real
+    demand by ~19% at the calibration point (21.55M estimated vs 25.68M
+    reported for medium), so a ceiling chosen without headroom could admit
+    a shape whose true demand busts it with no dense fallback. Small
+    shapes keep the default pipeline headroom."""
+    from jax.experimental.pallas import tpu as pltpu
+    if bytes_estimate <= _VMEM_BUDGET:
+        return None
+    need = bytes_estimate + bytes_estimate // 4
+    for _, limit in _VMEM_RAISED_LIMITS:
+        if need <= limit:
+            return pltpu.CompilerParams(vmem_limit_bytes=limit)
+    return pltpu.CompilerParams(vmem_limit_bytes=_VMEM_RAISED_LIMITS[-1][1])
 
 
 def use_spec(mask_spec) -> bool:
@@ -190,6 +226,7 @@ def _fused_fwd(qkv, mask, heads, scale, interpret, mask_spec=None):
         in_specs=[qkv_spec, mspec],
         out_specs=out_spec,
         out_shape=jax.ShapeDtypeStruct((b, n, hd), qkv.dtype),
+        compiler_params=_compiler_params(18 * n * hd + 10 * n * n),
         interpret=_interp(interpret),
     )(qkv.astype(jnp.bfloat16), jnp.asarray(tbl))
     return out, (qkv,)
@@ -210,6 +247,7 @@ def _fused_bwd(mask, heads, scale, interpret, mask_spec, res, do):
         in_specs=[qkv_spec, out_spec, mspec],
         out_specs=qkv_spec,
         out_shape=jax.ShapeDtypeStruct((b, n, hd3), qkv.dtype),
+        compiler_params=_compiler_params(_bwd_bytes(n, hd)),
         interpret=_interp(interpret),
     )(qkv.astype(jnp.bfloat16), do.astype(jnp.bfloat16), jnp.asarray(tbl))
     return (dqkv,)
@@ -234,11 +272,12 @@ fused_qkv_attention.defvjp(
 # opaque kernel, not of the dense math itself).
 
 def fused_fwd_fits(n: int, dim_head: int, heads: int) -> bool:
-    """Forward-pass VMEM bound: 2x (qkv + out) bf16 windows + score tiles
-    + the always-shipped int8 validity-table window."""
+    """Forward-pass VMEM bound (2x (qkv + out) bf16 windows + score tiles
+    + the always-shipped int8 validity-table window) against the raised
+    Mosaic ceiling — the gate for the fwd-kernel/XLA-bwd tier."""
     hd = heads * dim_head
     bytes_ = 18 * n * hd + 8 * n * n + 2 * n * n
-    return bytes_ <= _VMEM_BUDGET
+    return bytes_ <= _VMEM_RAISED_BUDGET
 
 
 def _dense_bwd(mask, heads, scale, interpret, mask_spec, res, do):

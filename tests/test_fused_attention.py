@@ -144,23 +144,25 @@ def test_resolve_tiers():
     assert resolve_use_pallas("fused", 513, backend="tpu") == "fused"
     assert resolve_use_pallas("fused", 2048, backend="tpu") is False
     assert resolve_use_pallas("fused", 513, backend="cpu") is False
-    # auto now selects fused at mid lengths on TPU where it fits (r5:
-    # 0.458 vs 0.391 MFU on DALL·E-small); flash ≥ 2048 unchanged; shapes
-    # whose backward busts scoped VMEM (medium/flagship h·d) stay dense
+    # auto selects fused where the merged kernel fits under the RAISED
+    # Mosaic vmem ceiling and measured a win: small (0.458 vs 0.391 MFU)
+    # and medium (0.638 vs 0.523 — the 32M-limit backward). The flagship
+    # h·d=1792 shape measured PARITY and stays dense; flash ≥ 2048
+    # unchanged.
     assert resolve_use_pallas("auto", 513, backend="tpu") == "fused"
     assert resolve_use_pallas("auto", 513, backend="tpu",
-                              dim_head=64, heads=16) is False
+                              dim_head=64, heads=16) == "fused"
     assert resolve_use_pallas("auto", 513, backend="tpu",
                               dim_head=128, heads=14) is False
     assert resolve_use_pallas("auto", 4096, backend="tpu") == "flash"
     assert fused_fits(513, 64, 8) and not fused_fits(2048, 64, 8)
-    assert not fused_fits(513, 64, 16)
-    # explicit "fused" admits the fwd-kernel/XLA-bwd tier for medium shapes
-    # (auto stays conservative until the tier is measured end-to-end)
+    assert fused_fits(513, 64, 16) and not fused_fits(513, 128, 14)
+    # explicit "fused" additionally admits the fwd-kernel/XLA-bwd tier
+    # (e.g. the flagship shape, measured at parity)
     assert resolve_use_pallas("fused", 513, backend="tpu",
-                              dim_head=64, heads=16) == "fused"
+                              dim_head=128, heads=14) == "fused"
     from dalle_tpu.ops.fused_attention import fused_fwd_fits
-    assert fused_fwd_fits(513, 64, 16) and not fused_fwd_fits(513, 128, 14)
+    assert fused_fwd_fits(513, 64, 16) and fused_fwd_fits(513, 128, 14)
 
 
 def test_transformer_fused_mode_matches_dense():
