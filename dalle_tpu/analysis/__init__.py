@@ -1,0 +1,37 @@
+"""graftlint — a JAX/TPU-aware static-analysis pass for this codebase.
+
+Off-the-shelf linters know nothing about the failure modes that actually
+bite a JAX/Pallas repo: PRNG key reuse that silently correlates samples,
+``static_argnums`` fed fresh unhashable objects (recompile storms),
+host syncs inside jitted functions, and VMEM ceilings drifting away from
+the kernel estimators they were calibrated against (see the b695782
+scoped-vmem work). Each of those is a rule here.
+
+Public surface:
+  * :func:`run_lint` — lint a set of files (or the whole repo) and return
+    :class:`Finding` objects.
+  * :data:`RULES` — the rule registry (name → rule instance).
+  * ``# graftlint: disable=<rule>[,<rule>]`` — per-line suppression, on the
+    offending line or the line directly above it.
+
+The runtime companion (jit-recompilation budgets for tests) lives in
+:mod:`dalle_tpu.analysis.recompile_guard`.
+"""
+
+from .core import (  # noqa: F401
+    Finding,
+    FileContext,
+    Rule,
+    ProjectRule,
+    RULES,
+    register_rule,
+    iter_repo_files,
+    run_lint,
+)
+
+# importing the rule modules populates the registry
+from . import rules_rng  # noqa: F401,E402
+from . import rules_except  # noqa: F401,E402
+from . import rules_jit  # noqa: F401,E402
+from . import rules_vmem  # noqa: F401,E402
+from . import rules_coverage  # noqa: F401,E402

@@ -1,0 +1,244 @@
+"""Rule registry, per-file context, suppression comments, and the runner.
+
+Two rule kinds:
+  * :class:`Rule` — checked once per file (AST + source in a
+    :class:`FileContext`); scoped by repo-relative path prefixes.
+  * :class:`ProjectRule` — checked once per run against the whole file set
+    (cross-file invariants: estimator/ceiling drift, test coverage).
+
+Suppression: ``# graftlint: disable=rule-a,rule-b`` on the finding's line or
+the line directly above it silences those rules for that line. There is no
+file-level or repo-level disable on purpose — a suppression should sit next
+to the code it excuses, where review sees both.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import subprocess
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# default lint surface: library + entry points. tests/ are read by
+# project rules (coverage) but not file-linted — test code legitimately
+# hard-codes keys and catches broadly around expected failures.
+DEFAULT_ROOTS = ("dalle_tpu", "scripts")
+
+_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([\w\-,\s]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str        # repo-relative, '/'-separated
+    line: int        # 1-indexed
+    message: str
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class FileContext:
+    """Parsed view of one source file: AST, raw lines, suppressions."""
+
+    def __init__(self, rel_path: str, source: str):
+        self.rel_path = rel_path.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=rel_path)
+        # suppressions come from REAL comment tokens, not raw line text — a
+        # string that merely quotes the directive must not open a silent
+        # false-negative hole on its line
+        self._suppressed: Dict[int, Tuple[str, ...]] = {}
+        try:
+            tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+        except (tokenize.TokenError, IndentationError):
+            tokens = []
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if m:
+                rules = tuple(r.strip() for r in m.group(1).split(",") if r.strip())
+                self._suppressed[tok.start[0]] = rules
+
+    def is_suppressed(self, line: int, rule: str) -> bool:
+        for at in (line, line - 1):
+            rules = self._suppressed.get(at)
+            if rules and (rule in rules or "all" in rules):
+                return True
+        return False
+
+
+class Rule:
+    """Per-file rule. Subclasses set ``name``/``description``/``include``
+    and implement :meth:`check`."""
+
+    name: str = ""
+    description: str = ""
+    # repo-relative path prefixes this rule applies to (tuple of str)
+    include: Tuple[str, ...] = DEFAULT_ROOTS
+    exclude: Tuple[str, ...] = ()
+
+    def applies_to(self, rel_path: str) -> bool:
+        if not any(rel_path.startswith(p) for p in self.include):
+            return False
+        return not any(rel_path.startswith(p) for p in self.exclude)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    # final, suppression-aware entry point used by the runner
+    def run(self, ctx: FileContext) -> List[Finding]:
+        if not self.applies_to(ctx.rel_path):
+            return []
+        return [f for f in self.check(ctx)
+                if not ctx.is_suppressed(f.line, self.name)]
+
+
+class ProjectRule(Rule):
+    """Whole-project rule. ``check_project`` receives every in-scope
+    FileContext plus the repo root being linted; per-file ``check`` is
+    unused."""
+
+    # which changed paths make this rule worth re-running in --changed-only
+    triggers: Tuple[str, ...] = DEFAULT_ROOTS
+
+    def check_project(self, ctxs: Sequence[FileContext],
+                      repo_root: str) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def run_project(self, ctxs: Sequence[FileContext],
+                    repo_root: str = REPO_ROOT) -> List[Finding]:
+        by_path = {c.rel_path: c for c in ctxs}
+        out = []
+        for f in self.check_project(ctxs, repo_root):
+            ctx = by_path.get(f.path)
+            if ctx is not None and ctx.is_suppressed(f.line, self.name):
+                continue
+            out.append(f)
+        return out
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register_rule(cls):
+    """Class decorator: instantiate and add to the registry."""
+    inst = cls()
+    assert inst.name and inst.name not in RULES, f"bad rule {cls}"
+    RULES[inst.name] = inst
+    return cls
+
+
+def iter_repo_files(roots: Sequence[str] = DEFAULT_ROOTS,
+                    repo_root: str = REPO_ROOT) -> List[str]:
+    """Repo-relative paths of every .py file under ``roots``."""
+    out = []
+    for root in roots:
+        base = os.path.join(repo_root, root)
+        if os.path.isfile(base) and base.endswith(".py"):
+            out.append(os.path.relpath(base, repo_root))
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.relpath(os.path.join(dirpath, fn),
+                                               repo_root))
+    return sorted(p.replace(os.sep, "/") for p in out)
+
+
+def changed_files(repo_root: str = REPO_ROOT) -> List[str]:
+    """Repo-relative .py paths touched vs HEAD (staged, unstaged, untracked).
+
+    Deleted paths are INCLUDED: they no longer exist to file-lint (and are
+    naturally absent from the walked file set), but they must still fire
+    project-rule triggers — deleting a test file is exactly how ops lose
+    coverage.
+
+    Raises on git failure: treating "git broke" as "nothing changed" would
+    make --changed-only print 0 findings and exit green having linted
+    nothing — the same silent-hole the CLI hard-errors unknown --select
+    names to avoid."""
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            cwd=repo_root, capture_output=True, text=True, check=True).stdout
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=repo_root, capture_output=True, text=True, check=True).stdout
+    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        raise RuntimeError(
+            f"--changed-only cannot determine changed files (git failed: "
+            f"{e}); run the full lint instead") from e
+    paths = {p.strip() for p in (diff + untracked).splitlines() if p.strip()}
+    return sorted(p for p in paths if p.endswith(".py"))
+
+
+def load_context(rel_path: str, repo_root: str = REPO_ROOT) -> Optional[FileContext]:
+    with open(os.path.join(repo_root, rel_path), encoding="utf-8") as fh:
+        src = fh.read()
+    try:
+        return FileContext(rel_path, src)
+    except SyntaxError:
+        return None  # a syntax error is the compiler's finding, not ours
+
+
+def run_lint(paths: Optional[Sequence[str]] = None,
+             select: Optional[Sequence[str]] = None,
+             ignore: Optional[Sequence[str]] = None,
+             changed_only: bool = False,
+             repo_root: str = REPO_ROOT) -> List[Finding]:
+    """Lint ``paths`` (repo-relative; default: the standard roots).
+
+    ``changed_only`` narrows file rules to git-changed files; project rules
+    still run when any of their trigger paths changed (they are cross-file
+    invariants — a partial view would produce false positives).
+    """
+    rules = [r for r in RULES.values()
+             if (select is None or r.name in select)
+             and (ignore is None or r.name not in ignore)]
+    file_rules = [r for r in rules if not isinstance(r, ProjectRule)]
+    project_rules = [r for r in rules if isinstance(r, ProjectRule)]
+
+    default_paths = iter_repo_files(repo_root=repo_root)
+    lint_paths = list(paths) if paths is not None else list(default_paths)
+    changed: Optional[List[str]] = None
+    if changed_only:
+        changed = changed_files(repo_root)
+        lint_paths = [p for p in lint_paths if p in set(changed)]
+
+    findings: List[Finding] = []
+    ctxs: List[FileContext] = []
+    for p in lint_paths:
+        ctx = load_context(p, repo_root)
+        if ctx is None:
+            findings.append(Finding("parse-error", p, 1, "file does not parse"))
+            continue
+        ctxs.append(ctx)
+        for rule in file_rules:
+            findings.extend(rule.run(ctx))
+
+    # project rules ALWAYS see the full in-scope file set — a partial view
+    # (explicit paths or changed-only) would miss cross-file drift and
+    # misattribute findings; loaded lazily, once for all of them
+    full: Optional[List[FileContext]] = (
+        ctxs if lint_paths == default_paths else None)
+    for rule in project_rules:
+        if changed is not None and not any(
+                p.startswith(rule.triggers) for p in changed):
+            continue
+        if full is None:
+            full = [c for c in (load_context(p, repo_root)
+                                for p in default_paths) if c is not None]
+        findings.extend(rule.run_project(full, repo_root))
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

@@ -1,0 +1,199 @@
+"""Shared AST helpers: find jit-wrapped functions and their jit options.
+
+Recognized spellings (the ones this repo uses):
+
+  @jax.jit                                   decorator
+  @partial(jax.jit, static_argnums=...)      via functools.partial or partial
+  @functools.partial(jax.jit, ...)
+  g = jax.jit(f, static_argnums=...)         call form, named or lambda
+  g = partial(jax.jit, ...)(f)               curried call form
+
+``nn.remat``/``jax.checkpoint`` are deliberately NOT matched — their
+static_argnums semantics differ and their bodies re-trace by design.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'jax.random.split' for nested Attribute/Name chains, '' otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    return dotted_name(node) in ("jax.jit", "jit", "pjit", "jax.pjit")
+
+
+def _is_partial(node: ast.AST) -> bool:
+    return dotted_name(node) in ("partial", "functools.partial")
+
+
+@dataclasses.dataclass
+class JitInfo:
+    """One jit application found in a module."""
+    name: Optional[str]            # name the JITTED callable is bound to
+    func_node: ast.AST             # FunctionDef or Lambda being jitted
+    line: int
+    static_argnums: Tuple[int, ...]
+    static_argnames: Tuple[str, ...]
+    has_donate: bool
+    jit_kwargs: Dict[str, ast.expr]
+    wrapped_name: Optional[str] = None   # inner function's own name, if any
+
+
+def _collect_jit_kwargs(call: ast.Call) -> Dict[str, ast.expr]:
+    return {kw.arg: kw.value for kw in call.keywords if kw.arg}
+
+
+def _literal_ints(node: Optional[ast.expr]) -> Tuple[int, ...]:
+    """static_argnums value → tuple of ints (best effort on literals)."""
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+        return tuple(out)
+    return ()
+
+
+def _literal_strs(node: Optional[ast.expr]) -> Tuple[str, ...]:
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant) and isinstance(e.value, str))
+    return ()
+
+
+def _info_from_kwargs(name, func_node, line, kwargs,
+                      wrapped_name=None) -> JitInfo:
+    return JitInfo(
+        name=name, func_node=func_node, line=line,
+        static_argnums=_literal_ints(kwargs.get("static_argnums")),
+        static_argnames=_literal_strs(kwargs.get("static_argnames")),
+        has_donate=("donate_argnums" in kwargs or "donate_argnames" in kwargs),
+        jit_kwargs=kwargs, wrapped_name=wrapped_name)
+
+
+def _jit_call_kwargs(node: ast.expr) -> Optional[Dict[str, ast.expr]]:
+    """If ``node`` evaluates to a jit-wrapper (jax.jit or partial(jax.jit,...)),
+    return its keyword options; else None."""
+    if _is_jax_jit(node):
+        return {}
+    if isinstance(node, ast.Call):
+        if _is_jax_jit(node.func):
+            return _collect_jit_kwargs(node)
+        if _is_partial(node.func) and node.args and _is_jax_jit(node.args[0]):
+            return _collect_jit_kwargs(node)
+    return None
+
+
+def _jit_call_parts(node: ast.Call):
+    """(wrapped target expr, jit kwargs) if ``node`` is a call-form jit
+    application — jax.jit(f, ...) or partial(jax.jit, ...)(f) — else None."""
+    if _is_jax_jit(node.func) and node.args:
+        return node.args[0], _collect_jit_kwargs(node)
+    if isinstance(node.func, ast.Call):
+        inner = _jit_call_kwargs(node.func)
+        if inner is not None and node.args:
+            kwargs = dict(inner)
+            kwargs.update(_collect_jit_kwargs(node))
+            return node.args[0], kwargs
+    return None
+
+
+def find_jit_functions(tree: ast.Module) -> List[JitInfo]:
+    """Every jit application in the module, with the wrapped function body
+    when it is syntactically available. For the call form the recorded
+    ``name`` is the name the JITTED callable is bound to (``g`` in
+    ``g = jax.jit(f)``) — call-site rules must match calls to ``g``, not to
+    the plain, un-jitted ``f``."""
+    out: List[JitInfo] = []
+    defs_by_name = {n.name: n for n in ast.walk(tree)
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    seen_calls = set()
+
+    def add_call_form(call: ast.Call, bound: Optional[str]):
+        parts = _jit_call_parts(call)
+        if parts is None:
+            return
+        seen_calls.add(id(call))
+        target, kwargs = parts
+        if isinstance(target, ast.Lambda):
+            out.append(_info_from_kwargs(bound, target, call.lineno, kwargs))
+        elif isinstance(target, ast.Name):
+            body = defs_by_name.get(target.id, ast.Pass())
+            out.append(_info_from_kwargs(bound, body, call.lineno, kwargs,
+                                         wrapped_name=target.id))
+
+    for node in ast.walk(tree):
+        # decorator forms
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                kwargs = _jit_call_kwargs(dec)
+                if kwargs is not None:
+                    out.append(_info_from_kwargs(node.name, node, node.lineno,
+                                                 kwargs,
+                                                 wrapped_name=node.name))
+        # assignment-bound call forms: g = jax.jit(f, ...)
+        elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            bound = (node.targets[0].id
+                     if len(node.targets) == 1
+                     and isinstance(node.targets[0], ast.Name) else None)
+            add_call_form(node.value, bound)
+
+    # unbound call forms (returned / passed directly): name stays None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and id(node) not in seen_calls:
+            add_call_form(node, None)
+    return out
+
+
+def func_param_names(func_node: ast.AST) -> List[str]:
+    if isinstance(func_node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        a = func_node.args
+        return ([p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+                + [p.arg for p in a.kwonlyargs])
+    return []
+
+
+def walk_scope(roots):
+    """Walk ``roots`` and their descendants WITHOUT descending into nested
+    function/lambda definitions — the shared scan-own-scope-only traversal
+    (each nested scope is scanned when the caller reaches it as a root)."""
+    stack = list(roots)
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def body_nodes(func_node: ast.AST):
+    """Iterate the wrapped function's own body nodes, pruning nested
+    function/lambda definitions: a nested jitted function is scanned at its
+    own jit site, and a nested plain def may be a host-callback body
+    (jax.pure_callback) where host work is the point — flagging it would
+    break the zero-false-positive contract."""
+    if isinstance(func_node, ast.Lambda):
+        yield from walk_scope([func_node.body])
+    elif isinstance(func_node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        yield from walk_scope(func_node.body)

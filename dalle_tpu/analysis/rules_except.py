@@ -1,0 +1,54 @@
+"""broad-except: ``except Exception`` must carry a written justification.
+
+The required idiom (set by data/webdataset.py, which catches broadly on
+purpose at shard/sample level):
+
+    except Exception as e:   # noqa: BLE001 - shard-level skip
+
+i.e. a ``# noqa: BLE001`` on the except line followed by ``- <reason>``.
+A bare ``except:`` is flagged unconditionally — it swallows
+KeyboardInterrupt/SystemExit invisibly; spell it ``except BaseException``
+with a justification if crossing a thread boundary really requires it.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from .core import FileContext, Finding, Rule, register_rule
+from .jit_scan import dotted_name
+
+_JUSTIFIED = re.compile(r"#\s*noqa:\s*BLE001\s*-\s*\S")
+
+
+@register_rule
+class BroadExcept(Rule):
+    name = "broad-except"
+    description = ("except Exception without a '# noqa: BLE001 - <reason>' "
+                   "justification on the except line")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                yield Finding(self.name, ctx.rel_path, node.lineno,
+                              "bare 'except:' swallows KeyboardInterrupt/"
+                              "SystemExit — catch a concrete exception type")
+                continue
+            caught = {dotted_name(t) for t in (
+                node.type.elts if isinstance(node.type, ast.Tuple)
+                else [node.type])}
+            broad = caught & {"Exception", "BaseException"}
+            if not broad:
+                continue
+            line = ctx.lines[node.lineno - 1] if node.lineno <= len(ctx.lines) else ""
+            if _JUSTIFIED.search(line):
+                continue
+            yield Finding(
+                self.name, ctx.rel_path, node.lineno,
+                f"'except {sorted(broad)[0]}' without justification — narrow "
+                "the type or annotate why broad is correct: "
+                "'except Exception as e:  # noqa: BLE001 - <reason>'")

@@ -1,0 +1,209 @@
+"""jit-shaped rules: recompile storms, host syncs, tracer branches, donation.
+
+All four rules share :mod:`dalle_tpu.analysis.jit_scan`'s view of where
+``jax.jit`` is applied in a module. They are syntactic: a jitted function is
+scanned as written; helpers it calls are each scanned at their own jit site
+(if any). That trades whole-program soundness for zero-false-positive
+signal on the patterns that actually recur in this codebase.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Optional
+
+from .core import FileContext, Finding, Rule, register_rule
+from .jit_scan import (JitInfo, body_nodes, dotted_name, find_jit_functions,
+                       func_param_names)
+
+# --------------------------------------------------------------------------
+# jit-static-hazard
+# --------------------------------------------------------------------------
+
+_FRESH_CTORS = {"dict", "list", "set", "frozenset"}
+
+
+def _unhashable_or_fresh(node: ast.expr) -> Optional[str]:
+    """Why this call-site argument will miss (or break) the jit cache."""
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp, ast.GeneratorExp)):
+        return "an unhashable literal (list/dict/set) — TypeError at call time"
+    if isinstance(node, ast.Lambda):
+        return ("a fresh lambda — every call site builds a new object, so "
+                "every call recompiles")
+    if isinstance(node, ast.Call):
+        name = dotted_name(node.func)
+        if name in _FRESH_CTORS:
+            return f"a fresh {name}() — unhashable, TypeError at call time"
+        if name in ("functools.partial", "partial"):
+            return ("a fresh functools.partial — new object per call, so "
+                    "every call recompiles")
+    return None
+
+
+@register_rule
+class JitStaticHazard(Rule):
+    name = "jit-static-hazard"
+    description = ("static_argnums/static_argnames argument receives an "
+                   "unhashable or freshly-constructed value at a call site "
+                   "(recompile storm or TypeError)")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        jits = [j for j in find_jit_functions(ctx.tree)
+                if (j.static_argnums or j.static_argnames) and j.name]
+        if not jits:
+            return findings
+        by_name = {j.name: j for j in jits}
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)):
+                continue
+            info = by_name.get(node.func.id)
+            if info is None:
+                continue
+            params = func_param_names(info.func_node)
+            for pos in info.static_argnums:
+                if pos < len(node.args):
+                    why = _unhashable_or_fresh(node.args[pos])
+                    if why:
+                        findings.append(Finding(
+                            self.name, ctx.rel_path, node.lineno,
+                            f"static arg {pos} of '{info.name}' is {why}"))
+            static_names = set(info.static_argnames)
+            static_names.update(params[p] for p in info.static_argnums
+                                if p < len(params))
+            for kw in node.keywords:
+                if kw.arg in static_names:
+                    why = _unhashable_or_fresh(kw.value)
+                    if why:
+                        findings.append(Finding(
+                            self.name, ctx.rel_path, node.lineno,
+                            f"static arg '{kw.arg}' of '{info.name}' is {why}"))
+        return findings
+
+
+# --------------------------------------------------------------------------
+# host-sync-in-jit
+# --------------------------------------------------------------------------
+
+_NUMPY_SYNCS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                "onp.asarray", "onp.array"}
+
+
+@register_rule
+class HostSyncInJit(Rule):
+    name = "host-sync-in-jit"
+    description = (".item()/float()/int()/np.asarray on traced values inside "
+                   "a jitted function — blocks the device and breaks tracing")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for info in find_jit_functions(ctx.tree):
+            params = set(func_param_names(info.func_node))
+            # static args are concrete Python values under trace — float()/
+            # int() on them is legal, so they are not "traced params"
+            all_params = func_param_names(info.func_node)
+            params -= set(info.static_argnames)
+            params -= {all_params[i] for i in info.static_argnums
+                       if i < len(all_params)}
+            for node in body_nodes(info.func_node):
+                if not isinstance(node, ast.Call):
+                    continue
+                # x.item() on anything
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "item" and not node.args):
+                    findings.append(Finding(
+                        self.name, ctx.rel_path, node.lineno,
+                        ".item() inside a jitted function forces a host "
+                        "sync (ConcretizationTypeError under trace)"))
+                    continue
+                name = dotted_name(node.func)
+                if name in _NUMPY_SYNCS:
+                    findings.append(Finding(
+                        self.name, ctx.rel_path, node.lineno,
+                        f"{name}() inside a jitted function materializes a "
+                        "host array — use jnp, or hoist out of jit"))
+                    continue
+                # float(x)/int(x)/bool(x) where x mentions a traced param
+                if (isinstance(node.func, ast.Name)
+                        and node.func.id in ("float", "int", "bool")
+                        and node.args):
+                    mentioned = {n.id for n in ast.walk(node.args[0])
+                                 if isinstance(n, ast.Name)}
+                    if mentioned & params:
+                        findings.append(Finding(
+                            self.name, ctx.rel_path, node.lineno,
+                            f"{node.func.id}() on a traced argument inside a "
+                            "jitted function — ConcretizationTypeError (use "
+                            "jnp casts, or mark the arg static)"))
+        return findings
+
+
+# --------------------------------------------------------------------------
+# python-branch-on-tracer
+# --------------------------------------------------------------------------
+
+_TRACED_ROOTS = re.compile(r"^(jnp|jax\.numpy|jax\.lax|lax)\.")
+
+
+def _test_mentions_traced_call(test: ast.expr) -> bool:
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call) and _TRACED_ROOTS.match(
+                dotted_name(node.func)):
+            return True
+    return False
+
+
+@register_rule
+class PythonBranchOnTracer(Rule):
+    name = "python-branch-on-tracer"
+    description = ("Python if/while on a value computed by jnp/jax.lax inside "
+                   "a jitted function — TracerBoolConversionError (use "
+                   "jnp.where / lax.cond / lax.while_loop)")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for info in find_jit_functions(ctx.tree):
+            for node in body_nodes(info.func_node):
+                if isinstance(node, (ast.If, ast.While)) and \
+                        _test_mentions_traced_call(node.test):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    findings.append(Finding(
+                        self.name, ctx.rel_path, node.lineno,
+                        f"Python '{kind}' on a jnp/lax expression inside a "
+                        "jitted function — the tracer has no concrete bool; "
+                        "use jnp.where / lax.cond / lax.while_loop"))
+        return findings
+
+
+# --------------------------------------------------------------------------
+# donate-missing
+# --------------------------------------------------------------------------
+
+_STEP_NAME = re.compile(r"(^|_)step$")
+
+
+@register_rule
+class DonateMissing(Rule):
+    name = "donate-missing"
+    description = ("train-step jit without donate_argnums — the old state "
+                   "buffer stays live across the update, doubling peak HBM")
+    # trainers + training entry points. bench scripts are excluded on
+    # purpose: they re-feed the same state across timed iterations, which
+    # donation would invalidate.
+    include = ("dalle_tpu/train/", "scripts/train_")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for info in find_jit_functions(ctx.tree):
+            step_name = next((n for n in (info.name, info.wrapped_name)
+                              if n and _STEP_NAME.search(n)), None)
+            if step_name is None or info.has_donate:
+                continue
+            findings.append(Finding(
+                self.name, ctx.rel_path, info.line,
+                f"jitted step function '{step_name}' does not donate its "
+                "state — pass donate_argnums so XLA reuses the old buffers "
+                "in place"))
+        return findings

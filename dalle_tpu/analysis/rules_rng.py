@@ -1,0 +1,182 @@
+"""prng-key-reuse: the two PRNG hazards that corrupt sampling silently.
+
+1. A hard-coded ``jax.random.PRNGKey(<literal>)`` in library code — the
+   classic "fallback key" that makes every caller share one stream. Library
+   code must require a key or route through the documented helper
+   ``dalle_tpu.utils.misc.deterministic_key`` (which carries its own
+   suppression and a docstring explaining when a fixed stream is correct).
+
+2. The same key name consumed by two ``jax.random.*`` draws with no
+   reassignment in between — both draws see identical bits, so e.g. two
+   "independent" gumbel perturbations are perfectly correlated.
+   ``split``/``fold_in``/``PRNGKey`` are derivations, not draws: they are
+   exempt as consumers (``key, sub = split(key)`` rebinds the name, which
+   the scan already honors).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from .core import FileContext, Finding, Rule, register_rule
+from .jit_scan import dotted_name
+
+# derivations (not draws): handing these the same bits is the sanctioned
+# key-plumbing pattern, not a correlated-sampling hazard
+_CONSUMERS_EXEMPT = {"split", "fold_in", "PRNGKey", "key", "key_data",
+                     "wrap_key_data", "clone"}
+
+
+def jax_random_aliases(tree: ast.Module) -> set:
+    """Names this module binds to the jax.random module. Bare ``random.``
+    is stdlib unless imported from jax — ``from jax import random`` /
+    ``import jax.random as jr`` make the alias a key-consuming prefix."""
+    aliases = {"jax.random"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax":
+            for a in node.names:
+                if a.name == "random":
+                    aliases.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax.random" and a.asname:
+                    aliases.add(a.asname)
+    return aliases
+
+
+def _is_jax_random_call(node: ast.Call, aliases: set) -> bool:
+    name = dotted_name(node.func)
+    return "." in name and name.rsplit(".", 1)[0] in aliases
+
+
+def _random_fn_name(node: ast.Call) -> str:
+    return dotted_name(node.func).rsplit(".", 1)[-1]
+
+
+def _walk_local(root: ast.AST):
+    """Scan ``root``'s own scope only (shared traversal from jit_scan):
+    nested function/lambda bodies are scanned when the outer loop reaches
+    them as roots — descending here would double-count and mix key scopes."""
+    from .jit_scan import walk_scope
+    return walk_scope(ast.iter_child_nodes(root))
+
+
+class _FunctionKeyScan(ast.NodeVisitor):
+    """Within one scope: order key-consuming uses and assignments by line,
+    flag a second consumption with no intervening rebind. The scan is
+    line-ordered, not control-flow-sensitive; the one disjointness it does
+    understand is if/else — uses in opposite branches of the same If never
+    execute together and are not a reuse pair."""
+
+    def __init__(self, findings: List[Finding], rel_path: str, aliases: set):
+        self.findings = findings
+        self.rel_path = rel_path
+        self.aliases = aliases
+
+    def scan(self, func: ast.AST):
+        uses = []      # (line, name)
+        assigns = []   # (line, name)
+        branches = []  # ((body_lo, body_hi), (else_lo, else_hi)) per If
+        for node in _walk_local(func):
+            if isinstance(node, ast.Call) and _is_jax_random_call(
+                    node, self.aliases):
+                fn = _random_fn_name(node)
+                if fn in _CONSUMERS_EXEMPT:
+                    continue
+                if node.args and isinstance(node.args[0], ast.Name):
+                    uses.append((node.lineno, node.args[0].id))
+            elif isinstance(node, ast.If) and node.orelse:
+                branches.append((self._span(node.body),
+                                 self._span(node.orelse)))
+            for tgt in self._assign_targets(node):
+                assigns.append(tgt)
+        uses.sort()
+        reported = set()   # (name, line) — one report per reuse line
+        for i, (ln, name) in enumerate(uses):
+            for ln2, name2 in uses[i + 1:]:
+                if name2 != name:
+                    continue
+                if self._disjoint_branches(ln, ln2, branches):
+                    continue  # try the next same-name use instead
+                rebound = any(a_name == name and ln < a_ln <= ln2
+                              for a_ln, a_name in assigns)
+                if not rebound and (name, ln2) not in reported:
+                    reported.add((name, ln2))
+                    self.findings.append(Finding(
+                        "prng-key-reuse", self.rel_path, ln2,
+                        f"key '{name}' already consumed by a jax.random call "
+                        f"on line {ln}; split it first "
+                        f"(identical bits → correlated draws)"))
+                break  # one report per first reuse pair
+
+    @staticmethod
+    def _span(stmts):
+        return (stmts[0].lineno, getattr(stmts[-1], "end_lineno",
+                                         stmts[-1].lineno))
+
+    @staticmethod
+    def _disjoint_branches(ln, ln2, branches) -> bool:
+        for (blo, bhi), (elo, ehi) in branches:
+            if (blo <= ln <= bhi and elo <= ln2 <= ehi) or \
+                    (elo <= ln <= ehi and blo <= ln2 <= bhi):
+                return True
+        return False
+
+    @staticmethod
+    def _assign_targets(node: ast.AST):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                yield from _names_in_target(t, node.lineno)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            yield from _names_in_target(node.target, node.lineno)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            yield from _names_in_target(node.target, node.lineno)
+        elif isinstance(node, ast.withitem) and node.optional_vars is not None:
+            yield from _names_in_target(node.optional_vars,
+                                        node.optional_vars.lineno)
+
+
+def _names_in_target(t: ast.AST, line: int):
+    if isinstance(t, ast.Name):
+        yield (line, t.id)
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            yield from _names_in_target(e, line)
+
+
+@register_rule
+class PrngKeyReuse(Rule):
+    name = "prng-key-reuse"
+    description = ("hard-coded PRNGKey literal in library code, or the same "
+                   "key consumed by two jax.random draws without a split")
+    include = ("dalle_tpu/",)
+    exclude = ("dalle_tpu/analysis/",)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        # hazard 1: literal PRNGKey anywhere in the file — matched by its
+        # distinctive trailing name so aliased/from-imports are caught too
+        for node in ast.walk(ctx.tree):
+            name = dotted_name(node.func) if isinstance(node, ast.Call) else ""
+            if (name.rsplit(".", 1)[-1] == "PRNGKey"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, int)):
+                findings.append(Finding(
+                    self.name, ctx.rel_path, node.lineno,
+                    f"hard-coded jax.random.PRNGKey({node.args[0].value}) — "
+                    "require a key from the caller or use "
+                    "utils.misc.deterministic_key (documented fixed-stream "
+                    "helper)"))
+        # hazard 2: per-scope reuse scan — module top level plus each
+        # function/lambda, nested scopes scanned independently
+        aliases = jax_random_aliases(ctx.tree)
+        scanner = _FunctionKeyScan(findings, ctx.rel_path, aliases)
+        scopes = [ctx.tree] + [
+            n for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda))]
+        for scope in scopes:
+            scanner.scan(scope)
+        return findings

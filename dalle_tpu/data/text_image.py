@@ -85,7 +85,8 @@ class TextImageDataset:
         for _ in range(len(self.pairs)):
             try:
                 return self._load(i)
-            except Exception:
+            except Exception:  # noqa: BLE001 - corrupt image / empty caption
+                # skipped by resampling, the reference contract (loader.py:58-96)
                 i = self.rng.randrange(len(self.pairs)) if self.shuffle \
                     else (i + 1) % len(self.pairs)
         raise RuntimeError("every sample in the dataset failed to load")

@@ -168,8 +168,8 @@ class WebDataset:
         if split_by_host:
             try:
                 self.shards = split_shards_per_host(self.shards)
-            except Exception:
-                pass  # jax not initialized yet — single-host
+            except Exception:  # noqa: BLE001 - jax not initialized yet
+                pass           # (or no distributed runtime) — single-host
         self.handler = handler
         self.shuffle_shards = shuffle_shards
         self.seed = seed
@@ -362,8 +362,9 @@ class _Prefetcher:
         try:
             while True:
                 self.q.get_nowait()
-        except Exception:   # queue.Empty — broad because __del__ may run at
-            pass            # interpreter shutdown when the module is torn down
+        except Exception:   # noqa: BLE001 - queue.Empty, but broad because
+            pass            # __del__ may run at interpreter shutdown when
+                            # the queue module is already torn down
 
     def __del__(self):
         self.close()

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import VQGANConfig
+from ..utils.misc import deterministic_key
 from .vqgan import VQModel
 from .wrapper import VAEAdapter
 
@@ -239,11 +240,18 @@ class OpenAIDiscreteVAE(VAEAdapter):
     def __init__(self, enc_params=None, dec_params=None, key=None):
         self.encoder = OpenAIEncoder()
         self.decoder = OpenAIDecoder()
-        key = key if key is not None else jax.random.PRNGKey(0)
+        # throwaway init: from_pretrained immediately replaces these params,
+        # so a fixed stream is correct (and keeps shape-only init reproducible)
+        key = key if key is not None else deterministic_key()
         img = jnp.zeros((1, 64, 64, 3), jnp.float32)
-        self.enc_params = enc_params or self.encoder.init(key, img)
+        # `is not None`, not `or`: a falsy params container (empty FrozenDict
+        # from a partial restore) must error downstream, not be silently
+        # replaced by fresh random init
+        self.enc_params = (enc_params if enc_params is not None
+                           else self.encoder.init(key, img))
         z = jnp.zeros((1, 8, 8, self.num_tokens), jnp.float32)
-        self.dec_params = dec_params or self.decoder.init(key, z)
+        self.dec_params = (dec_params if dec_params is not None
+                           else self.decoder.init(key, z))
         self._encode = jax.jit(lambda p, x: jnp.argmax(
             self.encoder.apply(p, map_pixels(x)), axis=-1))
         self._decode = jax.jit(lambda p, z: unmap_pixels(jax.nn.sigmoid(
@@ -408,7 +416,11 @@ class VQGanVAE(VAEAdapter):
         self.model = VQModel(cfg)
         if params is None:
             from .vqgan import init_vqgan
-            _, params = init_vqgan(cfg, key or jax.random.PRNGKey(0))
+            # `key if ... is not None`, NOT `key or`: truthiness of a (2,)
+            # uint32 key array raises; the old `key or PRNGKey(0)` only
+            # worked because every caller passed None
+            _, params = init_vqgan(
+                cfg, key if key is not None else deterministic_key())
         self.params = params
         self.image_size = cfg.resolution
         # true downsample factor; equals the reference's

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from ..config import VQGANConfig
 from ..ops.quantize import (VQOutput, gumbel_quantize, remap_indices,
                             unmap_indices, vector_quantize)
+from ..utils.misc import deterministic_key
 
 
 def swish(x):
@@ -201,8 +202,10 @@ class VQModel(nn.Module):
         if c.quantizer == "gumbel":
             logits = self.quant_proj(z)
             hard = c.straight_through if not deterministic else True
+            # deterministic eval still evaluates the gumbel path's argmax —
+            # a fixed stream makes it reproducible without an rng collection
             key = (self.make_rng("gumbel") if not deterministic
-                   else jax.random.PRNGKey(0))
+                   else deterministic_key())
             return gumbel_quantize(key, logits, self.codebook.embedding,
                                    tau=1.0 if temp is None else temp,
                                    hard=hard, kl_weight=c.gumbel_kl_weight)

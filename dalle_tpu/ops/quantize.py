@@ -18,6 +18,8 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
+from ..utils.misc import deterministic_key
+
 
 def gumbel_softmax(key: jax.Array, logits: jnp.ndarray, tau: float,
                    hard: bool = False, axis: int = -1) -> jnp.ndarray:
@@ -91,7 +93,9 @@ def remap_indices(idx: jnp.ndarray, used, unknown="random",
     found = jnp.any(match, axis=-1)
     new = jnp.argmax(match, axis=-1)
     if unknown == "random":
-        key = key if key is not None else jax.random.PRNGKey(0)
+        # no caller key → deterministic pseudo-random fill (the sane choice
+        # for eval tokenization; see VQModel.get_codebook_indices)
+        key = key if key is not None else deterministic_key()
         fill = jax.random.randint(key, idx.shape, 0, used.shape[0])
     elif unknown == "extra":
         fill = jnp.full(idx.shape, used.shape[0])

@@ -88,7 +88,9 @@ class BPE:
             from .native import NativeBPE
             if NativeBPE.available():
                 self._native = NativeBPE(merges)
-        except Exception:
+        except Exception:  # noqa: BLE001 - the C++ core is an optional
+            # accelerator: import, toolchain, or ABI failures all mean the
+            # same thing (use the pure-Python merge loop), never an error
             self._native = None
 
     @property

@@ -63,7 +63,9 @@ class CheckpointManager:
             restored = self._mgr.restore(
                 step, args=ocp.args.Composite(metadata=ocp.args.JsonRestore()))
             return restored["metadata"]
-        except Exception:
+        except Exception:  # noqa: BLE001 - metadata is best-effort sidecar:
+            # orbax raises version-dependent types for a missing/corrupt item
+            # and the weights restore (the part that must not fail) succeeded
             return None
 
     def preflight(self, state: Any, metadata: Optional[dict] = None):

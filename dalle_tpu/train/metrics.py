@@ -105,7 +105,8 @@ class MetricsLogger:
                 import wandb
                 self._wandb = wandb.init(project=project, name=run_name,
                                          config=config or {}, resume="allow")
-            except Exception as e:   # offline / not installed: degrade to jsonl
+            except Exception as e:   # noqa: BLE001 - wandb offline / not
+                # installed / auth failure: all degrade to jsonl-only logging
                 print(f"[metrics] wandb unavailable ({e!r}); jsonl only")
 
     def log(self, step: int, metrics: dict):

@@ -34,6 +34,19 @@ def log2_int(n: int) -> int:
     return l
 
 
+def deterministic_key(salt: int = 0) -> jax.Array:
+    """The sanctioned fixed PRNG stream for paths where run-to-run
+    determinism is the point (eval tokenization, throwaway init params that
+    pretrained weights immediately replace). Library code must not silently
+    fall back to ``jax.random.PRNGKey(0)`` — graftlint's ``prng-key-reuse``
+    rule flags hard-coded key literals precisely because a shared default
+    stream correlates every caller's draws. Routing through this helper
+    keeps the fixed stream greppable and reviewed; anything feeding
+    *sampling or training* should require a key from its caller instead.
+    """
+    return jax.random.PRNGKey(salt)  # graftlint: disable=prng-key-reuse
+
+
 def kmeans(x, k: int, iters: int = 10, seed: int = 0):
     """Plain k-means over (n, d) points — the pixel-clustering utility the
     reference ships for conditional image GPTs (taming mingpt.py:356-415

@@ -103,7 +103,8 @@ def main():
             dense = jax.grad(dense_loss, argnums=(0, 1, 2))
             try:
                 t_dense = timeit(dense, q, k, v)
-            except Exception as e:
+            except Exception as e:  # noqa: BLE001 - sweep point: a
+                # rejected config becomes an error row, not an aborted sweep
                 print(json.dumps({"seq": n_eff, "mask": kind, "dense_error":
                                   str(e)[:120]}), flush=True)
                 t_dense = None
@@ -124,7 +125,7 @@ def main():
                 fl = jax.grad(flash_loss, argnums=(0, 1, 2))
                 try:
                     t = timeit(fl, q, k, v)
-                except Exception as e:
+                except Exception as e:  # noqa: BLE001 - sweep point
                     print(json.dumps({"seq": n_eff, "mask": kind, "block": blk,
                                       "error": str(e)[:120]}), flush=True)
                     continue

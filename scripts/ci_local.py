@@ -11,6 +11,13 @@ as written against this checkout.
 
 Run: python scripts/ci_local.py   (the workflow's pytest step already runs
 the fast tier — pyproject addopts default to -m "not slow")
+
+The graftlint stage runs FIRST, before any workflow step: static findings
+are cheaper than a test tier, so they should gate it. --changed-only
+narrows the lint to files with UNCOMMITTED changes vs HEAD (the fast
+mid-edit loop) — after a commit it lints nothing, so the pre-push / CI
+gate is the default full lint. The workflow's own lint step is skipped
+here to avoid running the pass twice.
 """
 
 import argparse
@@ -24,9 +31,25 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 NETWORK_MARKERS = ("pip install", "apt-get", "curl ", "wget ")
 
 
+def run_lint_stage(changed_only: bool) -> int:
+    """The graftlint stage. Returns the lint exit code."""
+    cmd = [sys.executable, os.path.join(ROOT, "scripts", "lint.py")]
+    if changed_only:
+        cmd.append("--changed-only")
+    print(f"== [lint] {' '.join(cmd[1:])}")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(cmd, cwd=ROOT, env=env).returncode
+
+
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--changed-only", action="store_true",
+                    help="git-diff-scope the lint stage (fast pre-push loop)")
     args = ap.parse_args()
+
+    if run_lint_stage(args.changed_only) != 0:
+        print("ci_local: FAILED (lint stage) — test tiers not run")
+        return 1
 
     wf = yaml.safe_load(open(os.path.join(ROOT, ".github/workflows/ci.yml")))
     job = wf["jobs"]["test"]
@@ -37,6 +60,9 @@ def main():
             print(f"-- [skip] {name}: action step (no local runner)")
             continue
         cmd = step["run"]
+        if "scripts/lint.py" in cmd:
+            print(f"-- [skip] {name}: already run in the lint stage")
+            continue
         if any(m in cmd for m in NETWORK_MARKERS):
             # the editable-install smoke is half network, half local: keep
             # the local import check. Join backslash continuations first so

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""graftlint CLI — run the repo's static-analysis pass; exit non-zero on
+findings.
+
+    python scripts/lint.py                     # whole repo (dalle_tpu, scripts)
+    python scripts/lint.py dalle_tpu/ops/x.py  # specific files
+    python scripts/lint.py --changed-only      # git-diff-scoped (fast CI stage)
+    python scripts/lint.py --list-rules
+    python scripts/lint.py --select broad-except,prng-key-reuse
+
+There is deliberately no --fix: every rule here flags a judgment call
+(justify the broad except, pick the right key plumbing, recalibrate the
+estimator) that an auto-rewriter would get wrong silently.
+"""
+
+import argparse
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+# the vmem-ceiling rule imports ops.fused_attention (which imports jax);
+# keep that import on CPU so linting never touches an accelerator
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    help="repo-relative .py files to lint (default: all)")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="lint only files with uncommitted changes vs HEAD "
+                         "(mid-edit loop; after a commit this lints nothing "
+                         "— use the full lint as the push gate). Project-"
+                         "wide rules still run when their triggers changed")
+    ap.add_argument("--select", help="comma-separated rule names to run")
+    ap.add_argument("--ignore", help="comma-separated rule names to skip")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    from dalle_tpu.analysis import RULES, run_lint
+
+    if args.list_rules:
+        width = max(len(n) for n in RULES)
+        for name, rule in sorted(RULES.items()):
+            print(f"{name:<{width}}  {rule.description}")
+        return 0
+
+    paths = None
+    if args.paths:
+        paths = [os.path.relpath(os.path.abspath(p), ROOT).replace(os.sep, "/")
+                 for p in args.paths]
+        missing = [orig for orig, rel in zip(args.paths, paths)
+                   if not os.path.isfile(os.path.join(ROOT, rel))]
+        if missing:
+            sys.exit(f"lint.py: no such file: {', '.join(missing)}")
+
+    def rule_names(arg, flag):
+        if not arg:
+            return None
+        names = [n.strip() for n in arg.split(",") if n.strip()]
+        unknown = [n for n in names if n not in RULES]
+        if unknown:
+            # a typo'd --select silently running ZERO rules would report
+            # green while checking nothing — make it a hard error instead
+            sys.exit(f"lint.py: unknown rule(s) for {flag}: "
+                     f"{', '.join(unknown)} (see --list-rules)")
+        return names
+
+    try:
+        findings = run_lint(
+            paths=paths,
+            select=rule_names(args.select, "--select"),
+            ignore=rule_names(args.ignore, "--ignore"),
+            changed_only=args.changed_only,
+            repo_root=ROOT,
+        )
+    except RuntimeError as e:   # e.g. --changed-only with git unavailable
+        sys.exit(f"lint.py: {e}")
+    for f in findings:
+        print(f)
+    n = len(findings)
+    scope = "changed files" if args.changed_only else "repo"
+    print(f"graftlint: {n} finding{'s' if n != 1 else ''} ({scope})")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -112,7 +112,7 @@ def train_trunk(images, labels, *, steps: int, batch: int, seed: int):
     tx = optax.adam(1e-3)
     opt = tx.init(params)
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt, x, ys, yc, ysc):
         def loss_fn(p):
             feats = trunk.apply(p["trunk"], x)
@@ -163,7 +163,7 @@ def train_lins(model: LPIPS, lpips_params, images, *, steps: int, batch: int,
     lins = split(lpips_params)
     opt = tx.init(lins)
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=(0, 1))
     def step(lins, opt, x, weak, strong):
         def loss_fn(lins):
             p = join(lins)

@@ -36,6 +36,46 @@ if not _USE_REAL_TPU:
 
 jax.config.update("jax_default_matmul_precision", "float32")
 
+# ---------------------------------------------------------------------------
+# recompilation guard (dalle_tpu/analysis/recompile_guard.py): the listener
+# must be installed before any test compiles, so it lives here. Tests (or
+# whole modules, via ``pytestmark``) declare a per-test ceiling with
+# ``@pytest.mark.recompile_budget(N)``; exceeding it fails the test even when
+# its assertions pass — recompile drift only shows up as wall-clock on
+# hardware, which is exactly where it is most expensive to discover.
+# ---------------------------------------------------------------------------
+from dalle_tpu.analysis.recompile_guard import install_compile_counter  # noqa: E402
+
+_COMPILE_COUNTER = install_compile_counter()
+
+
+@pytest.fixture(autouse=True)
+def _recompile_budget(request):
+    marker = request.node.get_closest_marker("recompile_budget")
+    report = os.environ.get("GRAFTLINT_RECOMPILE_REPORT") == "1"
+    if marker is None and not report:
+        yield
+        return
+    if marker is not None and not (
+            marker.args and isinstance(marker.args[0], int)):
+        pytest.fail("recompile_budget marker requires an integer ceiling, "
+                    "e.g. @pytest.mark.recompile_budget(40)", pytrace=False)
+    start = _COMPILE_COUNTER.count
+    yield
+    used = _COMPILE_COUNTER.count - start
+    if report:
+        print(f"\n[recompile] {request.node.nodeid}: {used} backend compiles")
+    if marker is not None and used > marker.args[0]:
+        pytest.fail(
+            f"recompilation budget exceeded: {used} XLA backend compiles > "
+            f"declared ceiling {marker.args[0]} for {request.node.nodeid}. "
+            "Ceilings are set to the module's cold full-run TOTAL, which "
+            "bounds any single test in any order — so this is new "
+            "compilation work: look for fresh static args, unhashable "
+            "statics, or shape churn (graftlint's jit-static-hazard rule "
+            "catches the common causes). Raise the marker only if the new "
+            "compiles are intentional.", pytrace=False)
+
 
 @pytest.fixture(scope="session")
 def devices():

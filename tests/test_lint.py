@@ -1,0 +1,519 @@
+"""graftlint: positive/negative fixtures per rule, suppression semantics,
+the estimator/ceiling contract, the repo-clean invariant, and the runtime
+recompile counter.
+
+Fixture sources are linted in-memory through FileContext — the rel_path
+argument drives each rule's path scoping, so fixtures can pretend to live
+anywhere in the tree.
+"""
+
+import ast
+import os
+import sys
+import textwrap
+import types
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dalle_tpu.analysis import RULES, run_lint
+from dalle_tpu.analysis.core import FileContext
+from dalle_tpu.analysis.rules_coverage import untested_ops
+from dalle_tpu.analysis.rules_vmem import check_estimator_contract
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_source(rule: str, src: str, rel_path: str = "dalle_tpu/_fixture.py"):
+    return RULES[rule].run(FileContext(rel_path, textwrap.dedent(src)))
+
+
+# ---------------------------------------------------------------------------
+# prng-key-reuse
+# ---------------------------------------------------------------------------
+
+def test_prng_rule_flags_literal_key():
+    src = """
+    import jax
+    def f(key=None):
+        key = key if key is not None else jax.random.PRNGKey(0)
+        return jax.random.uniform(key, (2,))
+    """
+    found = lint_source("prng-key-reuse", src)
+    assert len(found) == 1 and "hard-coded" in found[0].message
+
+
+def test_prng_rule_flags_key_consumed_twice():
+    src = """
+    import jax
+    def f(key):
+        a = jax.random.uniform(key, (2,))
+        b = jax.random.normal(key, (2,))
+        return a + b
+    """
+    found = lint_source("prng-key-reuse", src)
+    assert len(found) == 1 and "already consumed" in found[0].message
+
+
+def test_prng_rule_accepts_split_between_uses():
+    src = """
+    import jax
+    def f(key):
+        a = jax.random.uniform(key, (2,))
+        key, sub = jax.random.split(key)
+        b = jax.random.normal(key, (2,))
+        return a + b + jax.random.gumbel(sub, (2,))
+    """
+    assert lint_source("prng-key-reuse", src) == []
+
+
+def test_prng_rule_sees_from_jax_import_random_alias():
+    src = """
+    from jax import random
+    def f(key):
+        a = random.uniform(key, (2,))
+        b = random.normal(key, (2,))
+        return a + b
+    """
+    assert len(lint_source("prng-key-reuse", src)) == 1
+    # stdlib `random` is NOT a key consumer
+    stdlib = """
+    import random
+    def f(lines):
+        a = random.choice(lines)
+        b = random.choice(lines)
+        return a + b
+    """
+    assert lint_source("prng-key-reuse", stdlib) == []
+
+
+def test_prng_rule_if_else_branches_are_not_reuse():
+    src = """
+    import jax
+    def f(key, training):
+        if training:
+            x = jax.random.bernoulli(key, 0.5)
+        else:
+            x = jax.random.normal(key, (2,))
+        return x
+    """
+    assert lint_source("prng-key-reuse", src) == []
+    # module-level reuse IS scanned
+    top = """
+    import jax
+    def make():
+        return None
+    k = make()
+    a = jax.random.uniform(k, (2,))
+    b = jax.random.normal(k, (2,))
+    """
+    assert len(lint_source("prng-key-reuse", top)) == 1
+
+
+def test_prng_rule_branch_uses_plus_later_use_single_finding():
+    src = """
+    import jax
+    def f(key, t):
+        if t:
+            a = jax.random.uniform(key, (2,))
+        else:
+            a = jax.random.normal(key, (2,))
+        return a + jax.random.gumbel(key, (2,))
+    """
+    found = lint_source("prng-key-reuse", src)
+    assert len(found) == 1  # one reuse line → one finding, not one per branch
+
+
+def test_suppression_inside_string_does_not_suppress():
+    src = '''
+    import jax
+    DOC = "# graftlint: disable=prng-key-reuse"
+    K = jax.random.PRNGKey(0)
+    '''
+    found = lint_source("prng-key-reuse", src)
+    assert len(found) == 1  # the quoted directive is data, not a directive
+
+
+def test_prng_rule_out_of_scope_for_tests_and_scripts():
+    src = "import jax\nk = jax.random.PRNGKey(0)\n"
+    assert lint_source("prng-key-reuse", src, "scripts/bench_x.py") == []
+
+
+def test_suppression_comment_silences_a_line():
+    src = """
+    import jax
+    def f():
+        return jax.random.PRNGKey(0)  # graftlint: disable=prng-key-reuse
+    """
+    assert lint_source("prng-key-reuse", src) == []
+    src_above = """
+    import jax
+    def f():
+        # graftlint: disable=prng-key-reuse
+        return jax.random.PRNGKey(0)
+    """
+    assert lint_source("prng-key-reuse", src_above) == []
+
+
+# ---------------------------------------------------------------------------
+# broad-except
+# ---------------------------------------------------------------------------
+
+def test_broad_except_positive_and_bare():
+    src = """
+    try:
+        x = 1
+    except Exception:
+        pass
+    """
+    assert len(lint_source("broad-except", src)) == 1
+    bare = """
+    try:
+        x = 1
+    except:
+        pass
+    """
+    found = lint_source("broad-except", bare)
+    assert len(found) == 1 and "bare" in found[0].message
+
+
+def test_broad_except_justified_or_narrow_is_clean():
+    src = """
+    try:
+        x = 1
+    except Exception as e:  # noqa: BLE001 - sample-level skip
+        pass
+    try:
+        y = 2
+    except (ValueError, KeyError):
+        pass
+    """
+    assert lint_source("broad-except", src) == []
+
+
+# ---------------------------------------------------------------------------
+# jit-static-hazard
+# ---------------------------------------------------------------------------
+
+def test_static_hazard_flags_fresh_dict_at_call_site():
+    src = """
+    import functools
+    import jax
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def f(x, cfg):
+        return x
+    def g(x):
+        return f(x, {"chunks": 4})
+    def h(x):
+        return f(x, cfg=dict(chunks=4))
+    """
+    found = lint_source("jit-static-hazard", src)
+    assert len(found) == 2
+    assert all("recompile" in f.message or "TypeError" in f.message
+               for f in found)
+
+
+def test_static_hazard_call_form_matches_jitted_binding_not_wrapped_fn():
+    src = """
+    import jax
+    def f(x, cfg):
+        return x
+    g = jax.jit(f, static_argnums=(1,))
+    def use(x):
+        a = g(x, {"a": 1})      # the jitted call: hazard
+        b = f(x, {"a": 1})      # plain python call: fine
+        return a + b
+    """
+    found = lint_source("jit-static-hazard", src)
+    assert len(found) == 1 and "'g'" in found[0].message
+
+
+def test_static_hazard_accepts_hashable_name():
+    src = """
+    import functools
+    import jax
+    CFG = ("a", 4)
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def f(x, cfg):
+        return x
+    def g(x):
+        return f(x, CFG)
+    """
+    assert lint_source("jit-static-hazard", src) == []
+
+
+# ---------------------------------------------------------------------------
+# host-sync-in-jit
+# ---------------------------------------------------------------------------
+
+def test_host_sync_flags_item_float_asarray():
+    src = """
+    import jax
+    import numpy as np
+    @jax.jit
+    def f(x):
+        a = x.item()
+        b = float(x) + 1
+        c = np.asarray(x)
+        return a + b
+    """
+    assert len(lint_source("host-sync-in-jit", src)) == 3
+
+
+def test_host_sync_allows_float_on_static_params():
+    src = """
+    from functools import partial
+    import jax
+    @partial(jax.jit, static_argnames=("scale",))
+    def f(x, scale):
+        return x * float(scale)
+    @partial(jax.jit, static_argnums=(1,))
+    def h(x, n):
+        return x * int(n)
+    """
+    assert lint_source("host-sync-in-jit", src) == []
+
+
+def test_host_sync_ignores_nested_host_callback_body():
+    # a nested plain def inside a jitted function may be a pure_callback
+    # host body — host work there is the point, not a hazard
+    src = """
+    import jax
+    import numpy as np
+    @jax.jit
+    def f(x):
+        def host_fn(a):
+            return np.asarray(a).sum()
+        return jax.pure_callback(host_fn, x[0], x)
+    """
+    assert lint_source("host-sync-in-jit", src) == []
+
+
+def test_host_sync_clean_outside_jit_and_on_statics():
+    src = """
+    import jax
+    import numpy as np
+    def plain(x):
+        return float(x)
+    @jax.jit
+    def f(x):
+        scale = float(1.0)
+        return x * scale
+    y = np.asarray([1.0])
+    """
+    assert lint_source("host-sync-in-jit", src) == []
+
+
+# ---------------------------------------------------------------------------
+# python-branch-on-tracer
+# ---------------------------------------------------------------------------
+
+def test_branch_on_tracer_flags_if_and_while():
+    src = """
+    import jax
+    import jax.numpy as jnp
+    @jax.jit
+    def f(x):
+        if jnp.any(x > 0):
+            return x
+        while jnp.max(x) > 1:
+            x = x - 1
+        return -x
+    """
+    assert len(lint_source("python-branch-on-tracer", src)) == 2
+
+
+def test_branch_on_static_config_is_clean():
+    src = """
+    import jax
+    @jax.jit
+    def f(x, *, chunks=0):
+        if chunks > 0:
+            return x
+        return -x
+    """
+    assert lint_source("python-branch-on-tracer", src) == []
+
+
+# ---------------------------------------------------------------------------
+# donate-missing
+# ---------------------------------------------------------------------------
+
+def test_donate_missing_flags_undonated_train_step():
+    src = """
+    import jax
+    @jax.jit
+    def train_step(state, batch):
+        return state
+    """
+    found = lint_source("donate-missing", src, "dalle_tpu/train/_fixture.py")
+    assert len(found) == 1 and "donate" in found[0].message
+
+
+def test_donate_missing_clean_when_donating_or_not_a_step():
+    src = """
+    from functools import partial
+    import jax
+    @partial(jax.jit, donate_argnums=(0,))
+    def train_step(state, batch):
+        return state
+    @jax.jit
+    def sample(params, prompt):
+        return prompt
+    """
+    assert lint_source("donate-missing", src,
+                       "dalle_tpu/train/_fixture.py") == []
+    # bench scripts are out of scope by design
+    undonated = "import jax\n@jax.jit\ndef step(s, b):\n    return s\n"
+    assert lint_source("donate-missing", undonated,
+                       "scripts/bench_sweep.py") == []
+
+
+# ---------------------------------------------------------------------------
+# vmem-ceiling
+# ---------------------------------------------------------------------------
+
+def _fake_fused(bwd_coeff_seq: int, limits, budget):
+    """A module-shaped namespace replicating fused_attention's selection
+    logic, with a tweakable estimator/tier table."""
+    def _bwd_bytes(n, hd):
+        return 34 * n * hd + bwd_coeff_seq * n * n
+
+    def _compiler_params(est):
+        if est <= 14 * 1024 * 1024:
+            return None
+        need = est + est // 4
+        for _, limit in limits:
+            if need <= limit:
+                return types.SimpleNamespace(vmem_limit_bytes=limit)
+        return types.SimpleNamespace(vmem_limit_bytes=limits[-1][1])
+
+    return types.SimpleNamespace(
+        _bwd_bytes=_bwd_bytes, _compiler_params=_compiler_params,
+        _VMEM_RAISED_LIMITS=tuple(limits), _VMEM_RAISED_BUDGET=budget)
+
+
+_M = 1024 * 1024
+_REAL_LIMITS = ((30 * _M, 32 * _M), (44 * _M, 48 * _M))
+
+
+def test_vmem_contract_holds_on_the_real_module():
+    from dalle_tpu.ops import fused_attention
+    assert check_estimator_contract(fused_attention) == []
+    # and the faithful fake agrees (coeff 14 = 12 + 2 from _bwd_bytes)
+    assert check_estimator_contract(_fake_fused(14, _REAL_LIMITS, 30 * _M)) == []
+
+
+def test_vmem_contract_catches_estimator_drift():
+    # estimator shrunk: headroom no longer covers the measured 25.68M point
+    msgs = check_estimator_contract(_fake_fused(6, _REAL_LIMITS, 30 * _M))
+    assert any("no longer covers" in m for m in msgs)
+
+
+def test_vmem_contract_catches_tier_edits():
+    # medium tier lowered 32M -> 24M: the calibration shape routes elsewhere
+    msgs = check_estimator_contract(
+        _fake_fused(14, ((30 * _M, 24 * _M), (44 * _M, 48 * _M)), 30 * _M))
+    assert any("32M" in m or "Estimator and tier table" in m for m in msgs)
+    # gate raised past the top ceiling's headroom
+    msgs = check_estimator_contract(
+        _fake_fused(14, ((30 * _M, 32 * _M), (44 * _M, 45 * _M)), 44 * _M))
+    assert any("no dense fallback" in m for m in msgs)
+
+
+def test_vmem_rule_flags_rogue_literal_ceiling():
+    rogue = FileContext("dalle_tpu/ops/_fixture.py", textwrap.dedent("""
+        import jax
+        def call(k, pltpu, pl):
+            return pl.pallas_call(
+                k, compiler_params=pltpu.CompilerParams(
+                    vmem_limit_bytes=12345))
+    """))
+    found = RULES["vmem-ceiling"].run_project([rogue])
+    assert any("12345" in f.message for f in found)
+
+
+# ---------------------------------------------------------------------------
+# untested-public-op
+# ---------------------------------------------------------------------------
+
+def test_project_rules_see_full_set_under_explicit_paths(tmp_path):
+    # linting ONE file must not blind project rules to the rest of the tree
+    (tmp_path / "dalle_tpu" / "ops").mkdir(parents=True)
+    (tmp_path / "tests").mkdir()
+    (tmp_path / "dalle_tpu" / "other.py").write_text("x = 1\n")
+    (tmp_path / "dalle_tpu" / "ops" / "mod.py").write_text(
+        "def orphan_op():\n    pass\n")
+    found = run_lint(paths=["dalle_tpu/other.py"], repo_root=str(tmp_path),
+                     select=["untested-public-op"])
+    assert any(f.path == "dalle_tpu/ops/mod.py" and "orphan_op" in f.message
+               for f in found)
+
+
+def test_untested_op_detection_on_fixtures():
+    tree = ast.parse("def covered():\n    pass\n\ndef orphan():\n    pass\n"
+                     "\ndef _private():\n    pass\n")
+    hits = list(untested_ops({"dalle_tpu/ops/_fixture.py": tree},
+                             "uses covered() somewhere"))
+    assert [(h[1]) for h in hits] == ["orphan"]
+
+
+# ---------------------------------------------------------------------------
+# the repo itself
+# ---------------------------------------------------------------------------
+
+def test_repo_is_lint_clean():
+    findings = run_lint()
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_cli_exit_codes_and_injected_positive(tmp_path, monkeypatch):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import lint as lint_cli
+    finally:
+        sys.path.pop(0)
+    assert lint_cli.main(["--list-rules"]) == 0
+    assert lint_cli.main([os.path.join(REPO, "dalle_tpu/utils/misc.py")]) == 0
+    with pytest.raises(SystemExit, match="unknown rule"):
+        lint_cli.main(["--select", "broad_except"])  # typo'd name must error
+    with pytest.raises(SystemExit, match="no such file"):
+        lint_cli.main(["does_not_exist.py"])  # clean error, not a traceback
+    # inject a positive fixture into a THROWAWAY repo root: exit flips to 1
+    # without ever writing inside the real package tree. --select pins the
+    # rule under test (the vmem-ceiling foreign-checkout guard would
+    # otherwise make ANY foreign-root lint exit 1, proving nothing)
+    (tmp_path / "dalle_tpu").mkdir()
+    good = tmp_path / "dalle_tpu" / "good.py"
+    good.write_text("x = 1\n")
+    bad = tmp_path / "dalle_tpu" / "bad.py"
+    bad.write_text("import jax\nK = jax.random.PRNGKey(0)\n")
+    monkeypatch.setattr(lint_cli, "ROOT", str(tmp_path))
+    assert lint_cli.main(["--select", "prng-key-reuse", str(good)]) == 0
+    assert lint_cli.main(["--select", "prng-key-reuse", str(bad)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# recompile guard (runtime half)
+# ---------------------------------------------------------------------------
+
+def test_compile_counter_counts_backend_compiles():
+    from dalle_tpu.analysis.recompile_guard import install_compile_counter
+    counter = install_compile_counter()
+    assert counter is install_compile_counter()  # idempotent singleton
+    f = jax.jit(lambda x: x * 3 + 1)
+    x = jnp.arange(37)           # unlikely shape → cold cache
+    f(x)
+    n1 = counter.count
+    assert n1 > 0
+    f(x)                         # cache hit: no new backend compiles
+    assert counter.count == n1
+    f(jnp.arange(38))            # new shape: recompiles
+    assert counter.count > n1
+
+
+@pytest.mark.recompile_budget(64)
+def test_recompile_budget_marker_passes_under_budget():
+    f = jax.jit(lambda x: x + 2)
+    f(jnp.arange(39))

@@ -1,0 +1,255 @@
+"""Surface coverage for ops-layer symbols graftlint's `untested-public-op`
+rule flagged as unreferenced: every public op gets at least one behavioral
+check here (not an import smoke — each test pins a property a refactor
+could silently break). Shapes are tiny; Pallas kernels run in interpret
+mode on the CPU mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_tpu.ops.attention import KVCache, attend, cached_attend, \
+    cached_attend_window
+from dalle_tpu.ops.chunk_attention import (chunk_flash_dkv, chunk_flash_dq,
+                                           chunk_flash_fwd, merge_chunk,
+                                           pick_block)
+from dalle_tpu.ops.attn_masks import axial_mask
+from dalle_tpu.ops.flash_attention import (BlockLists, build_block_lists,
+                                           elem_fn_from_spec)
+from dalle_tpu.ops.fused_attention import use_spec, validity_table
+from dalle_tpu.ops import permuter
+from dalle_tpu.ops.permuter import jnp_take, spiral_in, spiral_out, subsample
+from dalle_tpu.ops.quantize import VQOutput, gumbel_quantize
+from dalle_tpu.ops.quantize_weights import (assert_float_params,
+                                            quantize_params_int8)
+from dalle_tpu.ops.rotary import pixel_freqs, rotate_half
+
+
+# ---------------------------------------------------------------------------
+# rotary
+# ---------------------------------------------------------------------------
+
+def test_rotate_half_is_quarter_turn():
+    x = jnp.asarray(np.random.RandomState(0).randn(3, 8).astype(np.float32))
+    # applying the pairwise quarter-turn twice negates the input
+    np.testing.assert_allclose(rotate_half(rotate_half(x)), -x, rtol=1e-6)
+    # and preserves the norm (it is a rotation)
+    np.testing.assert_allclose(jnp.linalg.norm(rotate_half(x), axis=-1),
+                               jnp.linalg.norm(x, axis=-1), rtol=1e-6)
+
+
+def test_pixel_freqs_range():
+    f = pixel_freqs(16, max_freq=10.0)
+    assert f.shape == (8,) and f.dtype == np.float32
+    np.testing.assert_allclose(f[0], np.pi, rtol=1e-6)
+    np.testing.assert_allclose(f[-1], 5.0 * np.pi, rtol=1e-6)
+    assert np.all(np.diff(f) > 0)
+
+
+# ---------------------------------------------------------------------------
+# permuter
+# ---------------------------------------------------------------------------
+
+def test_spiral_permuters_roundtrip_and_reverse():
+    out, inn = spiral_out(4, 4), spiral_in(4, 4)
+    # inward spiral is the reversed outward walk
+    np.testing.assert_array_equal(inn.idx, out.idx[::-1])
+    x = np.arange(16)
+    np.testing.assert_array_equal(out(out(x), reverse=True), x)
+    np.testing.assert_array_equal(inn(inn(x), reverse=True), x)
+
+
+def test_subsample_coarse_to_fine():
+    p = subsample(4, 4)
+    # first 4 tokens are the coarsest 2x2 sub-lattice: one per quadrant-parity
+    first = sorted(p.idx[:4].tolist())
+    assert first == [0, 2, 8, 10]
+    x = np.arange(16)
+    np.testing.assert_array_equal(p(p(x), reverse=True), x)
+
+
+def test_jnp_take_numpy_and_jax_paths_agree():
+    table = permuter.random(2, 4).idx
+    x_np = np.arange(8).reshape(1, 8)
+    got_np = jnp_take(x_np, table, axis=-1)
+    got_jnp = jnp_take(jnp.asarray(x_np), table, axis=-1)
+    assert isinstance(got_np, np.ndarray)
+    np.testing.assert_array_equal(got_np, np.asarray(got_jnp))
+
+
+# ---------------------------------------------------------------------------
+# quantize
+# ---------------------------------------------------------------------------
+
+def test_gumbel_quantize_hard_selects_codebook_rows():
+    rng = np.random.RandomState(1)
+    logits = jnp.asarray(rng.randn(2, 5, 7).astype(np.float32))
+    codebook = jnp.asarray(rng.randn(7, 3).astype(np.float32))
+    out = gumbel_quantize(jax.random.PRNGKey(0), logits, codebook,
+                          tau=0.1, hard=True, kl_weight=0.5)
+    assert isinstance(out, VQOutput)
+    assert out.quantized.shape == (2, 5, 3)
+    assert out.indices.shape == (2, 5) and out.indices.dtype == jnp.int32
+    np.testing.assert_array_equal(out.indices, jnp.argmax(logits, axis=-1))
+    # hard=True mixes a one-hot: every output row is exactly a codebook row
+    dists = jnp.linalg.norm(out.quantized[..., None, :] - codebook, axis=-1)
+    np.testing.assert_allclose(jnp.min(dists, axis=-1), 0.0, atol=1e-5)
+    assert np.isfinite(float(out.loss))
+
+
+# ---------------------------------------------------------------------------
+# quantize_weights
+# ---------------------------------------------------------------------------
+
+def test_assert_float_params_guards_plain_dense():
+    import flax.linen as nn
+    model = nn.Dense(4)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 3)))
+    assert_float_params(model.bind(variables))  # float params: fine
+    quant = quantize_params_int8(variables)
+    with pytest.raises(ValueError, match="int8"):
+        assert_float_params(model.bind({"params": quant["params"]}))
+
+
+# ---------------------------------------------------------------------------
+# flash_attention block lists / structured specs
+# ---------------------------------------------------------------------------
+
+def test_build_block_lists_causal_structure():
+    bl = build_block_lists(16, 4, 4, mask=None, causal=True)
+    assert isinstance(bl, BlockLists)
+    # q block i attends exactly k blocks 0..i under pure causal
+    np.testing.assert_array_equal(bl.k_cnt, np.arange(1, 5))
+    for i in range(4):
+        np.testing.assert_array_equal(bl.k_ids[i, :i + 1], np.arange(i + 1))
+    # transposed lists: k block j serves q blocks j..3
+    np.testing.assert_array_equal(bl.q_cnt, np.arange(4, 0, -1))
+
+
+def test_elem_fn_from_spec_matches_axial_table():
+    text_len, fmap = 3, 4
+    spec = ("axial", text_len, fmap, 0)
+    fn = elem_fn_from_spec(spec)
+    n = text_len + fmap * fmap
+    ri = np.arange(n)[:, None]
+    ci = np.arange(n)[None, :]
+    got = np.asarray(fn(ri, ci), bool) & (ci <= ri)
+    want = axial_mask(text_len, fmap, axis=0) & np.tril(np.ones((n, n), bool))
+    np.testing.assert_array_equal(got, want)
+    assert elem_fn_from_spec(None) is None
+    assert elem_fn_from_spec(("block", 64)) is None
+
+
+def test_use_spec_and_validity_table():
+    assert use_spec(("axial", 3, 4, 0)) and use_spec(("conv", 3, 4, 3, 1))
+    assert not use_spec(None) and not use_spec(("block", 64))
+    n = 8
+    np.testing.assert_array_equal(validity_table(n, None, None),
+                                  np.tril(np.ones((n, n), np.int8)))
+    spec = ("axial", 3, 2, 1)
+    tbl = validity_table(3 + 4, None, spec)
+    fn = elem_fn_from_spec(spec)
+    ri = np.arange(7)[:, None]
+    ci = np.arange(7)[None, :]
+    want = (np.asarray(fn(ri, ci), bool) & (ci <= ri)).astype(np.int8)
+    np.testing.assert_array_equal(tbl, want)
+
+
+# ---------------------------------------------------------------------------
+# cached_attend_window (the speculative verify step)
+# ---------------------------------------------------------------------------
+
+def test_cached_attend_window_matches_single_step_decode():
+    rng = np.random.RandomState(2)
+    b, h, d, max_seq, w = 2, 2, 8, 16, 3
+    cache = KVCache.init(b, h, max_seq, d)
+    k = jnp.asarray(rng.randn(b, h, 10, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(b, h, 10, d).astype(np.float32))
+    cache = cache.append(k, v, 0)
+    q = jnp.asarray(rng.randn(b, h, w, d).astype(np.float32))
+    starts = jnp.array([5, 7])  # per-row absolute position of query 0
+    got = cached_attend_window(q, cache, starts)
+    # row by row, window query j must equal a single-step cached_attend with
+    # length = starts[b] + j + 1 (same visibility set)
+    for bi in range(b):
+        for j in range(w):
+            one = cached_attend(q[bi:bi + 1, :, j:j + 1, :],
+                                KVCache(cache.kv[bi:bi + 1], heads=h),
+                                length=int(starts[bi]) + j + 1,
+                                use_kernel=False)
+            np.testing.assert_allclose(got[bi:bi + 1, :, j:j + 1, :], one,
+                                       rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# chunk flash kernels (the ring-attention inner step), interpret mode
+# ---------------------------------------------------------------------------
+
+def _dense_ref(q, k, v, scale, q_off, k_off, n_valid):
+    """Dense causal reference at global offsets, f32."""
+    s = jnp.einsum("bhid,bhjd->bhij", q * scale, k)
+    qpos = q_off + np.arange(q.shape[2])[:, None]
+    kpos = k_off + np.arange(k.shape[2])[None, :]
+    valid = (kpos <= qpos) & (kpos < n_valid)
+    s = jnp.where(jnp.asarray(valid), s, -1e9)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhij,bhjd->bhid", p, v)
+
+
+def test_pick_block_divisor_rules():
+    assert pick_block(16) == 16
+    assert pick_block(48) == 16          # largest pow2 divisor of 48
+    assert pick_block(1024, cap=256) == 256
+    assert pick_block(6) is None         # no tiling >= 8
+
+
+def test_chunk_flash_fwd_and_merge_match_dense():
+    rng = np.random.RandomState(3)
+    b, h, n, d = 1, 2, 16, 8
+    blk = pick_block(n)
+    q, k, v = (jnp.asarray(rng.randn(b, h, n, d).astype(np.float32))
+               for _ in range(3))
+    scale = d ** -0.5
+    want = _dense_ref(q, k, v, scale, 0, 0, n)
+    # single chunk pair covers the whole sequence
+    o, lse = chunk_flash_fwd(q, k, v, 0, 0, scale=scale, n_valid=n,
+                             block_q=blk, block_k=blk)
+    np.testing.assert_allclose(o, want, rtol=2e-5, atol=2e-5)
+    # two k chunks merged online must equal the one-shot result
+    half = n // 2
+    o1, l1 = chunk_flash_fwd(q, k[:, :, :half], v[:, :, :half], 0, 0,
+                             scale=scale, n_valid=n, block_q=blk,
+                             block_k=pick_block(half))
+    o2, l2 = chunk_flash_fwd(q, k[:, :, half:], v[:, :, half:], 0, half,
+                             scale=scale, n_valid=n, block_q=blk,
+                             block_k=pick_block(half))
+    merged, lse_m = merge_chunk(o1, l1, o2, l2)
+    np.testing.assert_allclose(merged, want, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(lse_m, lse, rtol=2e-5, atol=2e-5)
+
+
+def test_chunk_flash_backward_matches_autodiff():
+    rng = np.random.RandomState(4)
+    b, h, n, d = 1, 2, 16, 8
+    blk = pick_block(n)
+    q, k, v = (jnp.asarray(rng.randn(b, h, n, d).astype(np.float32))
+               for _ in range(3))
+    do = jnp.asarray(rng.randn(b, h, n, d).astype(np.float32))
+    scale = d ** -0.5
+
+    def loss(q, k, v):
+        return jnp.sum(_dense_ref(q, k, v, scale, 0, 0, n) * do)
+
+    dq_ref, dk_ref, dv_ref = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    o, lse = chunk_flash_fwd(q, k, v, 0, 0, scale=scale, n_valid=n,
+                             block_q=blk, block_k=blk)
+    delta = jnp.sum(o * do, axis=-1)
+    dq = chunk_flash_dq(q, k, v, do, lse, delta, 0, 0, scale=scale,
+                        n_valid=n, block_q=blk, block_k=blk)
+    dk, dv = chunk_flash_dkv(q, k, v, do, lse, delta, 0, 0, scale=scale,
+                             n_valid=n, block_q=blk, block_k=blk)
+    np.testing.assert_allclose(dq, dq_ref, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(dk, dk_ref, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(dv, dv_ref, rtol=2e-4, atol=2e-4)

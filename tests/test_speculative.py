@@ -12,6 +12,13 @@ import pytest
 from dalle_tpu.config import DalleConfig
 from dalle_tpu.models.dalle import DALLE, init_dalle
 
+# recompilation budget (conftest guard): ceiling = the module's cold
+# full-run TOTAL (412 measured) + ~15% slack for cross-jax-version compile-
+# count variance; the total bounds any single test standalone in any
+# order/subset. A speculative-decode change that recompiles per
+# gamma/row would still blow straight through this — that is the point.
+pytestmark = pytest.mark.recompile_budget(475)
+
 CFG = dict(num_text_tokens=32, text_seq_len=6, dim=32, depth=2, heads=2,
            dim_head=16, image_size=16, image_vocab_size=24, image_fmap_size=4)
 

@@ -9,6 +9,14 @@ from dalle_tpu.config import TransformerConfig
 from dalle_tpu.models.transformer import (Transformer, layerscale_init_eps,
                                           shift_tokens_full)
 
+# recompilation budget (conftest guard): ceiling = the module's cold
+# full-run TOTAL (634 measured) + ~15% slack for cross-jax-version compile-
+# count variance (CI installs unpinned jax); the total bounds any single
+# test standalone in any order/subset — a mid-module per-test max would blow up under -k (a
+# later parametrization run alone measured 356, riding no warm cache). A
+# test exceeding this has introduced NEW compilation work — docs/LINT.md.
+pytestmark = pytest.mark.recompile_budget(730)
+
 FMAP = 4
 TEXT = 8  # text_seq_len (excl bos)
 SEQ = TEXT + FMAP * FMAP
