@@ -6,4 +6,4 @@ __version__ = "0.1.0"
 
 from .config import (MeshConfig, PrecisionConfig, DVAEConfig, TransformerConfig,
                      DalleConfig, ClipConfig, VQGANConfig, OptimConfig,
-                     TrainConfig, AnnealConfig)
+                     ObsConfig, TrainConfig, AnnealConfig)

@@ -8,6 +8,11 @@ budget. A test that quietly starts recompiling per step still passes its
 assertions — only wall-clock shows it, and only on hardware where compiles
 are expensive. The budget turns that drift into a red test on CPU.
 
+The counter itself now lives in ``dalle_tpu/obs/device.py`` so the same
+event stream also feeds runtime telemetry (recompiles-per-100-steps as a
+training metric — see docs/OBSERVABILITY.md); this module re-exports it for
+the test harness, which is the guard's home turf.
+
 Usage (wired in tests/conftest.py):
 
     pytestmark = pytest.mark.recompile_budget(40)   # per-test ceiling
@@ -32,51 +37,5 @@ declared budgets were measured).
 
 from __future__ import annotations
 
-from typing import Optional
-
-import jax
-
-try:
-    from jax._src.dispatch import BACKEND_COMPILE_EVENT
-except ImportError:  # event key is stable across recent jax; private import is not
-    BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
-
-
-class CompileCounter:
-    """Monotonic count of XLA backend compiles in this process."""
-
-    def __init__(self):
-        self.count = 0
-
-    def _on_event(self, event: str, duration: float, **kwargs):
-        if event == BACKEND_COMPILE_EVENT:
-            self.count += 1
-
-
-_counter: Optional[CompileCounter] = None
-
-
-def _self_test(counter: CompileCounter) -> None:
-    """A guard that fails open is worse than no guard: if jax renames the
-    monitoring event, the count would stay 0 and every budget would pass
-    forever. One tiny throwaway jit at install time proves the listener
-    actually fires (a fresh lambda is never cache-hit)."""
-    import jax.numpy as jnp
-    before = counter.count
-    jax.jit(lambda x: x + 1)(jnp.zeros((3,), jnp.float32))
-    if counter.count == before:
-        raise RuntimeError(
-            "recompile guard self-test failed: no backend-compile event "
-            "observed for a fresh jit — jax likely renamed "
-            f"{BACKEND_COMPILE_EVENT!r}; update recompile_guard.py")
-
-
-def install_compile_counter() -> CompileCounter:
-    """Idempotent: jax.monitoring has no unregister, so one listener is
-    installed for the life of the process and shared by every caller."""
-    global _counter
-    if _counter is None:
-        _counter = CompileCounter()
-        jax.monitoring.register_event_duration_secs_listener(_counter._on_event)
-        _self_test(_counter)
-    return _counter
+from ..obs.device import (BACKEND_COMPILE_EVENT, CompileCounter,  # noqa: F401
+                          install_compile_counter)

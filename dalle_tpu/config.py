@@ -409,6 +409,25 @@ class OptimConfig(ConfigBase):
 
 
 @dataclass(frozen=True)
+class ObsConfig(ConfigBase):
+    """grafttrace runtime telemetry (dalle_tpu/obs/, docs/OBSERVABILITY.md).
+    Everything defaults off/cheap: the per-step breakdown metrics are always
+    computed (host-side perf_counter math), but span collection, the
+    watchdog, and the Prometheus textfile each need an explicit opt-in."""
+    trace: bool = False            # collect spans into the ring buffer
+    trace_dir: str = ""            # export dir ("" → <checkpoint_dir>/obs)
+    ring_capacity: int = 65536     # spans kept; overflow is counted, not silent
+    # no completed step within this many seconds → stall report (open spans +
+    # thread stacks). 0 disables. Set well above worst expected XLA compile.
+    watchdog_deadline_s: float = 0.0
+    watchdog_dump_stacks: bool = True
+    # poll HBM/compile gauges every N host steps (at metrics boundaries);
+    # 0 disables device polling
+    device_poll_every: int = 10
+    prometheus_path: str = ""      # node-exporter textfile target ("" = off)
+
+
+@dataclass(frozen=True)
 class TrainConfig(ConfigBase):
     batch_size: int = 64                 # global batch
     epochs: int = 20
@@ -440,6 +459,7 @@ class TrainConfig(ConfigBase):
     optim: OptimConfig = field(default_factory=OptimConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     precision: PrecisionConfig = field(default_factory=PrecisionConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
 
 
 # temperature annealing for dVAE training (ref: legacy/train_vae.py:269-271)

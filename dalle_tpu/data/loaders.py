@@ -18,6 +18,8 @@ from typing import Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..obs.trace import span   # trace-only import: keeps this module jax-free
+
 IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
 
 
@@ -49,8 +51,10 @@ def _finish_pil(img, image_size: int, *, center_crop: bool = True,
 def _load_image(path, image_size: int, *, center_crop: bool = True,
                 to_unit_interval: bool = True) -> np.ndarray:
     from PIL import Image
-    return _finish_pil(Image.open(path), image_size, center_crop=center_crop,
-                       to_unit_interval=to_unit_interval)
+    with span("data/load_image"):
+        return _finish_pil(Image.open(path), image_size,
+                           center_crop=center_crop,
+                           to_unit_interval=to_unit_interval)
 
 
 class ImageFolderDataset:
@@ -160,6 +164,7 @@ class ImagePaths:
         return out
 
 
+@span("data/batch_arrays")
 def batch_arrays(dataset, indices: Sequence[int]):
     """Stack dataset[i] tuples/dicts into batched numpy arrays."""
     items = [dataset[i] for i in indices]

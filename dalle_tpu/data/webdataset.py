@@ -28,6 +28,8 @@ from typing import Callable, Dict, Iterable, Iterator, List, Optional, Sequence
 
 import numpy as np
 
+from ..obs.trace import span   # trace-only import: keeps this module jax-free
+
 IMAGE_EXTS = ("jpg", "jpeg", "png", "bmp", "webp")
 
 
@@ -84,8 +86,9 @@ def iter_tar_samples(url: str, handler: Callable[[Exception], bool]
     to the first dot, wds convention). Yields ``{"__key__": str, ext: bytes}``."""
     proc = None
     try:
-        stream, proc = _open_shard(url)
-        tf = tarfile.open(fileobj=stream, mode="r|*")
+        with span("data/shard_open", url=url):
+            stream, proc = _open_shard(url)
+            tf = tarfile.open(fileobj=stream, mode="r|*")
     except Exception as e:              # noqa: BLE001 - shard-level skip
         if handler(e):
             return
@@ -129,6 +132,7 @@ def reraise(e: Exception) -> bool:
     return False
 
 
+@span("data/decode")
 def decode_sample(sample: Dict[str, bytes], image_size: Optional[int] = None
                   ) -> Dict[str, object]:
     """bytes → python values by extension: images → float32 [0,1] HWC numpy,
@@ -373,7 +377,11 @@ class _Prefetcher:
         return self
 
     def __next__(self):
-        item = self.q.get()
+        # a long span here = the prefetch thread can't keep up (decode/IO
+        # bound); near-zero = the queue is full and the consumer is the
+        # bottleneck — the per-thread trace rows make the overlap visible
+        with span("data/prefetch_wait"):
+            item = self.q.get()
         if item is self._DONE:
             if self.error is not None:
                 raise self.error

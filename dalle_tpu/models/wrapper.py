@@ -16,6 +16,8 @@ import jax
 import jax.numpy as jnp
 
 from ..config import DalleConfig, DVAEConfig
+from ..obs import counter_add, gauge_set, span
+from ..obs import enabled as _obs_enabled
 from .clip import CLIP
 from .dalle import DALLE
 from .dvae import DiscreteVAE
@@ -129,7 +131,8 @@ class DalleWithVae:
             if n_prime is None:
                 n_prime = int(0.4375 * self.model.cfg.image_seq_len)
             assert n_prime < self.model.cfg.image_seq_len
-            prime = self.vae.get_codebook_indices(img)[:, :n_prime]
+            with span("decode/vae_encode_prime"):
+                prime = self.vae.get_codebook_indices(img)[:, :n_prime]
         if precision not in ("float32", "f32", "bfloat16", "bf16",
                              "bf16_int8kv", "int8w"):
             # a typo would otherwise fall through to the ~3x-slower f32 path
@@ -162,26 +165,43 @@ class DalleWithVae:
             params = cache[1][mode]
             cache_dtype = (jnp.int8 if precision in ("bf16_int8kv", "int8w")
                            else jnp.bfloat16)
-        if speculative > 0:
-            if cond_scale != 1.0 or prime is not None:
-                # not an assert: -O must not silently drop the user's CFG
-                raise ValueError(
-                    "speculative decode supports cond_scale=1.0 and no "
-                    "image priming (CFG would need a second verified window "
-                    "per round)")
-            ids = self.model.apply(
-                params, text, key, gamma=speculative, draft=draft,
-                filter_thres=filter_thres, temperature=temperature,
-                cache_dtype=cache_dtype, topk_approx=topk_approx,
-                method=DALLE.generate_images_tokens_speculative)
-        else:
-            ids = self.model.apply(
-                params, text, key, filter_thres=filter_thres,
-                temperature=temperature, cond_scale=cond_scale,
-                image_prime=prime, cache_dtype=cache_dtype,
-                topk_approx=topk_approx,
-                method=DALLE.generate_images_tokens)
-        images = self.vae.decode(ids)
+        n_new = self.model.cfg.image_seq_len - (prime.shape[1]
+                                                if prime is not None else 0)
+        with span("decode/generate_tokens", tokens=int(n_new),
+                  batch=int(text.shape[0]), precision=precision) as dec_span:
+            if speculative > 0:
+                if cond_scale != 1.0 or prime is not None:
+                    # not an assert: -O must not silently drop the user's CFG
+                    raise ValueError(
+                        "speculative decode supports cond_scale=1.0 and no "
+                        "image priming (CFG would need a second verified "
+                        "window per round)")
+                ids = self.model.apply(
+                    params, text, key, gamma=speculative, draft=draft,
+                    filter_thres=filter_thres, temperature=temperature,
+                    cache_dtype=cache_dtype, topk_approx=topk_approx,
+                    method=DALLE.generate_images_tokens_speculative)
+            else:
+                ids = self.model.apply(
+                    params, text, key, filter_thres=filter_thres,
+                    temperature=temperature, cond_scale=cond_scale,
+                    image_prime=prime, cache_dtype=cache_dtype,
+                    topk_approx=topk_approx,
+                    method=DALLE.generate_images_tokens)
+            if _obs_enabled():
+                # the decode program is async-dispatched; without the sync
+                # the span would time the dispatch, not the tokens
+                ids = jax.block_until_ready(ids)
+        if dec_span.duration is not None and n_new > 0:
+            # per-token latency — the serving-side number that decides
+            # batch size and speculative-γ (scripts/obs_report.py surfaces
+            # the gauge; see docs/OBSERVABILITY.md)
+            gauge_set("obs.decode_per_token_ms",
+                      dec_span.duration * 1e3 / n_new)
+            counter_add("obs.decode_tokens_total",
+                        float(n_new * text.shape[0]))
+        with span("decode/vae_decode"):
+            images = self.vae.decode(ids)
         if clip is not None:
             clip_model, clip_params = clip
             # pad-remapped ids exceed CLIP's text vocab; zero them back to pad
@@ -194,7 +214,8 @@ class DalleWithVae:
             elif clip_text.shape[1] < n:
                 clip_text = jnp.pad(clip_text,
                                     ((0, 0), (0, n - clip_text.shape[1])))
-            scores = clip_model.apply(clip_params, clip_text, images)
+            with span("decode/clip_rerank"):
+                scores = clip_model.apply(clip_params, clip_text, images)
             return images, scores
         return images
 

@@ -1,0 +1,40 @@
+"""grafttrace — span-based runtime telemetry for training and decode.
+
+The observability layer the ROADMAP's "fast as the hardware allows" goal
+needs: ``span`` timing regions into a ring buffer (Perfetto/JSONL export),
+counters/gauges that merge into ``MetricsLogger`` records and a Prometheus
+textfile, device telemetry (HBM + live recompile rate), and a stall
+watchdog. See docs/OBSERVABILITY.md for the operator guide.
+
+Everything is off by default and near-free when off: ``span`` costs one
+global ``None`` check until ``configure()`` enables tracing
+(``TrainConfig.obs.trace`` / ``--obs.trace true`` from the CLIs).
+"""
+
+from .prometheus import render_textfile, sanitize_metric_name, write_textfile
+from .report import span_overhead_s, summarize_run
+from .trace import (Tracer, configure, counter_add, disable, enabled,
+                    export_chrome_trace, export_spans_jsonl, gauge_set,
+                    get_tracer, metrics_snapshot, open_spans, span)
+from .watchdog import StallReport, StallWatchdog
+
+_DEVICE_NAMES = ("CompileCounter", "DeviceTelemetry", "device_memory_stats",
+                 "install_compile_counter")
+
+__all__ = [
+    *_DEVICE_NAMES, "render_textfile", "sanitize_metric_name",
+    "write_textfile", "span_overhead_s", "summarize_run", "Tracer",
+    "configure", "counter_add", "disable", "enabled", "export_chrome_trace",
+    "export_spans_jsonl", "gauge_set", "get_tracer", "metrics_snapshot",
+    "open_spans", "span", "StallReport", "StallWatchdog",
+]
+
+
+def __getattr__(name):
+    # obs.device is the one jax-importing submodule; resolving it lazily
+    # keeps `from ..obs.trace import span` in the host-side data pipeline
+    # (loaders/webdataset) from dragging jax into pure-numpy importers
+    if name in _DEVICE_NAMES:
+        from . import device
+        return getattr(device, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,64 @@
+"""Prometheus textfile exporter for grafttrace counters/gauges.
+
+Training jobs on TPU pods rarely get to open a scrape port (the hosts sit
+behind the TPU VM network fabric), so the standard pattern is the
+node-exporter *textfile collector*: the process atomically rewrites a
+``.prom`` file; node-exporter picks it up on its next scrape. This module
+writes that file — no client library, no server thread, no new dependency.
+
+Metric naming: dots/slashes become underscores and everything gets a
+``dalle_`` prefix; names ending in ``_total`` are typed ``counter``,
+everything else ``gauge``. Writes go to ``<path>.tmp`` + ``os.replace`` so a
+scrape never reads a torn file.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import time
+from typing import Optional
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize_metric_name(name: str, prefix: str = "dalle_") -> str:
+    out = _NAME_RE.sub("_", name)
+    if not out.startswith(prefix):
+        out = prefix + out
+    if out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def render_textfile(metrics: dict, *, prefix: str = "dalle_",
+                    timestamp: Optional[float] = None) -> str:
+    """Prometheus text exposition format for a flat {name: number} dict.
+    Non-numeric values are skipped (the format has no string samples)."""
+    lines = []
+    ts = time.time() if timestamp is None else timestamp
+    lines.append(f"# grafttrace export, unix_time={ts:.3f}")
+    for name in sorted(metrics):
+        v = metrics[name]
+        if isinstance(v, bool):
+            v = int(v)
+        if not isinstance(v, (int, float)):
+            continue
+        pname = sanitize_metric_name(name, prefix)
+        mtype = "counter" if pname.endswith("_total") else "gauge"
+        lines.append(f"# TYPE {pname} {mtype}")
+        lines.append(f"{pname} {v}")
+    return "\n".join(lines) + "\n"
+
+
+def write_textfile(path: str, metrics: dict, *, prefix: str = "dalle_") -> str:
+    """Atomically (re)write the textfile; returns the rendered content."""
+    content = render_textfile(metrics, prefix=prefix)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write(content)
+    os.replace(tmp, path)
+    return content

@@ -1,0 +1,187 @@
+"""Post-hoc run summaries from grafttrace output files.
+
+``scripts/obs_report.py`` is the CLI shell; the logic lives here so tests
+and notebooks can call it directly. Two inputs, auto-detected per line:
+
+  * span JSONL (``spans.jsonl`` from ``export_spans_jsonl``): lines with
+    ``name``/``dur_s`` — aggregated per span name (count, total, mean,
+    p50/p99/max) plus a top-k of the slowest individual spans.
+  * metrics JSONL (``MetricsLogger`` records): lines with ``step`` — the
+    step-time histogram (from ``step_time_s`` when present, else deltas of
+    the record timestamps) and min/p50/p99 plus the mean data-starvation
+    ratio and last HBM gauge when those columns exist.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import List, Optional, Tuple
+
+
+def load_jsonl(path: str) -> List[dict]:
+    rows = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    return rows
+
+
+def split_rows(rows: List[dict]) -> Tuple[List[dict], List[dict]]:
+    """(span rows, metrics rows) — span rows carry dur_s, metrics rows step."""
+    spans = [r for r in rows if "dur_s" in r and "name" in r]
+    metrics = [r for r in rows if "step" in r and "dur_s" not in r]
+    return spans, metrics
+
+
+def percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return math.nan
+    i = min(int(q * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+def ascii_histogram(vals: List[float], bins: int = 10, width: int = 40,
+                    unit: str = "s") -> List[str]:
+    """Fixed-width ASCII histogram lines (empty input → one 'no data' line)."""
+    if not vals:
+        return ["(no data)"]
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        hi = lo + max(abs(lo), 1e-9)
+    edges = [lo + (hi - lo) * i / bins for i in range(bins + 1)]
+    counts = [0] * bins
+    for v in vals:
+        i = min(int((v - lo) / (hi - lo) * bins), bins - 1)
+        counts[i] += 1
+    peak = max(counts)
+    lines = []
+    for i, c in enumerate(counts):
+        bar = "#" * (round(c / peak * width) if peak else 0)
+        lines.append(f"  {edges[i]:>10.4g}–{edges[i + 1]:<10.4g}{unit} "
+                     f"|{bar:<{width}} {c}")
+    return lines
+
+
+def span_aggregate(spans: List[dict]) -> List[dict]:
+    """Per-name stats sorted by total time descending."""
+    by_name: dict = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(float(s["dur_s"]))
+    out = []
+    for name, durs in by_name.items():
+        durs.sort()
+        out.append({"name": name, "count": len(durs), "total_s": sum(durs),
+                    "mean_s": sum(durs) / len(durs),
+                    "p50_s": percentile(durs, 0.50),
+                    "p99_s": percentile(durs, 0.99), "max_s": durs[-1]})
+    out.sort(key=lambda r: -r["total_s"])
+    return out
+
+
+def top_slowest(spans: List[dict], k: int = 10) -> List[dict]:
+    return sorted(spans, key=lambda s: -float(s["dur_s"]))[:k]
+
+
+def step_times(metrics: List[dict]) -> List[float]:
+    """Per-step seconds: prefer the meter's ``step_time_s`` column, else
+    derive from record timestamp/step deltas."""
+    direct = [float(r["step_time_s"]) for r in metrics if "step_time_s" in r]
+    if direct:
+        return direct
+    out = []
+    rows = sorted((r for r in metrics if "time" in r), key=lambda r: r["step"])
+    for a, b in zip(rows, rows[1:]):
+        dsteps = b["step"] - a["step"]
+        if dsteps > 0:
+            out.append((b["time"] - a["time"]) / dsteps)
+    return out
+
+
+def format_report(rows: List[dict], *, topk: int = 10) -> str:
+    spans, metrics = split_rows(rows)
+    lines: List[str] = []
+    if metrics:
+        st = step_times(metrics)
+        lines.append(f"== step time ({len(st)} samples over "
+                     f"{len(metrics)} metric records)")
+        if st:
+            ss = sorted(st)
+            lines.append(f"  min={ss[0]:.4g}s p50={percentile(ss, .5):.4g}s "
+                         f"p99={percentile(ss, .99):.4g}s max={ss[-1]:.4g}s")
+        lines.extend(ascii_histogram(st))
+        starv = [float(r["data_starvation"]) for r in metrics
+                 if "data_starvation" in r]
+        if starv:
+            mean_starv = sum(starv) / len(starv)
+            verdict = ("INPUT-BOUND" if mean_starv > 0.5 else
+                       "input-pressured" if mean_starv > 0.2 else
+                       "compute-bound")
+            lines.append(f"== data starvation: mean={mean_starv:.2%} "
+                         f"max={max(starv):.2%} → {verdict}")
+        hbm = [r["hbm_bytes_in_use"] for r in metrics
+               if "hbm_bytes_in_use" in r]
+        if hbm:
+            lines.append(f"== hbm in use: last={hbm[-1] / 2**20:.1f}MiB "
+                         f"peak_seen={max(hbm) / 2**20:.1f}MiB")
+        rec = [r["recompiles_per_100_steps"] for r in metrics
+               if "recompiles_per_100_steps" in r]
+        if rec and rec[-1] > 0:
+            lines.append(f"== WARNING: still compiling — "
+                         f"{rec[-1]:.1f} recompiles/100 steps at last poll")
+        if any(r.get("mfu_estimated") for r in metrics):
+            lines.append("== NOTE: mfu is ESTIMATED (unknown accelerator "
+                         "peak-flops — see train/metrics.py PEAK_TFLOPS)")
+    if spans:
+        lines.append(f"== spans by total time ({len(spans)} spans)")
+        lines.append(f"  {'name':<32}{'count':>7}{'total_s':>10}{'mean_s':>10}"
+                     f"{'p50_s':>10}{'p99_s':>10}{'max_s':>10}")
+        for r in span_aggregate(spans)[:topk]:
+            lines.append(f"  {r['name']:<32}{r['count']:>7}"
+                         f"{r['total_s']:>10.4g}{r['mean_s']:>10.4g}"
+                         f"{r['p50_s']:>10.4g}{r['p99_s']:>10.4g}"
+                         f"{r['max_s']:>10.4g}")
+        lines.append(f"== top {topk} slowest individual spans")
+        for s in top_slowest(spans, topk):
+            args = f" {s['args']}" if s.get("args") else ""
+            lines.append(f"  {s['dur_s']:>10.4g}s  {s['name']}"
+                         f" (tid {s.get('tid', '?')}){args}")
+    if not lines:
+        lines.append("(no span or metrics records found)")
+    return "\n".join(lines)
+
+
+def summarize_run(path: str, *, topk: int = 10) -> str:
+    """Summarize a file or a run directory (picks up ``spans.jsonl`` and
+    ``metrics.jsonl``/``*.jsonl`` inside a directory)."""
+    paths: List[str] = []
+    if os.path.isdir(path):
+        for name in sorted(os.listdir(path)):
+            if name.endswith(".jsonl"):
+                paths.append(os.path.join(path, name))
+        if not paths:
+            return f"(no .jsonl files under {path})"
+    else:
+        paths = [path]
+    rows: List[dict] = []
+    for p in paths:
+        rows.extend(load_jsonl(p))
+    header = "grafttrace report: " + ", ".join(os.path.basename(p)
+                                               for p in paths)
+    return header + "\n" + format_report(rows, topk=topk)
+
+
+def span_overhead_s(samples: int = 10000) -> float:
+    """Measured per-span cost (enter+exit) with tracing in its CURRENT state
+    — the number behind the '<1% of step time' acceptance gate (the CI smoke
+    multiplies this by the spans-per-step count)."""
+    import time
+    from .trace import span
+    t0 = time.perf_counter()
+    for _ in range(samples):
+        with span("obs/overhead_probe"):
+            pass
+    return (time.perf_counter() - t0) / samples

@@ -1,0 +1,262 @@
+"""grafttrace core: spans, counters/gauges, ring buffer, Chrome trace export.
+
+The repo's only runtime instrumentation before this module was a samples/sec
+print and a one-shot profiler capture (train/metrics.py) — enough to know a
+run is slow, never enough to know *why*. grafttrace adds the missing layer:
+
+  * ``span(name)`` — a context manager / decorator timing a named region,
+    with thread-local nesting. When tracing is disabled (the default) the
+    cost is a single global ``None`` check; when enabled, two
+    ``perf_counter`` calls and one deque append (~1µs), so spans can live on
+    per-step hot paths without moving the numbers they measure.
+  * an in-process ring buffer of completed spans (bounded; overflow is
+    *counted*, never silent) that exports both JSONL (one span per line,
+    greppable, ``scripts/obs_report.py``'s input) and Chrome ``trace_event``
+    JSON, openable directly in Perfetto / chrome://tracing.
+  * process-wide counters and gauges (``counter_add``/``gauge_set``) that
+    merge into ``MetricsLogger`` records and the Prometheus textfile
+    exporter (obs/prometheus.py).
+
+Spans recorded from multiple threads keep independent stacks (the prefetch
+thread's decode spans overlap the main thread's dispatch spans in Perfetto —
+that overlap IS the picture of a healthy input pipeline). ``open_spans()``
+exposes the live per-thread stacks for the stall watchdog's reports.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+# ---------------------------------------------------------------------------
+# global state: one process-wide tracer (None = tracing disabled) plus the
+# per-thread open-span stacks. The stacks registry is keyed by thread ident
+# so the watchdog can report "last open span" for every thread.
+# ---------------------------------------------------------------------------
+
+_TLS = threading.local()
+_STACKS: dict = {}          # thread ident -> (thread name, open-span stack)
+_tracer: Optional["Tracer"] = None
+
+
+def _stack() -> list:
+    s = getattr(_TLS, "stack", None)
+    if s is None:
+        s = []
+        _TLS.stack = s
+        _STACKS[threading.get_ident()] = (threading.current_thread().name, s)
+    return s
+
+
+class Tracer:
+    """Process-wide span sink: a bounded ring of completed spans plus
+    counter/gauge maps. Span records are plain tuples
+    ``(name, rel_start_s, dur_s, thread_ident, depth, args)`` — relative to
+    ``time_origin`` (a ``perf_counter`` anchor paired with a wall-clock
+    epoch, so exports can be mapped back to absolute time)."""
+
+    def __init__(self, capacity: int = 65536):
+        self.capacity = capacity
+        self.spans: deque = deque(maxlen=capacity)
+        self.counters: dict = {}
+        self.gauges: dict = {}
+        self.dropped = 0          # spans evicted from the ring (never silent)
+        self._lock = threading.Lock()
+        self.t_origin = time.perf_counter()
+        self.epoch_origin = time.time()
+
+    def _record(self, name, t0, dur, depth, args):
+        # locked: exports iterate the deque from other threads, and a deque
+        # mutated mid-iteration raises RuntimeError (the lock is uncontended
+        # on the hot path — ~100ns next to two perf_counter calls)
+        with self._lock:
+            if len(self.spans) == self.spans.maxlen:
+                self.dropped += 1
+            self.spans.append((name, t0 - self.t_origin, dur,
+                               threading.get_ident(), depth, args))
+
+    def snapshot_spans(self) -> list:
+        with self._lock:
+            return list(self.spans)
+
+    def snapshot_metrics(self) -> dict:
+        """Counters + gauges as one flat dict (copied under the lock)."""
+        with self._lock:
+            out = dict(self.counters)
+            out.update(self.gauges)
+        if self.dropped:
+            out["obs.spans_dropped"] = self.dropped
+        return out
+
+
+class span:
+    """Time a named region: ``with span("fit/dispatch"): ...`` or
+    ``@span("data/decode")``. Keyword args become span args in the export
+    (e.g. ``span("fit/step", step=12)``); ``sp.set(...)`` attaches more from
+    inside the region. ``sp.duration`` holds the measured seconds after exit
+    (None when tracing was disabled at entry)."""
+
+    __slots__ = ("name", "args", "duration", "_t0", "_stack")
+
+    def __init__(self, name: str, **args):
+        self.name = name
+        self.args = args or None
+        self.duration = None
+
+    def set(self, **args) -> "span":
+        if self.args is None:
+            self.args = args
+        else:
+            self.args.update(args)
+        return self
+
+    def __enter__(self) -> "span":
+        if _tracer is None:
+            self._t0 = None
+            return self
+        s = _stack()
+        s.append(self)
+        self._stack = s
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *_exc) -> bool:
+        t1 = time.perf_counter()
+        if self._t0 is None:
+            return False
+        s = self._stack
+        if s and s[-1] is self:
+            s.pop()
+        self.duration = t1 - self._t0
+        tr = _tracer
+        if tr is not None:
+            tr._record(self.name, self._t0, self.duration, len(s), self.args)
+        return False
+
+    def __call__(self, fn):
+        name, args = self.name, self.args
+
+        @functools.wraps(fn)
+        def wrapped(*a, **kw):
+            with span(name, **(args or {})):
+                return fn(*a, **kw)
+
+        return wrapped
+
+
+# ---------------------------------------------------------------------------
+# module-level API
+# ---------------------------------------------------------------------------
+
+def configure(capacity: int = 65536) -> Tracer:
+    """Enable tracing. Idempotent: an already-live tracer is kept (nested
+    subsystems can all call configure without clobbering spans — the ring is
+    process-wide and accumulates until ``disable()``), but a changed
+    ``capacity`` resizes the ring in place (keeping the newest spans) rather
+    than being silently ignored."""
+    global _tracer
+    if _tracer is None:
+        _tracer = Tracer(capacity)
+    elif capacity != _tracer.capacity:
+        with _tracer._lock:
+            _tracer.spans = deque(_tracer.spans, maxlen=capacity)
+            _tracer.capacity = capacity
+    return _tracer
+
+
+def disable() -> None:
+    """Turn tracing off and drop the ring (mainly for tests)."""
+    global _tracer
+    _tracer = None
+
+
+def enabled() -> bool:
+    return _tracer is not None
+
+
+def get_tracer() -> Optional[Tracer]:
+    return _tracer
+
+
+def counter_add(name: str, value: float = 1.0) -> None:
+    tr = _tracer
+    if tr is None:
+        return
+    with tr._lock:
+        tr.counters[name] = tr.counters.get(name, 0) + value
+
+
+def gauge_set(name: str, value: float) -> None:
+    tr = _tracer
+    if tr is None:
+        return
+    with tr._lock:
+        tr.gauges[name] = float(value)
+
+
+def metrics_snapshot() -> dict:
+    """Current counters+gauges ({} when tracing is disabled)."""
+    tr = _tracer
+    return tr.snapshot_metrics() if tr is not None else {}
+
+
+def open_spans() -> dict:
+    """Live per-thread open-span stacks, outermost first:
+    ``{"MainThread:140..": ["fit/step", "fit/dispatch"], ...}``. The stall
+    watchdog's "where is it stuck" signal."""
+    out = {}
+    for ident, (tname, stack) in list(_STACKS.items()):
+        names = [sp.name for sp in list(stack)]
+        if names:
+            out[f"{tname}:{ident}"] = names
+    return out
+
+
+# ---------------------------------------------------------------------------
+# exports
+# ---------------------------------------------------------------------------
+
+def export_spans_jsonl(path: str, tracer: Optional[Tracer] = None) -> int:
+    """Write the ring as JSONL — one span object per line with absolute
+    ``ts`` (unix seconds), ``dur_s``, thread id, nesting depth, and args.
+    Returns the number of spans written."""
+    tr = tracer or _tracer
+    if tr is None:
+        return 0
+    rows = tr.snapshot_spans()
+    with open(path, "w") as fh:
+        for name, rel, dur, tid, depth, args in rows:
+            rec = {"name": name, "ts": tr.epoch_origin + rel, "rel_s": rel,
+                   "dur_s": dur, "tid": tid, "depth": depth}
+            if args:
+                rec["args"] = args
+            fh.write(json.dumps(rec) + "\n")
+    return len(rows)
+
+
+def export_chrome_trace(path: str, tracer: Optional[Tracer] = None) -> int:
+    """Write the ring as Chrome ``trace_event`` JSON (complete "X" events,
+    microsecond timestamps) — open in Perfetto or chrome://tracing. Returns
+    the number of events written."""
+    tr = tracer or _tracer
+    if tr is None:
+        return 0
+    pid = os.getpid()
+    events = []
+    for name, rel, dur, tid, depth, args in tr.snapshot_spans():
+        ev = {"name": name, "ph": "X", "pid": pid, "tid": tid,
+              "ts": rel * 1e6, "dur": dur * 1e6}
+        if args:
+            ev["args"] = dict(args)
+        events.append(ev)
+    doc = {"traceEvents": events, "displayTimeUnit": "ms",
+           "metadata": {"epoch_origin": tr.epoch_origin,
+                        "spans_dropped": tr.dropped}}
+    with open(path, "w") as fh:
+        json.dump(doc, fh)
+    return len(events)

@@ -1,0 +1,124 @@
+"""Heartbeat watchdog: turn "is the run hung or just compiling?" into a log
+line instead of an SSH session.
+
+A daemon thread watches a heartbeat the fit loop feeds once per step. If no
+beat lands within the deadline it emits a stall report — host step, seconds
+idle, every thread's open span stack (grafttrace's live view: a stall inside
+``fit/batch_wait`` is data starvation, inside ``fit/dispatch`` is a device
+hang or a multi-minute compile), and a ``faulthandler`` all-threads stack
+dump. One report per stall episode: the next beat re-arms the trigger, so a
+long compile produces one report, not one per poll.
+
+The deadline should comfortably exceed the worst *expected* gap — cold-start
+XLA compiles of a big scan program can take minutes, so production runs want
+``watchdog_deadline_s`` in the 300–600s range (see docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from .trace import open_spans
+
+
+@dataclass
+class StallReport:
+    step: int
+    idle_s: float
+    wall_time: float
+    open_spans: dict = field(default_factory=dict)
+    stack_dump: str = ""
+
+    def format(self) -> str:
+        lines = [f"[watchdog] STALL: no step completed for {self.idle_s:.1f}s "
+                 f"(host step {self.step})"]
+        if self.open_spans:
+            for thread, stack in self.open_spans.items():
+                lines.append(f"[watchdog]   open spans [{thread}]: "
+                             + " > ".join(stack))
+        else:
+            lines.append("[watchdog]   no open spans (tracing off or idle "
+                         "between spans)")
+        if self.stack_dump:
+            lines.append("[watchdog]   thread stacks:")
+            lines.extend("[watchdog]     " + ln
+                         for ln in self.stack_dump.splitlines())
+        return "\n".join(lines)
+
+
+def _dump_all_stacks() -> str:
+    """All-threads python stacks via faulthandler (needs a real fd, so route
+    through a temp file)."""
+    with tempfile.TemporaryFile(mode="w+b") as fh:
+        faulthandler.dump_traceback(file=fh, all_threads=True)
+        fh.seek(0)
+        return fh.read().decode("utf-8", errors="replace")
+
+
+class StallWatchdog:
+    """``beat(step)`` once per completed step; a daemon thread raises a stall
+    report through ``log`` (and the optional ``on_stall`` callback) when the
+    gap between beats exceeds ``deadline_s``. ``stall_count``/``last_report``
+    are inspectable afterwards (the CI smoke asserts the watchdog stayed
+    quiet; the unit test asserts a deliberate stall fires it)."""
+
+    def __init__(self, deadline_s: float, *, log: Callable = print,
+                 dump_stacks: bool = True, poll_s: Optional[float] = None,
+                 on_stall: Optional[Callable[[StallReport], None]] = None):
+        if deadline_s <= 0:
+            raise ValueError("watchdog deadline must be > 0 (0 disables the "
+                             "watchdog at the config layer, not here)")
+        self.deadline_s = deadline_s
+        self.log = log
+        self.dump_stacks = dump_stacks
+        self.on_stall = on_stall
+        self.poll_s = poll_s if poll_s is not None else min(deadline_s / 4, 1.0)
+        self.stall_count = 0
+        self.last_report: Optional[StallReport] = None
+        self._step = 0
+        self._last_beat = time.monotonic()
+        self._armed = True            # one report per stall episode
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="grafttrace-watchdog")
+
+    def start(self) -> "StallWatchdog":
+        self._last_beat = time.monotonic()
+        self._thread.start()
+        return self
+
+    def beat(self, step: int) -> None:
+        self._step = step
+        self._last_beat = time.monotonic()
+        self._armed = True
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=max(self.poll_s * 4, 1.0))
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            idle = time.monotonic() - self._last_beat
+            if idle <= self.deadline_s or not self._armed:
+                continue
+            self._armed = False
+            report = StallReport(
+                step=self._step, idle_s=idle, wall_time=time.time(),
+                open_spans=open_spans(),
+                stack_dump=_dump_all_stacks() if self.dump_stacks else "")
+            self.stall_count += 1
+            self.last_report = report
+            try:
+                self.log(report.format())
+                if self.on_stall is not None:
+                    self.on_stall(report)
+            except Exception as e:  # noqa: BLE001 - a crashing log sink must
+                # not kill the watchdog thread (it would die silently and the
+                # run would lose its only stall detector)
+                print(f"[watchdog] stall-report sink failed: {e!r}")

@@ -6,12 +6,17 @@ and throughput metering — one implementation for every model family."""
 from __future__ import annotations
 
 import math
+import os
+import time
 from typing import Callable, Optional
 
 import jax
 import numpy as np
 
 from ..config import TrainConfig
+from ..obs import (DeviceTelemetry, StallWatchdog, export_chrome_trace,
+                   export_spans_jsonl, span)
+from ..obs import configure as obs_configure
 from .checkpoints import CheckpointManager
 
 
@@ -36,6 +41,15 @@ class BaseTrainer:
                                       keep_n=train_cfg.keep_n_checkpoints)
         self._last_good = None   # host copy of (params, opt_state) for rollback
         self._host_step = 0      # host mirror of state.step: no device sync
+        # grafttrace step-breakdown state (set by fit, consumed by
+        # _finish_step; None dispatch-t0 = bare train_step outside fit)
+        self._obs_dispatch_t0 = None
+        self._obs_last_wait = 0.0
+        self._obs_wait_accum = 0.0
+        self._obs_window_t0 = None
+        self._obs_poll_bucket = -1
+        self._telemetry = None
+        self.last_watchdog = None
         # per-instance extras merged into checkpoint metadata, e.g. vae
         # identity for DALLE ckpts (reference legacy/train_dalle.py:535-582)
         self.extra_meta: dict = {}
@@ -73,8 +87,9 @@ class BaseTrainer:
         without a NaN check)."""
         if getattr(self, "_pending_metrics", None) is None:
             return {}
-        metrics = {k: float(v) for k, v in
-                   jax.device_get(self._pending_metrics).items()}
+        with span("fit/sync", on_demand=True):
+            metrics = {k: float(v) for k, v in
+                       jax.device_get(self._pending_metrics).items()}
         rep = self.meter.step(self._host_step)
         if rep:
             metrics.update(rep)
@@ -140,8 +155,29 @@ class BaseTrainer:
         then happen at k-step granularity. Cadences use boundary *crossing*
         (prev//N != cur//N), so a k that does not divide N stretches an
         event by at most k-1 steps, never to lcm(k, N); a NaN rollback
-        rewinds the whole k-step group to the last good snapshot."""
+        rewinds the whole k-step group to the last good snapshot.
+
+        grafttrace (``train_cfg.obs``, docs/OBSERVABILITY.md): every
+        iteration is a ``fit/step`` span nesting ``fit/batch_wait`` (time
+        blocked on the batch iterator), ``fit/dispatch`` (host work + device
+        dispatch), and ``fit/sync`` (the metrics device_get, inside
+        ``_finish_step``); the same splits land in the metrics dict as a
+        per-step breakdown with a data-starvation ratio. With
+        ``obs.watchdog_deadline_s > 0`` a heartbeat watchdog reports stalls
+        (open spans + thread stacks) instead of hanging silently; with
+        ``obs.trace`` the span ring is exported as Perfetto-openable
+        ``trace.json`` + ``spans.jsonl`` when the loop ends."""
         tc = self.train_cfg
+        oc = getattr(tc, "obs", None)
+        tracing = bool(oc is not None and oc.trace)
+        if tracing:
+            obs_configure(oc.ring_capacity)
+        watchdog = None
+        if oc is not None and oc.watchdog_deadline_s > 0:
+            watchdog = StallWatchdog(
+                oc.watchdog_deadline_s, log=log,
+                dump_stacks=oc.watchdog_dump_stacks).start()
+            self.last_watchdog = watchdog
         scan_k = max(getattr(tc, "scan_steps", 1), 1)
         if scan_k > 1:
             assert hasattr(self, "train_steps"), (
@@ -158,60 +194,110 @@ class BaseTrainer:
         def crossed(prev, cur, every):
             return every > 0 and prev // every != cur // every
 
-        for stacked, batch in batches:
-            step_call = self.train_steps if stacked else self.train_step
-            k_this = batch[0].shape[0] if stacked else 1
-            prev_step = self._host_step
-            # profile the REAL step containing profile_step — no hidden
-            # extra update (the reference's flops profile also wraps a live
-            # step, legacy/train_dalle.py:492-499)
-            if tc.profile_step and prev_step < tc.profile_step <= prev_step + k_this:
-                logdir = f"{tc.checkpoint_dir}/profile_step{tc.profile_step}"
-                with jax.profiler.trace(logdir):
-                    m = step_call(*batch)
-                log(f"[profile] step {self._host_step}: trace → {logdir}")
-            else:
-                m = step_call(*batch)
-            step_num = self._host_step
-            # latch the signal flag ONCE per iteration; a save decision must
-            # see the same value the metrics-fetch decision does
-            want_save = (crossed(prev_step, step_num, tc.save_every_steps) or
-                         getattr(self, "_signal_save", False))
-            if not m and want_save:
-                m = self._fetch_pending_metrics()
-            nan = bool(m) and tc.nan_rollback and not math.isfinite(m["loss"])
-            if nan:
-                log(f"[step {step_num}] NaN loss — rolling back to last good state")
-                self._rollback()
-            else:
-                if m and crossed(prev_step, step_num, tc.log_every):
-                    log(f"[step {step_num}] " +
-                        " ".join(f"{k}={v:.5g}" for k, v in m.items()))
-                if m and metrics_writer is not None:
-                    metrics_writer.log(step_num, m)
-                if want_save:
-                    self.ckpt.save(step_num, self.state, meta)
-                    self._snapshot_good()
-                    self._signal_save = False
-                    if (getattr(tc, "log_artifacts", False)
-                            and metrics_writer is not None
-                            and hasattr(metrics_writer, "log_artifact")):
-                        # only the just-written step's directory — uploading
-                        # the whole checkpoint_dir would re-send every
-                        # retained checkpoint each save (ref uploads the one
-                        # new file, legacy/train_dalle.py:667-669)
-                        import os
-                        metrics_writer.log_artifact(
-                            os.path.join(tc.checkpoint_dir, str(step_num)),
-                            name=f"trained-{self.model_class.lower()}",
-                            metadata={"step": step_num})
-                if sample_fn and crossed(prev_step, step_num,
-                                         getattr(tc, "sample_every_steps", 0)):
-                    sample_fn(step_num)
-            # the steps budget must bound the loop even when steps go NaN
-            if steps is not None and step_num >= steps:
-                break
+        self._obs_wait_accum = 0.0
+        self._obs_window_t0 = time.perf_counter()
+        it = iter(batches)
+        _END = object()
+        try:
+            while True:
+                with span("fit/step") as step_span:
+                    t_wait0 = time.perf_counter()
+                    with span("fit/batch_wait"):
+                        item = next(it, _END)
+                    if item is _END:
+                        break
+                    self._obs_last_wait = time.perf_counter() - t_wait0
+                    self._obs_wait_accum += self._obs_last_wait
+                    stacked, batch = item
+                    step_call = self.train_steps if stacked else self.train_step
+                    k_this = batch[0].shape[0] if stacked else 1
+                    prev_step = self._host_step
+                    step_span.set(step=prev_step)
+                    self._obs_dispatch_t0 = time.perf_counter()
+                    # profile the REAL step containing profile_step — no
+                    # hidden extra update (the reference's flops profile also
+                    # wraps a live step, legacy/train_dalle.py:492-499)
+                    if tc.profile_step and prev_step < tc.profile_step <= prev_step + k_this:
+                        logdir = f"{tc.checkpoint_dir}/profile_step{tc.profile_step}"
+                        with jax.profiler.trace(logdir):
+                            with span("fit/dispatch", profiled=True):
+                                m = step_call(*batch)
+                        log(f"[profile] step {self._host_step}: trace → {logdir}")
+                    else:
+                        with span("fit/dispatch"):
+                            m = step_call(*batch)
+                    step_num = self._host_step
+                    if watchdog is not None:
+                        watchdog.beat(step_num)
+                    # latch the signal flag ONCE per iteration; a save
+                    # decision must see the same value the metrics-fetch
+                    # decision does
+                    want_save = (crossed(prev_step, step_num, tc.save_every_steps) or
+                                 getattr(self, "_signal_save", False))
+                    if not m and want_save:
+                        m = self._fetch_pending_metrics()
+                    nan = bool(m) and tc.nan_rollback and not math.isfinite(
+                        self._nan_check_value(m, log))
+                    if nan:
+                        log(f"[step {step_num}] NaN loss — rolling back to last good state")
+                        self._rollback()
+                    else:
+                        if m and crossed(prev_step, step_num, tc.log_every):
+                            log(f"[step {step_num}] " +
+                                " ".join(f"{k}={v:.5g}" for k, v in m.items()))
+                        if m and metrics_writer is not None:
+                            metrics_writer.log(step_num, m)
+                        if want_save:
+                            with span("fit/checkpoint", step=step_num):
+                                self.ckpt.save(step_num, self.state, meta)
+                                self._snapshot_good()
+                            self._signal_save = False
+                            if (getattr(tc, "log_artifacts", False)
+                                    and metrics_writer is not None
+                                    and hasattr(metrics_writer, "log_artifact")):
+                                # only the just-written step's directory —
+                                # uploading the whole checkpoint_dir would
+                                # re-send every retained checkpoint each save
+                                # (ref uploads the one new file,
+                                # legacy/train_dalle.py:667-669)
+                                metrics_writer.log_artifact(
+                                    os.path.join(tc.checkpoint_dir, str(step_num)),
+                                    name=f"trained-{self.model_class.lower()}",
+                                    metadata={"step": step_num})
+                        if sample_fn and crossed(prev_step, step_num,
+                                                 getattr(tc, "sample_every_steps", 0)):
+                            sample_fn(step_num)
+                # the steps budget must bound the loop even when steps go NaN
+                if steps is not None and step_num >= steps:
+                    break
+        finally:
+            self._obs_dispatch_t0 = None   # bare train_step: no breakdown
+            if watchdog is not None:
+                watchdog.stop()
+            if tracing:
+                outdir = oc.trace_dir or os.path.join(tc.checkpoint_dir, "obs")
+                os.makedirs(outdir, exist_ok=True)
+                export_chrome_trace(os.path.join(outdir, "trace.json"))
+                export_spans_jsonl(os.path.join(outdir, "spans.jsonl"))
         return self.state
+
+    def _nan_check_value(self, m: dict, log=print) -> float:
+        """The scalar the NaN-rollback check inspects: ``loss`` when present
+        (every in-repo trainer), else the first finite-checkable scalar — a
+        metrics dict without one used to KeyError the whole fit loop. With
+        nothing checkable the guard is inert (warned once)."""
+        val = m.get("loss")
+        if val is None:
+            val = next((v for v in m.values()
+                        if isinstance(v, (int, float))
+                        and not isinstance(v, bool)), None)
+            if val is None:
+                if not getattr(self, "_warned_no_nan_scalar", False):
+                    log("[nan-guard] metrics carry no 'loss' or other "
+                        "finite-checkable scalar; NaN rollback is inactive")
+                    self._warned_no_nan_scalar = True
+                return 0.0   # finite → never triggers a rollback
+        return val
 
     def _snapshot_good(self):
         # NaN loss is observed AFTER apply_gradients has run, so the optimizer
@@ -235,15 +321,62 @@ class BaseTrainer:
 
         With ``metrics_every > 1`` the device_get (a host↔device sync that
         stalls the step pipeline) only happens every N steps; other steps
-        return an empty dict and fit() skips their NaN check / logging."""
+        return an empty dict and fit() skips their NaN check / logging.
+
+        Boundary steps additionally carry the grafttrace step breakdown
+        (batch-wait/dispatch/sync splits, data-starvation ratio) and — at
+        ``obs.device_poll_every`` cadence — the HBM and recompile gauges."""
         self._host_step += 1
         self._pending_metrics = metrics   # fit() fetches these on demand at
                                           # save boundaries (NaN-check gate)
         every = max(getattr(self.train_cfg, "metrics_every", 1), 1)
         if self._host_step % every != 0:
             return {}
-        metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
+        sync0 = time.perf_counter()
+        with span("fit/sync"):
+            metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
         rep = self.meter.step(self._host_step)
         if rep:
             metrics.update(rep)
+        metrics.update(self._step_breakdown(sync0, time.perf_counter()))
         return metrics
+
+    def _step_breakdown(self, sync0: float, now: float) -> dict:
+        """Where did the step go? batch wait vs dispatch vs sync, plus the
+        waiting-on-data share of the whole window since the last report (so
+        ``metrics_every``-skipped steps are covered) — 'input-bound vs
+        compute-bound' as a logged metric instead of a guess. Device gauges
+        (HBM used/peak, compiles, recompiles-per-100-steps) ride along every
+        ``obs.device_poll_every`` steps, and the merged dict is mirrored to
+        the Prometheus textfile when ``obs.prometheus_path`` is set. Only
+        meaningful under fit(): a bare ``train_step()`` call has no
+        batch-wait context and gets no breakdown."""
+        t0 = getattr(self, "_obs_dispatch_t0", None)
+        if t0 is None:
+            return {}
+        out = {"t_batch_wait_s": self._obs_last_wait,
+               "t_dispatch_s": sync0 - t0,
+               "t_sync_s": now - sync0}
+        window_t0 = getattr(self, "_obs_window_t0", None)
+        if window_t0 is not None and now > window_t0:
+            out["data_starvation"] = min(self._obs_wait_accum / (now - window_t0), 1.0)
+        self._obs_window_t0 = now
+        self._obs_wait_accum = 0.0
+        oc = getattr(self.train_cfg, "obs", None)
+        if oc is not None and oc.device_poll_every > 0:
+            bucket = self._host_step // oc.device_poll_every
+            if bucket != getattr(self, "_obs_poll_bucket", -1):
+                self._obs_poll_bucket = bucket
+                if getattr(self, "_telemetry", None) is None:
+                    self._telemetry = DeviceTelemetry()
+                # gauges flow through the metrics dict only — mirroring them
+                # into the tracer's gauge map would re-export every value a
+                # second time under an obs.-prefixed alias in each record
+                out.update(self._telemetry.poll(self._host_step))
+                if oc.prometheus_path:
+                    from ..obs import metrics_snapshot
+                    from ..obs import write_textfile as prom_write
+                    prom_write(oc.prometheus_path,
+                               {**out, **metrics_snapshot(),
+                                "host_step": self._host_step})
+        return out

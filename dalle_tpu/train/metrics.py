@@ -22,13 +22,32 @@ PEAK_TFLOPS = {
 }
 
 
-def device_peak_tflops(device: Optional[jax.Device] = None) -> float:
+_warned_unknown_peak = False
+
+
+def device_peak_tflops_info(device: Optional[jax.Device] = None
+                            ) -> tuple[float, bool]:
+    """(peak bf16 TFLOP/s, estimated?) — ``estimated`` is True when the
+    device kind has no entry in PEAK_TFLOPS and the 100.0 placeholder is in
+    play, so MFU consumers can tag the number as fiction instead of fact."""
+    global _warned_unknown_peak
     d = device or jax.devices()[0]
     kind = getattr(d, "device_kind", "cpu")
     for k, v in PEAK_TFLOPS.items():
         if kind.lower().startswith(k.lower()):
-            return v
-    return 100.0  # unknown accelerator: conservative guess
+            return v, False
+    if not _warned_unknown_peak:
+        import warnings
+        warnings.warn(
+            f"unknown accelerator {kind!r}: MFU uses a 100 TFLOP/s guess and "
+            "reports are tagged mfu_estimated — add the chip's peak to "
+            "train/metrics.PEAK_TFLOPS for a real number")
+        _warned_unknown_peak = True
+    return 100.0, True
+
+
+def device_peak_tflops(device: Optional[jax.Device] = None) -> float:
+    return device_peak_tflops_info(device)[0]
 
 
 class ThroughputMeter:
@@ -64,8 +83,12 @@ class ThroughputMeter:
             rep["tokens_per_sec_per_chip"] = sps * self.tokens_per_sample / self.num_chips
         if self.flops_per_step:
             achieved = self.flops_per_step * n_steps / dt
-            peak = device_peak_tflops() * 1e12 * self.num_chips
-            rep["mfu"] = achieved / peak
+            peak_tflops, estimated = device_peak_tflops_info()
+            rep["mfu"] = achieved / (peak_tflops * 1e12 * self.num_chips)
+            if estimated:
+                # unknown chip → the denominator is a guess; without the tag
+                # the report would present a made-up MFU as authoritative
+                rep["mfu_estimated"] = True
         self._last_report = rep
         return rep
 
@@ -109,12 +132,29 @@ class MetricsLogger:
                 # installed / auth failure: all degrade to jsonl-only logging
                 print(f"[metrics] wandb unavailable ({e!r}); jsonl only")
 
+    @staticmethod
+    def _coerce_scalar(v):
+        """Numeric scalars of ANY stripe → float: np.float32 is not a
+        ``float`` and a 0-d device array is not an ``int``, so the plain
+        isinstance filter used to drop them from the JSONL silently.
+        Returns None for non-scalars (arrays, objects)."""
+        if isinstance(v, (bool, int, float, str)):
+            return v
+        if getattr(v, "ndim", None) == 0:   # 0-d numpy/jax array, np scalar
+            try:
+                return float(v)
+            except (TypeError, ValueError):  # non-numeric dtype
+                return None
+        return None
+
     def log(self, step: int, metrics: dict):
         import json
         import time as _time
+        from ..obs import metrics_snapshot
+        merged = {**metrics, **metrics_snapshot()}   # obs counters/gauges
+        coerced = ((k, self._coerce_scalar(v)) for k, v in merged.items())
         rec = {"step": step, "time": _time.time(),
-               **{k: v for k, v in metrics.items()
-                  if isinstance(v, (int, float, str))}}
+               **{k: v for k, v in coerced if v is not None}}
         if self._fh is not None:
             self._fh.write(json.dumps(rec) + "\n")
             self._fh.flush()

@@ -17,6 +17,7 @@ import optax
 
 from ..config import ClipConfig, TrainConfig
 from ..models.clip import CLIP, init_clip
+from ..obs import span
 from ..parallel import shard_batch, shard_params
 from .base_trainer import BaseTrainer
 from .metrics import ThroughputMeter, count_params, transformer_train_flops
@@ -78,9 +79,11 @@ class CLIPTrainer(BaseTrainer):
             num_chips=self.mesh.size)
 
     def train_step(self, text: np.ndarray, images: np.ndarray):
-        text = shard_batch(self.mesh, np.asarray(text, np.int32))
-        images = shard_batch(self.mesh, np.asarray(images, np.float32))
-        self.state, metrics = self.step_fn(self.state, text, images)
+        with span("clip/shard_batch"):
+            text = shard_batch(self.mesh, np.asarray(text, np.int32))
+            images = shard_batch(self.mesh, np.asarray(images, np.float32))
+        with span("clip/step"):
+            self.state, metrics = self.step_fn(self.state, text, images)
         return self._finish_step(metrics)
 
     def train_steps(self, texts: np.ndarray, imagess: np.ndarray):
@@ -92,12 +95,14 @@ class CLIPTrainer(BaseTrainer):
             self._multi_step_fn = make_clip_train_multi_step(
                 self.model, dtype=compute_dtype(self.train_cfg.precision))
         from ..parallel import shard_stacked_batch
-        texts = shard_stacked_batch(self.mesh, np.asarray(texts, np.int32))
-        imagess = shard_stacked_batch(self.mesh,
-                                      np.asarray(imagess, np.float32))
         k = texts.shape[0]
-        self.state, metrics = self._multi_step_fn(self.state,
-                                                  (texts, imagess))
+        with span("clip/shard_batch", k=k):
+            texts = shard_stacked_batch(self.mesh, np.asarray(texts, np.int32))
+            imagess = shard_stacked_batch(self.mesh,
+                                          np.asarray(imagess, np.float32))
+        with span("clip/steps", k=k):
+            self.state, metrics = self._multi_step_fn(self.state,
+                                                      (texts, imagess))
         self._host_step += k - 1     # _finish_step adds the final +1
         return self._finish_step(metrics)
 

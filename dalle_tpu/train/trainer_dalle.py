@@ -26,6 +26,7 @@ import optax
 
 from ..config import DalleConfig, TrainConfig
 from ..models.dalle import DALLE, init_dalle
+from ..obs import span
 from ..parallel import shard_batch, shard_params, shard_stacked_batch
 from .base_trainer import BaseTrainer
 from .metrics import ThroughputMeter, count_params, transformer_train_flops
@@ -155,9 +156,11 @@ class DalleTrainer(BaseTrainer):
     # -- single step ---------------------------------------------------------
     def train_step(self, text: np.ndarray, image_ids: np.ndarray):
         key = jax.random.fold_in(self.base_key, self._host_step)
-        text = shard_batch(self.mesh, np.asarray(text, np.int32))
-        image_ids = shard_batch(self.mesh, np.asarray(image_ids, np.int32))
-        self.state, metrics = self.step_fn(self.state, text, image_ids, key)
+        with span("dalle/shard_batch"):
+            text = shard_batch(self.mesh, np.asarray(text, np.int32))
+            image_ids = shard_batch(self.mesh, np.asarray(image_ids, np.int32))
+        with span("dalle/step"):
+            self.state, metrics = self.step_fn(self.state, text, image_ids, key)
         return self._finish_step(metrics)
 
     # -- k steps in one device program ---------------------------------------
@@ -173,10 +176,12 @@ class DalleTrainer(BaseTrainer):
                 self.model, **self._multi_step_kw)
         k = texts.shape[0]
         keys = self._step_keys(k)
-        texts = shard_stacked_batch(self.mesh, np.asarray(texts, np.int32))
-        image_ids = shard_stacked_batch(self.mesh,
-                                        np.asarray(image_ids, np.int32))
-        self.state, metrics = self._multi_step_fn(self.state, texts,
-                                                  image_ids, keys)
+        with span("dalle/shard_batch", k=k):
+            texts = shard_stacked_batch(self.mesh, np.asarray(texts, np.int32))
+            image_ids = shard_stacked_batch(self.mesh,
+                                            np.asarray(image_ids, np.int32))
+        with span("dalle/steps", k=k):
+            self.state, metrics = self._multi_step_fn(self.state, texts,
+                                                      image_ids, keys)
         self._host_step += k - 1     # _finish_step adds the final +1
         return self._finish_step(metrics)

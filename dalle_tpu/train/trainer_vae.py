@@ -27,6 +27,7 @@ import optax
 
 from ..config import AnnealConfig, DVAEConfig, TrainConfig
 from ..models.dvae import DiscreteVAE, init_dvae
+from ..obs import span
 from ..parallel import shard_batch, shard_params
 from .base_trainer import BaseTrainer
 from .metrics import ThroughputMeter, count_params
@@ -111,9 +112,11 @@ class VAETrainer(BaseTrainer):
         step_num = self._host_step
         temp = anneal_temperature(self.anneal_cfg, step_num)
         key = jax.random.fold_in(self.base_key, step_num)
-        images = shard_batch(self.mesh, images.astype(np.float32))
-        self.state, metrics = self.step_fn(self.state, images, key,
-                                           jnp.float32(temp))
+        with span("vae/shard_batch"):
+            images = shard_batch(self.mesh, images.astype(np.float32))
+        with span("vae/step"):
+            self.state, metrics = self.step_fn(self.state, images, key,
+                                               jnp.float32(temp))
         metrics = self._finish_step(metrics)
         if metrics:   # empty when metrics_every skips the host sync this step
             metrics["temperature"] = temp
@@ -137,10 +140,12 @@ class VAETrainer(BaseTrainer):
         temps = jnp.asarray([anneal_temperature(self.anneal_cfg, int(s))
                              for s in steps], jnp.float32)
         from ..parallel import shard_stacked_batch
-        images = shard_stacked_batch(self.mesh,
-                                     np.asarray(images, np.float32))
-        self.state, metrics = self._multi_step_fn(
-            self.state, (images, keys, temps))
+        with span("vae/shard_batch", k=k):
+            images = shard_stacked_batch(self.mesh,
+                                         np.asarray(images, np.float32))
+        with span("vae/steps", k=k):
+            self.state, metrics = self._multi_step_fn(
+                self.state, (images, keys, temps))
         self._host_step += k - 1     # _finish_step adds the final +1
         metrics = self._finish_step(metrics)
         if metrics:

@@ -41,6 +41,7 @@ from ..models.gan import (GANLossConfig, NLayerDiscriminator, adaptive_disc_weig
                           vanilla_d_loss)
 from ..models.lpips import LPIPS, init_lpips
 from ..models.vqgan import VQModel, init_vqgan
+from ..obs import span
 from ..parallel import shard_batch, shard_params
 from .base_trainer import BaseTrainer
 from .metrics import ThroughputMeter, count_params
@@ -327,15 +328,18 @@ class VQGANTrainer(BaseTrainer):
         temp = (self.temp_scheduler(step_num) if self.temp_scheduler is not None
                 else 1.0)
         key = jax.random.fold_in(self.base_key, step_num)
-        images = shard_batch(self.mesh, images.astype(np.float32))
+        with span("vqgan/shard_batch"):
+            images = shard_batch(self.mesh, images.astype(np.float32))
         if self.loss_mode != "gan":
             t = images if targets is None else shard_batch(
                 self.mesh, np.asarray(targets, np.float32))
-            self.state, metrics = self.step_fn(self.state, images, t, key,
-                                               jnp.float32(temp))
+            with span("vqgan/step"):
+                self.state, metrics = self.step_fn(self.state, images, t, key,
+                                                   jnp.float32(temp))
             return self._finish_step(metrics)
-        self.state, metrics = self.step_fn(self.state, images, key,
-                                           jnp.float32(temp))
+        with span("vqgan/step"):
+            self.state, metrics = self.step_fn(self.state, images, key,
+                                               jnp.float32(temp))
         metrics = self._finish_step(metrics)
         if metrics and self.temp_scheduler is not None:
             metrics["temperature"] = temp
@@ -364,14 +368,16 @@ class VQGANTrainer(BaseTrainer):
             [self.temp_scheduler(int(s)) if self.temp_scheduler is not None
              else 1.0 for s in steps], jnp.float32)
         keys = self._step_keys(k)
-        images = shard_stacked_batch(self.mesh, images.astype(np.float32))
+        with span("vqgan/shard_batch", k=k):
+            images = shard_stacked_batch(self.mesh, images.astype(np.float32))
         if self.loss_mode != "gan":
             t = images if targets is None else shard_stacked_batch(
                 self.mesh, np.asarray(targets, np.float32))
             xs = (images, t, keys, temps)
         else:
             xs = (images, keys, temps)
-        self.state, metrics = self._multi_step_fn(self.state, xs)
+        with span("vqgan/steps", k=k):
+            self.state, metrics = self._multi_step_fn(self.state, xs)
         self._host_step += k - 1     # _finish_step adds the final +1
         metrics = self._finish_step(metrics)
         if metrics and self.temp_scheduler is not None:

@@ -41,6 +41,19 @@ def run_lint_stage(changed_only: bool) -> int:
     return subprocess.run(cmd, cwd=ROOT, env=env).returncode
 
 
+def run_obs_smoke_stage() -> int:
+    """The grafttrace smoke stage: a 5-step synthetic traced fit that must
+    produce a well-formed Perfetto trace, the step-time breakdown in the
+    metrics JSONL, a quiet watchdog, and <1% span overhead
+    (scripts/obs_smoke.py; the workflow's matching step is skipped below).
+    Artifacts land in ./obs_artifacts — the dir ci.yml uploads."""
+    cmd = [sys.executable, os.path.join(ROOT, "scripts", "obs_smoke.py"),
+           "--outdir", os.path.join(ROOT, "obs_artifacts")]
+    print(f"== [obs] {' '.join(cmd[1:])}")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(cmd, cwd=ROOT, env=env).returncode
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--changed-only", action="store_true",
@@ -49,6 +62,10 @@ def main():
 
     if run_lint_stage(args.changed_only) != 0:
         print("ci_local: FAILED (lint stage) — test tiers not run")
+        return 1
+
+    if run_obs_smoke_stage() != 0:
+        print("ci_local: FAILED (observability smoke) — test tiers not run")
         return 1
 
     wf = yaml.safe_load(open(os.path.join(ROOT, ".github/workflows/ci.yml")))
@@ -62,6 +79,9 @@ def main():
         cmd = step["run"]
         if "scripts/lint.py" in cmd:
             print(f"-- [skip] {name}: already run in the lint stage")
+            continue
+        if "scripts/obs_smoke.py" in cmd:
+            print(f"-- [skip] {name}: already run in the obs smoke stage")
             continue
         if any(m in cmd for m in NETWORK_MARKERS):
             # the editable-install smoke is half network, half local: keep

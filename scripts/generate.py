@@ -62,6 +62,11 @@ def build_parser():
                          "generations, best first (reference "
                          "generate_images :553-555)")
     ap.add_argument("--outputs_dir", type=str, default="./outputs")
+    ap.add_argument("--trace", type=str, default=None, metavar="DIR",
+                    help="grafttrace the run: per-prompt/batch spans + "
+                         "per-token decode latency, exported to DIR as "
+                         "Perfetto trace.json + spans.jsonl "
+                         "(docs/OBSERVABILITY.md)")
     ap.add_argument("--tokenizer", type=str, default="simple")
     ap.add_argument("--bpe_path", type=str, default=None)
     ap.add_argument("--image_size", type=int, default=128)
@@ -88,6 +93,9 @@ def main(argv=None):
     from dalle_tpu.parallel import set_backend_from_args
     from dalle_tpu.text.tokenizer import get_tokenizer
 
+    from dalle_tpu import obs
+    if args.trace:
+        obs.configure()
     backend = set_backend_from_args(args).initialize()
     tok_kw = {"bpe_path": args.bpe_path} if args.bpe_path else {}
     tokenizer = get_tokenizer(args.tokenizer, **tok_kw)
@@ -127,54 +135,65 @@ def main(argv=None):
 
     prompts = [t.strip() for t in args.text.split("|") if t.strip()]
     for prompt in prompts:
-        text_str = prompt
-        if args.gentxt:
-            tkey, key = jax.random.split(key)
-            prime = tokenizer.tokenize([prompt], cfg.text_seq_len,
-                                       truncate_text=True)
-            prime = prime[:, :max(1, int((prime != 0).sum()))]
-            out_ids = dv.generate_texts(tkey, np.asarray(prime))
-            text_str = tokenizer.decode(np.asarray(out_ids)[0])
-            print(f"gentxt: {prompt!r} → {text_str!r}")
-        text = tokenizer.tokenize([text_str], cfg.text_seq_len,
-                                  truncate_text=True)
-        outdir = os.path.join(args.outputs_dir,
-                              text_str.replace(" ", "_")[:64])
-        os.makedirs(outdir, exist_ok=True)
-        made = 0
-        all_imgs, all_scores = [], []
-        while made < args.num_images:
-            n = min(args.batch_size, args.num_images - made)
-            bkey, key = jax.random.split(key)
-            batch_text = np.repeat(text, n, axis=0)
-            out = dv.generate_images(
-                batch_text, bkey, filter_thres=args.top_k_thres,
-                temperature=args.temperature, cond_scale=args.cond_scale,
-                clip=clip,
-                precision=("int8w" if args.int8w
-                           else "bf16_int8kv" if args.kv_int8
-                           else "bfloat16" if args.bf16 else "float32"),
-                topk_approx=args.fast_topk,
-                speculative=args.speculative, draft=args.draft)
+        with obs.span("generate/prompt", prompt=prompt[:64]):
+            text_str = prompt
+            if args.gentxt:
+                tkey, key = jax.random.split(key)
+                prime = tokenizer.tokenize([prompt], cfg.text_seq_len,
+                                           truncate_text=True)
+                prime = prime[:, :max(1, int((prime != 0).sum()))]
+                out_ids = dv.generate_texts(tkey, np.asarray(prime))
+                text_str = tokenizer.decode(np.asarray(out_ids)[0])
+                print(f"gentxt: {prompt!r} → {text_str!r}")
+            text = tokenizer.tokenize([text_str], cfg.text_seq_len,
+                                      truncate_text=True)
+            outdir = os.path.join(args.outputs_dir,
+                                  text_str.replace(" ", "_")[:64])
+            os.makedirs(outdir, exist_ok=True)
+            made = 0
+            all_imgs, all_scores = [], []
+            while made < args.num_images:
+                n = min(args.batch_size, args.num_images - made)
+                bkey, key = jax.random.split(key)
+                batch_text = np.repeat(text, n, axis=0)
+                out = dv.generate_images(
+                    batch_text, bkey, filter_thres=args.top_k_thres,
+                    temperature=args.temperature, cond_scale=args.cond_scale,
+                    clip=clip,
+                    precision=("int8w" if args.int8w
+                               else "bf16_int8kv" if args.kv_int8
+                               else "bfloat16" if args.bf16 else "float32"),
+                    topk_approx=args.fast_topk,
+                    speculative=args.speculative, draft=args.draft)
+                if clip is not None:
+                    # reranking needs the whole set — accumulate
+                    imgs, scores = out
+                    all_scores.append(np.asarray(scores))
+                    all_imgs.append(np.asarray(imgs))
+                else:
+                    # stream each batch to disk as it is produced
+                    save_image_grid(np.asarray(out),
+                                    os.path.join(outdir, f"img_{made}_{{}}.png"))
+                made += n
             if clip is not None:
-                # reranking needs the whole set — accumulate
-                imgs, scores = out
-                all_scores.append(np.asarray(scores))
-                all_imgs.append(np.asarray(imgs))
-            else:
-                # stream each batch to disk as it is produced
-                save_image_grid(np.asarray(out),
-                                os.path.join(outdir, f"img_{made}_{{}}.png"))
-            made += n
-        if clip is not None:
-            # best-first ordering by CLIP similarity (reference :553-555)
-            imgs = np.concatenate(all_imgs)
-            scores = np.concatenate(all_scores)
-            order = np.argsort(-scores)
-            print("clip scores (best first): "
-                  + " ".join(f"{scores[i]:.4f}" for i in order))
-            save_image_grid(imgs[order], os.path.join(outdir, "img_{}.png"))
-        print(f"wrote {made} images for {text_str!r} → {outdir}")
+                # best-first ordering by CLIP similarity (reference :553-555)
+                imgs = np.concatenate(all_imgs)
+                scores = np.concatenate(all_scores)
+                order = np.argsort(-scores)
+                print("clip scores (best first): "
+                      + " ".join(f"{scores[i]:.4f}" for i in order))
+                save_image_grid(imgs[order], os.path.join(outdir, "img_{}.png"))
+            print(f"wrote {made} images for {text_str!r} → {outdir}")
+    if args.trace:
+        os.makedirs(args.trace, exist_ok=True)
+        n = obs.export_chrome_trace(os.path.join(args.trace, "trace.json"))
+        obs.export_spans_jsonl(os.path.join(args.trace, "spans.jsonl"))
+        snap = obs.metrics_snapshot()
+        if "obs.decode_per_token_ms" in snap:
+            print(f"[trace] last per-token decode latency: "
+                  f"{snap['obs.decode_per_token_ms']:.3f} ms")
+        print(f"[trace] {n} spans → {args.trace}/trace.json (Perfetto), "
+              f"spans.jsonl (scripts/obs_report.py)")
     return 0
 
 

@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""Summarize a grafttrace run: step-time histogram + top-k slowest spans.
+
+Input is any grafttrace output — a run directory (picks up every ``.jsonl``
+inside, e.g. ``<checkpoint_dir>/obs/`` or a ``--trace`` export dir), a
+``spans.jsonl``, or a ``MetricsLogger`` metrics JSONL. Span rows yield the
+per-name aggregate and slowest-spans tables; metrics rows yield the
+step-time histogram, the input-bound/compute-bound verdict from the
+data-starvation ratio, and HBM/recompile callouts. See docs/OBSERVABILITY.md
+for reading the output.
+
+Examples:
+  python scripts/obs_report.py ./checkpoints/obs
+  python scripts/obs_report.py ./metrics.jsonl --top 20
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("path", help="run directory or .jsonl file")
+    ap.add_argument("--top", type=int, default=10,
+                    help="rows in the top-k span tables")
+    args = ap.parse_args(argv)
+
+    from dalle_tpu.obs.report import summarize_run
+    if not os.path.exists(args.path):
+        print(f"error: {args.path} does not exist", file=sys.stderr)
+        return 2
+    print(summarize_run(args.path, topk=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,13 +24,26 @@ def main(argv=None):
     ap.add_argument("--variants", type=int, default=4,
                     help="rotated/dithered variants per (color,shape,scale) combo")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--trace", type=str, default=None, metavar="DIR",
+                    help="grafttrace dataset generation (span per phase), "
+                         "exported to DIR (docs/OBSERVABILITY.md)")
     args = ap.parse_args(argv)
 
     from dalle_tpu.data.synthetic import ShapesDataset
-    ds = ShapesDataset(image_size=args.image_size, variants=args.variants,
-                       seed=args.seed)
-    n = ds.save_folder(args.outdir, count=args.count)
+    from dalle_tpu.obs import trace as obs_trace
+    if args.trace:
+        obs_trace.configure()
+    with obs_trace.span("sampler/build_dataset"):
+        ds = ShapesDataset(image_size=args.image_size, variants=args.variants,
+                           seed=args.seed)
+    with obs_trace.span("sampler/save_folder", outdir=args.outdir):
+        n = ds.save_folder(args.outdir, count=args.count)
     print(f"wrote {n} image/caption pairs to {args.outdir}")
+    if args.trace:
+        os.makedirs(args.trace, exist_ok=True)
+        obs_trace.export_chrome_trace(os.path.join(args.trace, "trace.json"))
+        obs_trace.export_spans_jsonl(os.path.join(args.trace, "spans.jsonl"))
+        print(f"[trace] exported to {args.trace}")
     return 0
 
 

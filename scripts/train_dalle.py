@@ -93,6 +93,17 @@ def build_parser():
     train.add_argument("--flops_profiler", action="store_true",
                        help="profile at step 200 then exit (ref :492-499)")
 
+    tel = ap.add_argument_group("telemetry (grafttrace, docs/OBSERVABILITY.md)")
+    tel.add_argument("--trace", action="store_true",
+                     help="collect spans; exports <output_dir>/obs/"
+                          "{trace.json,spans.jsonl} (Perfetto / obs_report)")
+    tel.add_argument("--watchdog_deadline_s", type=float, default=0.0,
+                     help="stall report if no step completes within this "
+                          "many seconds (0 = off; set above worst expected "
+                          "compile, e.g. 600 on pods)")
+    tel.add_argument("--prometheus_path", type=str, default="",
+                     help="node-exporter textfile target for live gauges")
+
     from dalle_tpu.parallel import wrap_arg_parser
     wrap_arg_parser(ap)
     return ap
@@ -106,7 +117,7 @@ def main(argv=None):
         return 2
 
     import numpy as np
-    from dalle_tpu.config import DalleConfig, OptimConfig, TrainConfig
+    from dalle_tpu.config import DalleConfig, ObsConfig, OptimConfig, TrainConfig
     from dalle_tpu.models.wrapper import DalleWithVae, dalle_config_for_vae
     from dalle_tpu.parallel import set_backend_from_args
     from dalle_tpu.text.tokenizer import get_tokenizer
@@ -148,7 +159,10 @@ def main(argv=None):
         optim=OptimConfig(learning_rate=args.learning_rate,
                           grad_clip_norm=args.clip_grad_norm,
                           grad_accum_steps=args.ga_steps,
-                          lr_scheduler=args.lr_scheduler))
+                          lr_scheduler=args.lr_scheduler),
+        obs=ObsConfig(trace=args.trace,
+                      watchdog_deadline_s=args.watchdog_deadline_s,
+                      prometheus_path=args.prometheus_path))
 
     trainer = DalleTrainer(model_cfg, train_cfg, backend=backend,
                            null_cond_prob=args.null_cond_prob)

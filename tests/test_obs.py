@@ -1,0 +1,350 @@
+"""grafttrace telemetry (dalle_tpu/obs/): spans, ring buffer, exports,
+counters/gauges, Prometheus textfile, device telemetry, stall watchdog, and
+the MetricsLogger/MFU satellites."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dalle_tpu import obs
+from dalle_tpu.obs import prometheus as prom
+from dalle_tpu.obs import report as obs_report
+
+
+@pytest.fixture
+def tracer():
+    """A fresh enabled tracer, disabled again afterwards (the global default
+    must stay off: other test modules measure span cost as one None check)."""
+    obs.disable()
+    tr = obs.configure(capacity=256)
+    yield tr
+    obs.disable()
+
+
+# -- span core --------------------------------------------------------------
+
+def test_span_disabled_is_noop():
+    obs.disable()
+    with obs.span("x") as sp:
+        pass
+    assert sp.duration is None
+    assert obs.metrics_snapshot() == {}
+
+
+def test_span_nesting_depth_and_order(tracer):
+    with obs.span("outer"):
+        with obs.span("inner"):
+            pass
+    rows = list(tracer.spans)
+    assert [(r[0], r[4]) for r in rows] == [("inner", 1), ("outer", 0)]
+    inner, outer = rows
+    assert 0 <= inner[2] <= outer[2]       # inner duration within outer's
+
+
+def test_span_args_and_set(tracer):
+    with obs.span("s", step=3) as sp:
+        sp.set(extra=1)
+    assert list(tracer.spans)[0][5] == {"step": 3, "extra": 1}
+    assert sp.duration is not None and sp.duration >= 0
+
+
+def test_span_decorator(tracer):
+    @obs.span("deco")
+    def f(x):
+        return x + 1
+
+    assert f(1) == 2 and f(2) == 3
+    assert [r[0] for r in tracer.spans] == ["deco", "deco"]
+
+
+def test_span_records_on_exception(tracer):
+    with pytest.raises(ValueError):
+        with obs.span("boom"):
+            raise ValueError("x")
+    assert [r[0] for r in tracer.spans] == ["boom"]
+    assert obs.open_spans() == {}          # stack unwound
+
+
+def test_ring_overflow_is_counted():
+    obs.disable()
+    tr = obs.configure(capacity=8)
+    try:
+        for i in range(20):
+            with obs.span(f"s{i}"):
+                pass
+        assert len(tr.spans) == 8
+        assert tr.dropped == 12
+        assert obs.metrics_snapshot()["obs.spans_dropped"] == 12
+    finally:
+        obs.disable()
+
+
+def test_thread_local_stacks(tracer):
+    """Spans in a worker thread must not nest under the main thread's open
+    span (independent per-thread depth), and open_spans sees both."""
+    seen = {}
+    release = threading.Event()
+
+    def worker():
+        with obs.span("worker_span"):
+            seen.update(obs.open_spans())
+            release.wait(2.0)
+
+    with obs.span("main_span"):
+        t = threading.Thread(target=worker)
+        t.start()
+        while len(seen) < 2 and t.is_alive():
+            time.sleep(0.005)
+        release.set()
+        t.join()
+    stacks = list(seen.values())
+    assert ["main_span"] in stacks and ["worker_span"] in stacks
+    by_name = {r[0]: r for r in tracer.spans}
+    assert by_name["worker_span"][4] == 0   # depth 0 in its own thread
+
+
+def test_export_while_another_thread_records(tmp_path, tracer):
+    """Exports snapshot the ring under the lock: iterating a deque that a
+    prefetch-style thread is appending to would otherwise raise
+    'deque mutated during iteration' right in fit's export-on-exit."""
+    stop = threading.Event()
+
+    def worker():
+        while not stop.is_set():
+            with obs.span("w"):
+                pass
+
+    t = threading.Thread(target=worker)
+    t.start()
+    try:
+        for _ in range(40):
+            obs.export_spans_jsonl(str(tmp_path / "s.jsonl"))
+            obs.export_chrome_trace(str(tmp_path / "t.json"))
+    finally:
+        stop.set()
+        t.join()
+
+
+def test_configure_resize_keeps_newest_spans(tracer):
+    for i in range(20):
+        with obs.span(f"s{i}"):
+            pass
+    tr = obs.configure(capacity=4)          # shrink in place, not ignored
+    assert tr is tracer and tr.capacity == 4
+    assert [r[0] for r in tr.snapshot_spans()] == ["s16", "s17", "s18", "s19"]
+
+
+# -- exports ----------------------------------------------------------------
+
+def test_chrome_trace_export(tmp_path, tracer):
+    with obs.span("parent", step=1):
+        with obs.span("child"):
+            pass
+    path = str(tmp_path / "trace.json")
+    n = obs.export_chrome_trace(path)
+    doc = json.load(open(path))
+    events = doc["traceEvents"]
+    assert n == len(events) == 2
+    ev = {e["name"]: e for e in events}
+    assert all(e["ph"] == "X" for e in events)
+    # microsecond containment: child inside parent
+    assert ev["parent"]["ts"] <= ev["child"]["ts"]
+    assert (ev["child"]["ts"] + ev["child"]["dur"]
+            <= ev["parent"]["ts"] + ev["parent"]["dur"] + 1)
+    assert ev["parent"]["args"] == {"step": 1}
+
+
+def test_spans_jsonl_export_and_report(tmp_path, tracer):
+    for i in range(3):
+        with obs.span("work", i=i):
+            pass
+    path = str(tmp_path / "spans.jsonl")
+    assert obs.export_spans_jsonl(path) == 3
+    rows = obs_report.load_jsonl(path)
+    assert all(r["name"] == "work" and "dur_s" in r for r in rows)
+    agg = obs_report.span_aggregate(rows)
+    assert agg[0]["name"] == "work" and agg[0]["count"] == 3
+    text = obs_report.summarize_run(path)
+    assert "work" in text and "slowest" in text
+
+
+def test_report_metrics_rows(tmp_path):
+    path = str(tmp_path / "metrics.jsonl")
+    with open(path, "w") as fh:
+        for i in range(1, 6):
+            fh.write(json.dumps({
+                "step": i, "time": float(i), "step_time_s": 0.1 * i,
+                "data_starvation": 0.8, "hbm_bytes_in_use": 1 << 20}) + "\n")
+    text = obs_report.summarize_run(path)
+    assert "INPUT-BOUND" in text and "hbm in use" in text
+
+
+# -- counters / gauges / prometheus -----------------------------------------
+
+def test_counters_and_gauges(tracer):
+    obs.counter_add("obs.events_total", 2)
+    obs.counter_add("obs.events_total", 3)
+    obs.gauge_set("obs.depth", 4)
+    snap = obs.metrics_snapshot()
+    assert snap["obs.events_total"] == 5 and snap["obs.depth"] == 4.0
+
+
+def test_prometheus_textfile(tmp_path):
+    path = str(tmp_path / "m.prom")
+    content = prom.write_textfile(
+        path, {"obs.decode_tokens_total": 7, "obs.hbm/used": 3.5,
+               "note": "not-a-number"})
+    assert open(path).read() == content
+    assert "# TYPE dalle_obs_decode_tokens_total counter" in content
+    assert "dalle_obs_decode_tokens_total 7" in content
+    assert "# TYPE dalle_obs_hbm_used gauge" in content
+    assert "not-a-number" not in content
+    assert not (tmp_path / "m.prom.tmp").exists()   # atomic replace
+
+
+# -- device telemetry --------------------------------------------------------
+
+def test_device_memory_stats_always_has_gauge():
+    out = obs.device_memory_stats()
+    assert isinstance(out["hbm_bytes_in_use"], int)
+
+
+def test_device_telemetry_poll_and_compile_rate():
+    import jax
+    import jax.numpy as jnp
+    tele = obs.DeviceTelemetry(window=100)
+    first = tele.poll(0)
+    assert "compiles_total" in first and "hbm_peak_bytes" in first
+    jax.jit(lambda x: x * 2 + 1)(jnp.arange(7))     # fresh program: compiles
+    second = tele.poll(10)
+    assert second["compiles_total"] > first["compiles_total"]
+    assert second["recompiles_per_100_steps"] > 0
+
+
+def test_compile_counter_shared_with_recompile_guard():
+    """The guard's counter and the obs counter are the SAME process-wide
+    listener (lifted, not duplicated)."""
+    from dalle_tpu.analysis import recompile_guard
+    from dalle_tpu.obs import device
+    assert recompile_guard.install_compile_counter() is (
+        device.install_compile_counter())
+
+
+# -- watchdog ----------------------------------------------------------------
+
+def test_watchdog_fires_on_stall(tracer):
+    logs, reports = [], []
+    wd = obs.StallWatchdog(0.08, log=logs.append, poll_s=0.02,
+                           on_stall=reports.append).start()
+    try:
+        wd.beat(7)
+        with obs.span("stuck_step"):
+            time.sleep(0.4)
+    finally:
+        wd.stop()
+    assert wd.stall_count == 1              # one report per episode, not per poll
+    rep = wd.last_report
+    assert rep.step == 7 and rep.idle_s >= 0.08
+    assert any("stuck_step" in " > ".join(v)
+               for v in rep.open_spans.values())
+    assert "test_watchdog_fires_on_stall" in rep.stack_dump
+    assert reports == [rep]
+    assert "STALL" in logs[0] and "stuck_step" in logs[0]
+
+
+def test_watchdog_rearms_after_beat():
+    wd = obs.StallWatchdog(0.05, log=lambda *_: None, poll_s=0.01,
+                           dump_stacks=False).start()
+    try:
+        time.sleep(0.15)
+        assert wd.stall_count == 1
+        wd.beat(1)                          # re-arm
+        time.sleep(0.15)
+        assert wd.stall_count == 2
+    finally:
+        wd.stop()
+
+
+def test_watchdog_quiet_with_heartbeat():
+    wd = obs.StallWatchdog(0.2, log=lambda *_: None, poll_s=0.02,
+                           dump_stacks=False).start()
+    try:
+        for i in range(10):
+            wd.beat(i)
+            time.sleep(0.02)
+    finally:
+        wd.stop()
+    assert wd.stall_count == 0
+
+
+def test_watchdog_rejects_zero_deadline():
+    with pytest.raises(ValueError):
+        obs.StallWatchdog(0.0)
+
+
+# -- satellite: MetricsLogger scalar coercion --------------------------------
+
+def test_metrics_logger_coerces_0d_arrays(tmp_path):
+    import jax.numpy as jnp
+    from dalle_tpu.train.metrics import MetricsLogger
+    path = str(tmp_path / "m.jsonl")
+    w = MetricsLogger(path=path)
+    w.log(1, {"loss": np.float32(1.5), "zero_d": jnp.ones(()),
+              "np0d": np.asarray(2.0), "plain": 3, "tag": "s",
+              "flag": True, "vector": np.zeros(4)})
+    w.close()
+    rec = json.loads(open(path).read().strip())
+    assert rec["loss"] == 1.5 and rec["zero_d"] == 1.0 and rec["np0d"] == 2.0
+    assert rec["plain"] == 3 and rec["tag"] == "s" and rec["flag"] is True
+    assert "vector" not in rec              # non-scalars still dropped
+
+
+def test_metrics_logger_merges_obs_snapshot(tmp_path, tracer):
+    from dalle_tpu.train.metrics import MetricsLogger
+    obs.counter_add("obs.decode_tokens_total", 9)
+    path = str(tmp_path / "m.jsonl")
+    w = MetricsLogger(path=path)
+    w.log(1, {"loss": 0.5})
+    w.close()
+    rec = json.loads(open(path).read().strip())
+    assert rec["obs.decode_tokens_total"] == 9
+
+
+# -- satellite: estimated-MFU tagging ----------------------------------------
+
+def test_device_peak_tflops_unknown_is_tagged():
+    from dalle_tpu.train import metrics as tm
+
+    class FakeDevice:
+        device_kind = "QuantumChip 9000"
+
+    tm._warned_unknown_peak = False
+    with pytest.warns(UserWarning, match="mfu_estimated"):
+        peak, estimated = tm.device_peak_tflops_info(FakeDevice())
+    assert peak == 100.0 and estimated
+    # warn-once: the second lookup is silent
+    peak2, est2 = tm.device_peak_tflops_info(FakeDevice())
+    assert (peak2, est2) == (100.0, True)
+
+
+def test_throughput_meter_tags_estimated_mfu(monkeypatch):
+    from dalle_tpu.train import metrics as tm
+    monkeypatch.setattr(tm, "device_peak_tflops_info",
+                        lambda device=None: (100.0, True))
+    meter = tm.ThroughputMeter(8, interval=1, flops_per_step=1e9)
+    time.sleep(0.01)
+    rep = meter.step(2)
+    assert rep["mfu_estimated"] is True and rep["mfu"] > 0
+
+
+def test_throughput_meter_known_chip_untagged(monkeypatch):
+    from dalle_tpu.train import metrics as tm
+    monkeypatch.setattr(tm, "device_peak_tflops_info",
+                        lambda device=None: (123.0, False))
+    meter = tm.ThroughputMeter(8, interval=1, flops_per_step=1e9)
+    time.sleep(0.01)
+    rep = meter.step(2)
+    assert "mfu_estimated" not in rep

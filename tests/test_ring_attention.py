@@ -83,11 +83,13 @@ def test_non_divisible_seq_is_padded(sp_mesh):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_dalle_train_step_with_sequence_parallelism():
     """Full DALL·E training step over a dp×fsdp×sp mesh: the transformer's
     attention runs as ring attention over 'sp' (the long-context path is
     first-class, not a standalone op). Loss must equal the sp=1 step — the
-    ring math is exact."""
+    ring math is exact. (~45s: two full trainer builds + compiles on the
+    8-device CPU mesh → slow tier.)"""
     from dalle_tpu.config import DalleConfig, MeshConfig, OptimConfig, TrainConfig
     from dalle_tpu.parallel import build_mesh
     from dalle_tpu.train.trainer_dalle import DalleTrainer
@@ -116,13 +118,14 @@ def test_dalle_train_step_with_sequence_parallelism():
 
 # -- kernelized ring (Pallas chunk kernels inside the ring schedule) --------
 
-@pytest.mark.parametrize(
-    "zigzag", [False, pytest.param(True, marks=pytest.mark.slow)])
+@pytest.mark.slow
+@pytest.mark.parametrize("zigzag", [False, True])
 def test_kernel_ring_matches_dense(sp_mesh, zigzag):
     """The Pallas chunk-kernel ring body ≡ dense causal attention (and hence
-    ≡ the dense ring body it replaces). The zigzag variant costs ~145s in
-    CPU interpret mode → slow tier (its backward is also covered by
-    test_kernel_ring_gradients_zigzag there)."""
+    ≡ the dense ring body it replaces). CPU interpret mode makes both
+    variants slow-tier (~19s plain, ~145s zigzag); the fast tier keeps the
+    kernel path honest via test_kernel_ring_rejects_untileable_chunks and
+    the dense-body exactness tests."""
     q, k, v = _qkv(128)
     ref = attend(q, k, v, causal=True)
     out = ring_attention(q, k, v, mesh=sp_mesh, causal=True, zigzag=zigzag,
@@ -131,7 +134,9 @@ def test_kernel_ring_matches_dense(sp_mesh, zigzag):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_kernel_ring_noncausal(sp_mesh):
+    # ~21s in CPU interpret mode
     q, k, v = _qkv(128, seed=3)
     ref = attend(q, k, v, causal=False)
     out = ring_attention(q, k, v, mesh=sp_mesh, causal=False, kernel=True)
@@ -164,10 +169,11 @@ def test_kernel_ring_gradients_zigzag(sp_mesh):
     _check_kernel_ring_gradients(sp_mesh, zigzag=True)
 
 
+@pytest.mark.slow
 def test_kernel_ring_gradients_zigzag_sp2():
-    """Default-tier backward coverage for the kernel ring: same check on a
-    2-device mesh (4 ring-step programs instead of 64 — interpret-mode cost
-    scales with program count, ~seconds instead of ~7 minutes)."""
+    """Backward coverage for the kernel ring on a 2-device mesh (4 ring-step
+    programs instead of 64 — interpret-mode cost scales with program count:
+    ~71s here vs ~7 minutes at sp=8, both slow tier)."""
     from jax.sharding import Mesh
     mesh2 = Mesh(np.array(jax.devices()[:2]), ("sp",))
     _check_kernel_ring_gradients(mesh2, zigzag=True)
@@ -228,7 +234,10 @@ def test_kernel_ring_memory_scales(sp_mesh):
 # -- structured masks under the ring (sp beyond full-causal) ----------------
 
 _STRUCTURED_CASES = [
-    ("axial_row", ("axial", 64, 8, 0)),
+    # all ~48s+ each on the CPU mesh (zigzag ring over per-chunk mask
+    # evaluation) → slow tier; the fast tier covers the mask-spec plumbing
+    # through test_ring_rejects_tabled_masks
+    pytest.param("axial_row", ("axial", 64, 8, 0), marks=pytest.mark.slow),
     pytest.param("axial_col", ("axial", 64, 8, 1), marks=pytest.mark.slow),
     pytest.param("conv_like", ("conv", 64, 8, 5, 1),
                  marks=pytest.mark.slow),
@@ -268,9 +277,11 @@ def test_ring_rejects_tabled_masks(sp_mesh):
         ring_attention(q, k, v, mesh=sp_mesh, mask_spec=("block", 16))
 
 
+@pytest.mark.slow
 def test_dalle_train_step_sp_with_axial():
     """attn_types=('full', 'axial_row') trains under sp=2 with loss ≡ sp=1
-    (VERDICT r2 next #6: sp beyond full-causal)."""
+    (VERDICT r2 next #6: sp beyond full-causal). ~38s: two trainer builds →
+    slow tier."""
     from dalle_tpu.config import DalleConfig, MeshConfig, OptimConfig, TrainConfig
     from dalle_tpu.train.trainer_dalle import DalleTrainer
 
@@ -293,13 +304,13 @@ def test_dalle_train_step_sp_with_axial():
     np.testing.assert_allclose(losses["sp2"], losses["sp1"], rtol=1e-3)
 
 
-@pytest.mark.parametrize("n", [pytest.param(64, marks=pytest.mark.slow),
-                               48,
-                               pytest.param(19, marks=pytest.mark.slow)])
+@pytest.mark.slow
+@pytest.mark.parametrize("n", [64, 48, 19])
 def test_zigzag_matches_dense(sp_mesh, n):
     """Zigzag layout (balanced causal ring with quadrant skipping) is exact:
     same outputs as dense causal attention for divisible, half-divisible and
-    padded sequence lengths."""
+    padded sequence lengths. (~39s per case on the CPU mesh → slow tier;
+    the fast tier keeps the plain-ring exactness tests.)"""
     from dalle_tpu.ops.attention import attend
     q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (2, 2, n, 16))
                for i in range(3))
