@@ -442,7 +442,34 @@ class TrainConfig(ConfigBase):
     keep_n_checkpoints: Optional[int] = None
     checkpoint_dir: str = "./checkpoints"
     resume: bool = False
+    # async orbax saves (docs/PERFORMANCE.md): a mid-run save() returns after
+    # the device→host snapshot; serialize+write happen on a background thread.
+    # The manager drains (wait_until_finished) at preflight, restore,
+    # SIGUSR1-latch saves, fit() exit, and close()/atexit, so durability
+    # points stay synchronous while steady-state saves leave the step loop.
+    async_checkpointing: bool = True
     nan_rollback: bool = True            # ref fork: vae.py:100-110
+    # where the NaN-rollback snapshot of (params, opt_state) lives:
+    #   "device" — donated-safe on-device copy (no host fetch: the snapshot
+    #              costs one HBM copy instead of a multi-second device_get at
+    #              flagship scale), "host" — the pre-PR3 host device_get,
+    #   "auto"   — device when the HBM headroom gauge shows the copy fits
+    #              (bytes_limit known and in_use + 1.15×snapshot < limit,
+    #              or no limit reported, e.g. CPU), else host
+    rollback_snapshot: str = "auto"
+    # double-buffered device prefetch depth for fit(): while step N runs, the
+    # next `device_prefetch` batches are already converted + device_put with
+    # their target shardings, so batch-wait + H2D leave the device critical
+    # path. 0 disables (fit pulls and puts inline, the pre-PR3 behavior)
+    device_prefetch: int = 2
+    # fetch step metrics one metrics-boundary late so the device_get lands
+    # after the NEXT dispatch (the sync then reads an already-finished step
+    # instead of blocking on the running one). Costs: the loss column lags
+    # one boundary (records carry their true step via ``metrics_step``) and
+    # NaN rollback triggers one boundary late on non-save steps — save
+    # boundaries still force a synchronous fetch of the current step, so
+    # nothing is ever checkpointed without a NaN check
+    defer_metrics: bool = False
     preflight_checkpoint: bool = True    # ref: legacy/train_dalle.py:591-594
     sample_every_steps: int = 0
     profile_step: int = 0                # >0 → dump a jax.profiler trace + MFU report

@@ -2,6 +2,7 @@ from .synthetic import ShapesDataset, batch_iterator, render, SHAPES, COLORS, SC
 from .text_image import TextImageDataset
 from .webdataset import WebDataset, expand_shards, write_shards, warn_and_continue
 from .loaders import ImageFolderDataset, ImagePaths, Token, load_labels, batch_arrays
+from .device_prefetch import DevicePrefetcher, prefetch_to_device
 from .taming_datasets import (NumpyPaths, CustomTrain, CustomTest, ImageNetTrain,
                               ImageNetValidation, CocoCaptions, ADE20k, SFLCKR,
                               FacesHQ)

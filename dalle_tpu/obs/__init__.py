@@ -19,7 +19,7 @@ from .trace import (Tracer, configure, counter_add, disable, enabled,
 from .watchdog import StallReport, StallWatchdog
 
 _DEVICE_NAMES = ("CompileCounter", "DeviceTelemetry", "device_memory_stats",
-                 "install_compile_counter")
+                 "device_memory_headroom", "install_compile_counter")
 
 __all__ = [
     *_DEVICE_NAMES, "render_textfile", "sanitize_metric_name",

@@ -88,9 +88,25 @@ def device_memory_stats(device: Optional[jax.Device] = None) -> dict:
         peak = stats.get("peak_bytes_in_use")
         if peak is not None:
             out["hbm_peak_bytes"] = int(peak)
+        limit = stats.get("bytes_limit")
+        if limit:
+            out["hbm_bytes_limit"] = int(limit)
         return out
     live = sum(int(x.nbytes) for x in jax.live_arrays())
     return {"hbm_bytes_in_use": live}
+
+
+def device_memory_headroom(device: Optional[jax.Device] = None
+                           ) -> Optional[int]:
+    """Free HBM bytes on one device (``bytes_limit - bytes_in_use``), or
+    ``None`` when the backend reports no allocator limit (CPU — effectively
+    unbounded host RAM). The gate behind ``rollback_snapshot="auto"``: an
+    on-device snapshot is only taken when it fits this headroom."""
+    stats = device_memory_stats(device)
+    limit = stats.get("hbm_bytes_limit")
+    if limit is None:
+        return None
+    return max(int(limit) - int(stats.get("hbm_bytes_in_use", 0)), 0)
 
 
 class DeviceTelemetry:

@@ -20,12 +20,31 @@ from ..obs import configure as obs_configure
 from .checkpoints import CheckpointManager
 
 
+@jax.jit
+def _tree_copy(t):
+    """Bit-exact on-device copy with FRESH buffers: ``jnp.copy`` is never
+    input-forwarded by jit, so the result survives a later donation of the
+    source (the whole point of the device rollback snapshot). Module-level:
+    one jit cache shared by every trainer — equal tree structures compile
+    once per process, not once per trainer instance."""
+    import jax.numpy as jnp
+    return jax.tree.map(jnp.copy, t)
+
+
 class BaseTrainer:
     """Owns (mesh, state, step fn, checkpoints, meter). Subclasses set
     ``self.state``, ``self.step_fn``-driven ``train_step``, and
     ``model_class`` for checkpoint metadata."""
 
     model_class = "Model"
+
+    # class-level defaults so duck-typed subclasses that skip __init__ (the
+    # test suite's host-only FakeTrainer) still satisfy the fit()/breakdown
+    # machinery added after them
+    _last_good_device = None
+    _deferred_metrics = None
+    _obs_last_h2d = 0.0
+    _obs_last_ckpt = 0.0
 
     def __init__(self, train_cfg: TrainConfig, mesh=None, backend=None):
         self.train_cfg = train_cfg
@@ -37,18 +56,23 @@ class BaseTrainer:
         self.mesh = mesh
         self.backend = backend
         self.base_key = jax.random.PRNGKey(train_cfg.seed)
-        self.ckpt = CheckpointManager(train_cfg.checkpoint_dir,
-                                      keep_n=train_cfg.keep_n_checkpoints)
+        self.ckpt = CheckpointManager(
+            train_cfg.checkpoint_dir, keep_n=train_cfg.keep_n_checkpoints,
+            async_save=getattr(train_cfg, "async_checkpointing", False))
         self._last_good = None   # host copy of (params, opt_state) for rollback
+        self._last_good_device = None   # on-device copy (rollback_snapshot)
         self._host_step = 0      # host mirror of state.step: no device sync
         # grafttrace step-breakdown state (set by fit, consumed by
         # _finish_step; None dispatch-t0 = bare train_step outside fit)
         self._obs_dispatch_t0 = None
         self._obs_last_wait = 0.0
+        self._obs_last_h2d = 0.0
+        self._obs_last_ckpt = 0.0
         self._obs_wait_accum = 0.0
         self._obs_window_t0 = None
         self._obs_poll_bucket = -1
         self._telemetry = None
+        self._deferred_metrics = None   # (step, device metrics) under defer
         self.last_watchdog = None
         # per-instance extras merged into checkpoint metadata, e.g. vae
         # identity for DALLE ckpts (reference legacy/train_dalle.py:535-582)
@@ -83,17 +107,55 @@ class BaseTrainer:
 
     def _fetch_pending_metrics(self) -> dict:
         """Host-fetch the most recent step's device metrics (used when a save
-        boundary lands on a metrics-skipped step: nothing may be checkpointed
-        without a NaN check)."""
+        boundary lands on a metrics-skipped step, or to bypass the
+        ``defer_metrics`` lag: nothing may be checkpointed without a NaN
+        check of the CURRENT state)."""
         if getattr(self, "_pending_metrics", None) is None:
             return {}
+        sync0 = time.perf_counter()
         with span("fit/sync", on_demand=True):
             metrics = {k: float(v) for k, v in
                        jax.device_get(self._pending_metrics).items()}
+        # the same step's metrics are now consumed in-band — retire the
+        # deferred copy so the next boundary doesn't re-emit them, but keep
+        # its parked breakdown: dropping it would lose every t_* column
+        # (and the once-consumed t_ckpt_s) whenever the save cadence
+        # coincides with the metrics cadence
+        if (self._deferred_metrics is not None
+                and self._deferred_metrics[0] == self._host_step):
+            part = self._deferred_metrics[2]
+            self._deferred_metrics = None
+            if part is not None:
+                now = time.perf_counter()
+                part["t_sync_s"] = now - sync0
+                metrics.update(self._finish_breakdown(part, now))
         rep = self.meter.step(self._host_step)
         if rep:
             metrics.update(rep)
         return metrics
+
+    def _put(self, x, dtype=None, stacked: bool = False):
+        """Convert one host batch leaf and place it on the mesh. A jax Array
+        of the right dtype skips the ``np.asarray`` (which would drag it back
+        to host) but still routes through the shard fn — ``device_put`` with
+        the already-correct sharding is a no-op (the prefetch path stays
+        zero-copy) while a direct caller's device array with some other
+        placement gets resharded onto the mesh, matching the pre-prefetch
+        semantics. A wrong-dtype device array pays the host round-trip the
+        coercion always cost."""
+        from ..parallel import shard_batch, shard_stacked_batch
+        if not (isinstance(x, jax.Array)
+                and (dtype is None or x.dtype == np.dtype(dtype))):
+            x = np.asarray(x, dtype) if dtype is not None else np.asarray(x)
+        return (shard_stacked_batch if stacked else shard_batch)(self.mesh, x)
+
+    def _put_batch(self, batch: tuple, stacked: bool = False) -> tuple:
+        """Convert + shard one fit() batch tuple exactly as ``train_step``
+        would (dtype coercion included) — the hook the device prefetcher uses
+        to move H2D off the critical path. The base implementation is the
+        identity (host batches through, for trainers without a device path);
+        real trainers override with their per-leaf dtypes."""
+        return batch
 
     def _step_keys(self, k: int):
         """The exact per-step rng stream ``train_step`` would draw for the
@@ -157,6 +219,21 @@ class BaseTrainer:
         event by at most k-1 steps, never to lcm(k, N); a NaN rollback
         rewinds the whole k-step group to the last good snapshot.
 
+        Host-overlap layers (docs/PERFORMANCE.md): with
+        ``train_cfg.device_prefetch > 0`` the next batches are converted and
+        device_put through the trainer's ``_put_batch`` while the current
+        step runs — note the lookahead means a fit() that exits on its
+        ``steps`` budget has consumed up to ``device_prefetch`` extra
+        batches from the iterator (callers sharing one iterator across fit
+        calls should pass ``device_prefetch=0``); with
+        ``train_cfg.async_checkpointing`` a mid-run save
+        costs one device→host snapshot (the write overlaps following steps;
+        SIGUSR1-latch saves and fit exit drain); with
+        ``train_cfg.defer_metrics`` the metrics device_get reads the
+        previous boundary's already-finished step (save boundaries still
+        force a synchronous fetch — nothing is checkpointed without a NaN
+        check of the current state).
+
         grafttrace (``train_cfg.obs``, docs/OBSERVABILITY.md): every
         iteration is a ``fit/step`` span nesting ``fit/batch_wait`` (time
         blocked on the batch iterator), ``fit/dispatch`` (host work + device
@@ -186,6 +263,19 @@ class BaseTrainer:
             batches = self._stack_batches(batches, scan_k)
         else:
             batches = ((False, b) for b in batches)
+        prefetcher = None
+        if getattr(tc, "device_prefetch", 0) > 0:
+            # double-buffered device placement: the next `depth` batches are
+            # converted + device_put (through the trainer's _put_batch, so
+            # dtypes/shardings match train_step exactly) while the current
+            # step runs — batch wait and H2D leave the critical path
+            from ..data.device_prefetch import DevicePrefetcher
+            prefetcher = DevicePrefetcher(
+                batches,
+                lambda item: (item[0], self._put_batch(item[1],
+                                                       stacked=item[0])),
+                depth=tc.device_prefetch)
+            batches = prefetcher
         meta = self._meta()
         if tc.preflight_checkpoint:
             self.ckpt.preflight(self.state, meta)
@@ -208,6 +298,8 @@ class BaseTrainer:
                         break
                     self._obs_last_wait = time.perf_counter() - t_wait0
                     self._obs_wait_accum += self._obs_last_wait
+                    self._obs_last_h2d = (prefetcher.last_put_s
+                                          if prefetcher is not None else 0.0)
                     stacked, batch = item
                     step_call = self.train_steps if stacked else self.train_step
                     k_this = batch[0].shape[0] if stacked else 1
@@ -234,27 +326,69 @@ class BaseTrainer:
                     # decision does
                     want_save = (crossed(prev_step, step_num, tc.save_every_steps) or
                                  getattr(self, "_signal_save", False))
-                    if not m and want_save:
+                    # the step these metrics belong to: with defer_metrics the
+                    # in-band dict is one boundary stale and tags itself
+                    mstep = m.pop("metrics_step", step_num) if m else step_num
+                    if want_save and (not m or mstep != step_num):
+                        # the save's NaN gate must see the CURRENT step — any
+                        # stale (deferred) record is flushed first, BEFORE the
+                        # current step's, so writer steps stay monotonic
+                        # (wandb silently drops out-of-order steps); then the
+                        # live metrics are pulled
+                        if m and metrics_writer is not None:
+                            metrics_writer.log(mstep, m)
+                        elif (not m and self._deferred_metrics is not None
+                              and self._deferred_metrics[0] != step_num):
+                            # save landed on a metrics-skipped step: an OLDER
+                            # boundary's record is still parked — emit it now
+                            # (a parked record of the current step is instead
+                            # retired by _fetch_pending_metrics, which keeps
+                            # its breakdown)
+                            dstep, dm, dpart = self._deferred_metrics
+                            self._deferred_metrics = None
+                            dsync0 = time.perf_counter()
+                            with span("fit/sync", on_demand=True):
+                                dm = {k: float(v) for k, v in
+                                      jax.device_get(dm).items()}
+                            if dpart is not None:
+                                dnow = time.perf_counter()
+                                dpart["t_sync_s"] = dnow - dsync0
+                                dm.update(self._finish_breakdown(dpart, dnow))
+                            if metrics_writer is not None:
+                                metrics_writer.log(dstep, dm)
                         m = self._fetch_pending_metrics()
+                        mstep = step_num
                     nan = bool(m) and tc.nan_rollback and not math.isfinite(
                         self._nan_check_value(m, log))
                     if nan:
-                        log(f"[step {step_num}] NaN loss — rolling back to last good state")
+                        log(f"[step {mstep}] NaN loss — rolling back to last good state")
                         self._rollback()
                     else:
                         if m and crossed(prev_step, step_num, tc.log_every):
-                            log(f"[step {step_num}] " +
+                            log(f"[step {mstep}] " +
                                 " ".join(f"{k}={v:.5g}" for k, v in m.items()))
                         if m and metrics_writer is not None:
-                            metrics_writer.log(step_num, m)
+                            metrics_writer.log(mstep, m)
                         if want_save:
+                            signal_save = getattr(self, "_signal_save", False)
+                            t_ckpt0 = time.perf_counter()
                             with span("fit/checkpoint", step=step_num):
+                                # async manager: returns after the snapshot;
+                                # the write overlaps the next steps. An
+                                # operator-requested (SIGUSR1) save drains so
+                                # the latch means "durable now".
                                 self.ckpt.save(step_num, self.state, meta)
+                                if signal_save:
+                                    self._ckpt_wait()
                                 self._snapshot_good()
+                            self._obs_last_ckpt = time.perf_counter() - t_ckpt0
                             self._signal_save = False
                             if (getattr(tc, "log_artifacts", False)
                                     and metrics_writer is not None
                                     and hasattr(metrics_writer, "log_artifact")):
+                                # the upload reads the step directory, so an
+                                # in-flight async write must land first
+                                self._ckpt_wait()
                                 # only the just-written step's directory —
                                 # uploading the whole checkpoint_dir would
                                 # re-send every retained checkpoint each save
@@ -272,6 +406,30 @@ class BaseTrainer:
                     break
         finally:
             self._obs_dispatch_t0 = None   # bare train_step: no breakdown
+            if self._deferred_metrics is not None:
+                # defer_metrics parks the final boundary's metrics — flush so
+                # the run's last record isn't silently dropped
+                fstep, fmetrics, fpart = self._deferred_metrics
+                self._deferred_metrics = None
+                try:
+                    fsync0 = time.perf_counter()
+                    with span("fit/sync", flush=True):
+                        fm = {k: float(v) for k, v in
+                              jax.device_get(fmetrics).items()}
+                    if fpart is not None:
+                        fnow = time.perf_counter()
+                        fpart["t_sync_s"] = fnow - fsync0
+                        fm.update(self._finish_breakdown(fpart, fnow))
+                    log(f"[step {fstep}] " +
+                        " ".join(f"{k}={v:.5g}" for k, v in fm.items()))
+                    if metrics_writer is not None:
+                        metrics_writer.log(fstep, fm)
+                except Exception:  # noqa: BLE001 - the flush is best-effort:
+                    pass           # fit may be unwinding from a device error
+            # drain in-flight async checkpoint writes: a fit() that returned
+            # must leave durable checkpoints behind (duck-typed managers in
+            # tests may not expose the drain)
+            self._ckpt_wait()
             if watchdog is not None:
                 watchdog.stop()
             if tracing:
@@ -280,6 +438,12 @@ class BaseTrainer:
                 export_chrome_trace(os.path.join(outdir, "trace.json"))
                 export_spans_jsonl(os.path.join(outdir, "spans.jsonl"))
         return self.state
+
+    def _ckpt_wait(self):
+        wait = getattr(self.ckpt, "wait_until_finished", None)
+        if wait is not None:
+            with span("ckpt/drain"):
+                wait()
 
     def _nan_check_value(self, m: dict, log=print) -> float:
         """The scalar the NaN-rollback check inspects: ``loss`` when present
@@ -299,18 +463,77 @@ class BaseTrainer:
                 return 0.0   # finite → never triggers a rollback
         return val
 
+    def _snapshot_mode(self, live) -> str:
+        """Resolve ``rollback_snapshot`` ("auto" → "device"/"host"): the
+        on-device copy doubles the (params, opt_state) HBM footprint, so auto
+        only takes it when the allocator reports enough headroom (backends
+        without a limit — CPU — always fit: "device" there is host RAM)."""
+        mode = getattr(self.train_cfg, "rollback_snapshot", "host")
+        if mode != "auto":
+            return mode
+        from ..obs import device_memory_headroom
+        d0 = self.mesh.devices.flat[0]
+        try:
+            headroom = device_memory_headroom(d0)
+        except Exception:  # noqa: BLE001 - stats API varies per backend;
+            return "host"  # an unreadable gauge must not break training
+        if headroom is None:
+            return "device"
+
+        # per-device snapshot bytes = what ONE device actually holds — the
+        # sum of its shards. global/mesh_size would undercount replicated
+        # leaves (a dp-only mesh replicates the whole tree on every device)
+        def _on_d0(x):
+            try:
+                return sum(s.data.nbytes for s in x.addressable_shards
+                           if s.device == d0)
+            except Exception:  # noqa: BLE001 - conservative on exotic arrays
+                return x.nbytes
+        per_device = sum(_on_d0(x) for x in jax.tree.leaves(live))
+        # 1.15× covers copy transients + rounding
+        return "device" if per_device * 1.15 < headroom else "host"
+
     def _snapshot_good(self):
         # NaN loss is observed AFTER apply_gradients has run, so the optimizer
         # moments are poisoned too — snapshot and restore both (the reference
         # fork reloads the whole checkpoint, vae.py:100-110)
         live = (self.state.params, self.state.opt_state)
-        self._last_good = jax.device_get(live)
         self._last_good_shardings = jax.tree.map(lambda x: x.sharding, live)
+        # free the PREVIOUS snapshot before the headroom gate and the copy:
+        # gating with it still resident makes auto oscillate device/host on
+        # alternating saves (the old snapshot eats exactly the headroom the
+        # new one needs), and holding both through the copy would spike to
+        # 3× the state footprint
+        self._last_good_device = None
+        mode = self._snapshot_mode(live)
+        with span("ckpt/snapshot_good", mode=mode):
+            if mode == "device":
+                # donated-safe on-device copy — no host fetch, which at
+                # flagship scale is a multi-second device-idle window
+                self._last_good_device = _tree_copy(live)
+                self._last_good = None
+            else:
+                self._last_good = jax.device_get(live)
+                self._last_good_device = None
 
     def _rollback(self):
-        if self._last_good is not None:
-            restored = jax.tree.map(jax.device_put, self._last_good,
-                                    self._last_good_shardings)
+        # metrics computed from the poisoned state must die with it: a
+        # parked (defer_metrics) NaN record would otherwise trigger a
+        # second, spurious rollback at the next boundary, discarding the
+        # good step just trained from the restored state
+        self._deferred_metrics = None
+        self._pending_metrics = None
+        with span("ckpt/rollback"):
+            if self._last_good_device is not None:
+                # install a COPY: the restored tree becomes the live state and
+                # gets donated into the next step — the snapshot itself must
+                # stay valid in case that step goes NaN again
+                restored = _tree_copy(self._last_good_device)
+            elif self._last_good is not None:
+                restored = jax.tree.map(jax.device_put, self._last_good,
+                                        self._last_good_shardings)
+            else:
+                return
             params, opt_state = restored
             self.state = self.state.replace(params=params, opt_state=opt_state)
 
@@ -332,13 +555,38 @@ class BaseTrainer:
         every = max(getattr(self.train_cfg, "metrics_every", 1), 1)
         if self._host_step % every != 0:
             return {}
+        step_of = self._host_step
+        defer = bool(getattr(self.train_cfg, "defer_metrics", False))
+        part = None
+        if defer:
+            # one-boundary-delayed pull: hand back the PREVIOUS boundary's
+            # metrics (that step has long finished — the device_get returns
+            # without stalling the pipeline) and park this boundary's for the
+            # next call. Records carry their true step via ``metrics_step``,
+            # and the wait/dispatch/h2d timings are parked WITH the step they
+            # describe so the record's columns all belong to metrics_step.
+            part = self._partial_breakdown(time.perf_counter())
+            parked, self._deferred_metrics = (self._deferred_metrics,
+                                              (step_of, metrics, part))
+            if parked is None:
+                return {}
+            step_of, metrics, part = parked
         sync0 = time.perf_counter()
         with span("fit/sync"):
             metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
         rep = self.meter.step(self._host_step)
         if rep:
             metrics.update(rep)
-        metrics.update(self._step_breakdown(sync0, time.perf_counter()))
+        now = time.perf_counter()
+        if defer:
+            if part is not None:
+                # the sync just paid IS this record's fetch — attribute it here
+                part["t_sync_s"] = now - sync0
+                metrics.update(self._finish_breakdown(part, now))
+        else:
+            metrics.update(self._step_breakdown(sync0, now))
+        if step_of != self._host_step:
+            metrics["metrics_step"] = step_of
         return metrics
 
     def _step_breakdown(self, sync0: float, now: float) -> dict:
@@ -351,12 +599,35 @@ class BaseTrainer:
         the Prometheus textfile when ``obs.prometheus_path`` is set. Only
         meaningful under fit(): a bare ``train_step()`` call has no
         batch-wait context and gets no breakdown."""
+        out = self._partial_breakdown(sync0)
+        if out is None:
+            return {}
+        out["t_sync_s"] = now - sync0
+        return self._finish_breakdown(out, now)
+
+    def _partial_breakdown(self, dispatch_end: float) -> Optional[dict]:
+        """The per-step splits knowable at dispatch end (everything except
+        the sync): wait/dispatch/h2d plus the previous boundary's checkpoint
+        cost. None outside fit() (no batch-wait context)."""
         t0 = getattr(self, "_obs_dispatch_t0", None)
         if t0 is None:
-            return {}
+            return None
         out = {"t_batch_wait_s": self._obs_last_wait,
-               "t_dispatch_s": sync0 - t0,
-               "t_sync_s": now - sync0}
+               "t_dispatch_s": dispatch_end - t0,
+               # host-side H2D enqueue cost of the consumed batch (0 without
+               # device prefetch — the put then rides inside batch_wait)
+               "t_h2d_s": self._obs_last_h2d}
+        if self._obs_last_ckpt:
+            # checkpoint dispatch cost of the PREVIOUS boundary (saves run
+            # after metrics are fetched, so the cost lands one record late) —
+            # obs_report accounts these steps as their own category
+            out["t_ckpt_s"] = self._obs_last_ckpt
+            self._obs_last_ckpt = 0.0
+        return out
+
+    def _finish_breakdown(self, out: dict, now: float) -> dict:
+        """Windowed starvation ratio + device-gauge poll + Prometheus mirror,
+        merged into ``out`` (the per-step splits)."""
         window_t0 = getattr(self, "_obs_window_t0", None)
         if window_t0 is not None and now > window_t0:
             out["data_starvation"] = min(self._obs_wait_accum / (now - window_t0), 1.0)

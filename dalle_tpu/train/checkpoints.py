@@ -8,41 +8,108 @@ misconfiguration (:591-594).
 
 Implementation is Orbax (sharded, multi-host-safe — the TPU equivalent of the
 DeepSpeed partitioned checkpoint dir) with the metadata dict stored alongside.
+
+With ``async_save=True`` (the trainer default, ``TrainConfig.
+async_checkpointing``) a mid-run ``save()`` blocks only for the device→host
+snapshot; serialization and the filesystem write happen on orbax's background
+thread, so the accelerator resumes stepping while the bytes land. The manager
+drains (``wait_until_finished``) exactly at the durability points: before any
+``restore``, at ``preflight``, when the caller asks (``save(wait=True)`` — the
+SIGUSR1 latch path), and at ``close()``/atexit — an interrupted write never
+finalizes its step directory, and orbax lists only finalized steps, so a save
+racing process exit leaves either a complete checkpoint or an ignored
+``*.orbax-checkpoint-tmp-*`` directory, never a truncated one.
 """
 
 from __future__ import annotations
 
-import json
+import atexit
 import os
+import weakref
 from typing import Any, Optional
 
-import jax
 import orbax.checkpoint as ocp
+
+from ..obs import gauge_set, span
+
+# every live manager, drained at interpreter exit so an in-flight background
+# write can finish before the process dies (a WeakSet: test suites create
+# hundreds of short-lived managers and atexit must not pin them)
+_LIVE_MANAGERS: "weakref.WeakSet[CheckpointManager]" = weakref.WeakSet()
+
+# process-wide count of managers with a write in flight — the
+# ``ckpt.write_inflight`` gauge. A count, not a 0/1 flag: one manager
+# draining must not zero the gauge while another manager's write runs.
+_inflight_count = 0
+
+
+def _inflight_delta(d: int) -> None:
+    global _inflight_count
+    _inflight_count = max(_inflight_count + d, 0)
+    gauge_set("ckpt.write_inflight", _inflight_count)
+
+
+@atexit.register
+def _drain_live_managers():
+    for mgr in list(_LIVE_MANAGERS):
+        try:
+            mgr.close()
+        except Exception:  # noqa: BLE001 - atexit must try every manager;
+            pass           # a torn-down orbax thread pool raises arbitrarily
 
 
 class CheckpointManager:
-    def __init__(self, directory: str, keep_n: Optional[int] = None):
+    def __init__(self, directory: str, keep_n: Optional[int] = None,
+                 async_save: bool = False):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
+        self.async_save = bool(async_save)
         opts = ocp.CheckpointManagerOptions(
-            max_to_keep=keep_n, create=True, enable_async_checkpointing=False)
+            max_to_keep=keep_n, create=True,
+            enable_async_checkpointing=self.async_save)
         self._mgr = ocp.CheckpointManager(self.directory, options=opts)
+        self._closed = False
+        self.in_flight_step: Optional[int] = None
+        _LIVE_MANAGERS.add(self)
 
-    def save(self, step: int, state: Any, metadata: Optional[dict] = None):
+    def save(self, step: int, state: Any, metadata: Optional[dict] = None,
+             *, wait: Optional[bool] = None):
         """``state`` is any pytree (TrainState works). ``metadata`` is the
-        config/hparams dict that travels with the weights."""
+        config/hparams dict that travels with the weights. Async managers
+        return once the device buffers are snapshotted to host (donation-safe:
+        orbax owns a copy); pass ``wait=True`` to force durability before
+        returning (signal-latch saves, final saves)."""
         args = {"state": ocp.args.PyTreeSave(state)}
         if metadata is not None:
             args["metadata"] = ocp.args.JsonSave(metadata)
-        self._mgr.save(step, args=ocp.args.Composite(**args))
+        # orbax itself drains any still-running previous save at the top of
+        # save() — back-to-back boundaries (rotation pressure) self-serialize
+        with span("ckpt/snapshot", step=step, asynchronous=self.async_save):
+            self._mgr.save(step, args=ocp.args.Composite(**args))
+        if self.async_save:
+            if self.in_flight_step is None:
+                _inflight_delta(+1)   # orbax drained any previous write above
+            self.in_flight_step = step
+        if wait if wait is not None else not self.async_save:
+            self.wait_until_finished()
+
+    def wait_until_finished(self):
+        """Drain any in-flight background write (no-op when idle/sync)."""
         self._mgr.wait_until_finished()
+        if self.in_flight_step is not None:
+            self.in_flight_step = None
+            _inflight_delta(-1)
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
     def restore(self, state_template: Any, step: Optional[int] = None):
         """Restore into the structure/shardings of ``state_template``.
-        Returns (state, metadata|None)."""
+        Returns (state, metadata|None). Drains in-flight saves first so a
+        just-requested step is durable before it is read back; steps whose
+        write never finalized (``*-tmp-*`` dirs) are invisible to orbax and
+        are never restored."""
+        self.wait_until_finished()
         step = step if step is not None else self._mgr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint found in {self.directory}")
@@ -53,6 +120,7 @@ class CheckpointManager:
         return restored["state"], meta
 
     def load_metadata(self, step: Optional[int] = None) -> Optional[dict]:
+        self.wait_until_finished()
         step = step if step is not None else self._mgr.latest_step()
         if step is None:
             return None
@@ -70,8 +138,17 @@ class CheckpointManager:
 
     def preflight(self, state: Any, metadata: Optional[dict] = None):
         """Save-before-training so a broken checkpoint config fails immediately
-        (reference legacy/train_dalle.py:591-594)."""
-        self.save(0, state, metadata)
+        (reference legacy/train_dalle.py:591-594) — synchronous even on async
+        managers: a preflight that fails in a background thread three steps
+        later defeats its purpose."""
+        self.save(0, state, metadata, wait=True)
 
     def close(self):
+        """Drain in-flight writes, then release orbax resources. Idempotent
+        (also runs from the module atexit hook)."""
+        if self._closed:
+            return
+        self._closed = True
+        _LIVE_MANAGERS.discard(self)
+        self.wait_until_finished()
         self._mgr.close()

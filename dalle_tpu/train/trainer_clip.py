@@ -18,7 +18,7 @@ import optax
 from ..config import ClipConfig, TrainConfig
 from ..models.clip import CLIP, init_clip
 from ..obs import span
-from ..parallel import shard_batch, shard_params
+from ..parallel import shard_params
 from .base_trainer import BaseTrainer
 from .metrics import ThroughputMeter, count_params, transformer_train_flops
 from .train_state import (TrainState, cast_floating, compute_dtype,
@@ -78,10 +78,15 @@ class CLIPTrainer(BaseTrainer):
                 n, train_cfg.batch_size * tokens_per_sample),
             num_chips=self.mesh.size)
 
+    def _put_batch(self, batch, stacked: bool = False):
+        """(text, images) → int32 text + float32 images on the mesh."""
+        text, images = batch
+        return (self._put(text, np.int32, stacked),
+                self._put(images, np.float32, stacked))
+
     def train_step(self, text: np.ndarray, images: np.ndarray):
         with span("clip/shard_batch"):
-            text = shard_batch(self.mesh, np.asarray(text, np.int32))
-            images = shard_batch(self.mesh, np.asarray(images, np.float32))
+            text, images = self._put_batch((text, images))
         with span("clip/step"):
             self.state, metrics = self.step_fn(self.state, text, images)
         return self._finish_step(metrics)
@@ -94,12 +99,9 @@ class CLIPTrainer(BaseTrainer):
         if self._multi_step_fn is None:
             self._multi_step_fn = make_clip_train_multi_step(
                 self.model, dtype=compute_dtype(self.train_cfg.precision))
-        from ..parallel import shard_stacked_batch
         k = texts.shape[0]
         with span("clip/shard_batch", k=k):
-            texts = shard_stacked_batch(self.mesh, np.asarray(texts, np.int32))
-            imagess = shard_stacked_batch(self.mesh,
-                                          np.asarray(imagess, np.float32))
+            texts, imagess = self._put_batch((texts, imagess), stacked=True)
         with span("clip/steps", k=k):
             self.state, metrics = self._multi_step_fn(self.state,
                                                       (texts, imagess))

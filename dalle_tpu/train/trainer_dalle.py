@@ -27,7 +27,7 @@ import optax
 from ..config import DalleConfig, TrainConfig
 from ..models.dalle import DALLE, init_dalle
 from ..obs import span
-from ..parallel import shard_batch, shard_params, shard_stacked_batch
+from ..parallel import shard_params
 from .base_trainer import BaseTrainer
 from .metrics import ThroughputMeter, count_params, transformer_train_flops
 from .train_state import (TrainState, cast_floating, compute_dtype,
@@ -153,12 +153,18 @@ class DalleTrainer(BaseTrainer):
                 n, train_cfg.batch_size * tokens_per_sample),
             num_chips=self.mesh.size)
 
+    def _put_batch(self, batch, stacked: bool = False):
+        """(text, image_ids) → int32 on the mesh (the device-prefetch hook;
+        already-placed jax Arrays pass through untouched)."""
+        text, image_ids = batch
+        return (self._put(text, np.int32, stacked),
+                self._put(image_ids, np.int32, stacked))
+
     # -- single step ---------------------------------------------------------
     def train_step(self, text: np.ndarray, image_ids: np.ndarray):
         key = jax.random.fold_in(self.base_key, self._host_step)
         with span("dalle/shard_batch"):
-            text = shard_batch(self.mesh, np.asarray(text, np.int32))
-            image_ids = shard_batch(self.mesh, np.asarray(image_ids, np.int32))
+            text, image_ids = self._put_batch((text, image_ids))
         with span("dalle/step"):
             self.state, metrics = self.step_fn(self.state, text, image_ids, key)
         return self._finish_step(metrics)
@@ -177,9 +183,8 @@ class DalleTrainer(BaseTrainer):
         k = texts.shape[0]
         keys = self._step_keys(k)
         with span("dalle/shard_batch", k=k):
-            texts = shard_stacked_batch(self.mesh, np.asarray(texts, np.int32))
-            image_ids = shard_stacked_batch(self.mesh,
-                                            np.asarray(image_ids, np.int32))
+            texts, image_ids = self._put_batch((texts, image_ids),
+                                               stacked=True)
         with span("dalle/steps", k=k):
             self.state, metrics = self._multi_step_fn(self.state, texts,
                                                       image_ids, keys)

@@ -28,7 +28,7 @@ import optax
 from ..config import AnnealConfig, DVAEConfig, TrainConfig
 from ..models.dvae import DiscreteVAE, init_dvae
 from ..obs import span
-from ..parallel import shard_batch, shard_params
+from ..parallel import shard_params
 from .base_trainer import BaseTrainer
 from .metrics import ThroughputMeter, count_params
 from .train_state import (TrainState, cast_floating, compute_dtype,
@@ -107,13 +107,19 @@ class VAETrainer(BaseTrainer):
                                      model_cfg.image_seq_len,
                                      num_chips=self.mesh.size)
 
+    def _put_batch(self, batch, stacked: bool = False):
+        """(images[, labels]) → float32 images on the mesh; trailing labels
+        (ignored by the step) pass through as-is."""
+        images, *rest = batch
+        return (self._put(images, np.float32, stacked), *rest)
+
     # -- single step -------------------------------------------------------
     def train_step(self, images: np.ndarray, _labels=None):
         step_num = self._host_step
         temp = anneal_temperature(self.anneal_cfg, step_num)
         key = jax.random.fold_in(self.base_key, step_num)
         with span("vae/shard_batch"):
-            images = shard_batch(self.mesh, images.astype(np.float32))
+            images = self._put(images, np.float32)
         with span("vae/step"):
             self.state, metrics = self.step_fn(self.state, images, key,
                                                jnp.float32(temp))
@@ -139,10 +145,8 @@ class VAETrainer(BaseTrainer):
         keys = self._step_keys(k)
         temps = jnp.asarray([anneal_temperature(self.anneal_cfg, int(s))
                              for s in steps], jnp.float32)
-        from ..parallel import shard_stacked_batch
         with span("vae/shard_batch", k=k):
-            images = shard_stacked_batch(self.mesh,
-                                         np.asarray(images, np.float32))
+            images = self._put(images, np.float32, stacked=True)
         with span("vae/steps", k=k):
             self.state, metrics = self._multi_step_fn(
                 self.state, (images, keys, temps))

@@ -42,7 +42,7 @@ from ..models.gan import (GANLossConfig, NLayerDiscriminator, adaptive_disc_weig
 from ..models.lpips import LPIPS, init_lpips
 from ..models.vqgan import VQModel, init_vqgan
 from ..obs import span
-from ..parallel import shard_batch, shard_params
+from ..parallel import shard_params
 from .base_trainer import BaseTrainer
 from .metrics import ThroughputMeter, count_params
 from .train_state import (TrainState, cast_floating, compute_dtype,
@@ -321,6 +321,14 @@ class VQGANTrainer(BaseTrainer):
             flops_per_step=6.0 * n * self.train_cfg.batch_size,
             num_chips=self.mesh.size)
 
+    def _put_batch(self, batch, stacked: bool = False):
+        """(images[, targets]) → float32 on the mesh (targets only exist for
+        the segmentation/nodisc modes)."""
+        images, *rest = batch
+        return (self._put(images, np.float32, stacked),
+                *(self._put(t, np.float32, stacked) if t is not None else t
+                  for t in rest))
+
     def train_step(self, images: np.ndarray, targets=None):
         """``targets``: segmentation one-hots for loss_mode="segmentation";
         defaults to the images themselves for "nodisc"."""
@@ -329,10 +337,9 @@ class VQGANTrainer(BaseTrainer):
                 else 1.0)
         key = jax.random.fold_in(self.base_key, step_num)
         with span("vqgan/shard_batch"):
-            images = shard_batch(self.mesh, images.astype(np.float32))
+            images = self._put(images, np.float32)
         if self.loss_mode != "gan":
-            t = images if targets is None else shard_batch(
-                self.mesh, np.asarray(targets, np.float32))
+            t = images if targets is None else self._put(targets, np.float32)
             with span("vqgan/step"):
                 self.state, metrics = self.step_fn(self.state, images, t, key,
                                                    jnp.float32(temp))
@@ -361,7 +368,6 @@ class VQGANTrainer(BaseTrainer):
                 self._multi_step_fn = make_vq_simple_train_step(
                     self.model, self.loss_cfg, self.loss_mode, dtype=dt,
                     scanned=True)
-        from ..parallel import shard_stacked_batch
         k = images.shape[0]
         steps = self._host_step + np.arange(k)
         temps = jnp.asarray(
@@ -369,10 +375,10 @@ class VQGANTrainer(BaseTrainer):
              else 1.0 for s in steps], jnp.float32)
         keys = self._step_keys(k)
         with span("vqgan/shard_batch", k=k):
-            images = shard_stacked_batch(self.mesh, images.astype(np.float32))
+            images = self._put(images, np.float32, stacked=True)
         if self.loss_mode != "gan":
-            t = images if targets is None else shard_stacked_batch(
-                self.mesh, np.asarray(targets, np.float32))
+            t = (images if targets is None
+                 else self._put(targets, np.float32, stacked=True))
             xs = (images, t, keys, temps)
         else:
             xs = (images, keys, temps)

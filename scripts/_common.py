@@ -141,3 +141,37 @@ def save_image_grid(images, path):
     arr = (np.asarray(images) * 255).clip(0, 255).astype("uint8")
     for i, im in enumerate(arr):
         Image.fromarray(im).save(path.format(i))
+
+
+def add_overlap_args(parser):
+    """Host-overlap flags shared by every train CLI (docs/PERFORMANCE.md):
+    async checkpointing, device prefetch depth, deferred metrics, and the
+    rollback-snapshot placement."""
+    grp = parser.add_argument_group("host overlap (docs/PERFORMANCE.md)")
+    grp.add_argument("--sync_checkpointing", action="store_true",
+                     help="disable async orbax saves (save() blocks until "
+                          "the checkpoint is durable, the pre-PR3 behavior)")
+    grp.add_argument("--device_prefetch", type=int, default=2,
+                     help="batches kept device-resident ahead of the step "
+                          "loop (0 disables; H2D then rides the critical "
+                          "path)")
+    grp.add_argument("--defer_metrics", action="store_true",
+                     help="fetch step metrics one boundary late so the "
+                          "device_get reads an already-finished step "
+                          "(loss column lags one boundary; NaN rollback on "
+                          "non-save steps triggers one boundary late)")
+    grp.add_argument("--rollback_snapshot", type=str, default="auto",
+                     choices=["auto", "device", "host"],
+                     help="where the NaN-rollback snapshot lives (auto = "
+                          "device when HBM headroom allows)")
+    return parser
+
+
+def overlap_train_kwargs(args) -> dict:
+    """TrainConfig kwargs from add_overlap_args flags."""
+    return {
+        "async_checkpointing": not args.sync_checkpointing,
+        "device_prefetch": args.device_prefetch,
+        "defer_metrics": args.defer_metrics,
+        "rollback_snapshot": args.rollback_snapshot,
+    }

@@ -42,11 +42,14 @@ def run_lint_stage(changed_only: bool) -> int:
 
 
 def run_obs_smoke_stage() -> int:
-    """The grafttrace smoke stage: a 5-step synthetic traced fit that must
-    produce a well-formed Perfetto trace, the step-time breakdown in the
-    metrics JSONL, a quiet watchdog, and <1% span overhead
+    """The grafttrace + host-overlap smoke stage: a short synthetic traced
+    fit (device prefetch + async checkpointing + deferred metrics ON) that
+    must produce a well-formed Perfetto trace, the step-time breakdown in
+    the metrics JSONL, steady-state batch_wait+sync ≈ 0, a bounded
+    checkpoint-boundary step, a quiet watchdog, and <1% span overhead
     (scripts/obs_smoke.py; the workflow's matching step is skipped below).
-    Artifacts land in ./obs_artifacts — the dir ci.yml uploads."""
+    Artifacts (incl. breakdown.json) land in ./obs_artifacts — the dir
+    ci.yml uploads."""
     cmd = [sys.executable, os.path.join(ROOT, "scripts", "obs_smoke.py"),
            "--outdir", os.path.join(ROOT, "obs_artifacts")]
     print(f"== [obs] {' '.join(cmd[1:])}")

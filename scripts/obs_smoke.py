@@ -1,19 +1,28 @@
 #!/usr/bin/env python
-"""Observability smoke: a 5-step synthetic traced DALLE fit, then assert the
-telemetry contract end to end (the CI stage behind docs/OBSERVABILITY.md):
+"""Observability + host-overlap smoke: a short synthetic traced DALLE fit
+with every PR3 overlap layer ON (device prefetch, async checkpointing,
+deferred metrics), then assert the telemetry AND overlap contracts end to
+end (the CI stage behind docs/OBSERVABILITY.md and docs/PERFORMANCE.md):
 
   1. the Chrome trace JSON is well-formed, contains fit/batch_wait,
-     fit/dispatch and fit/sync spans, and the sync span NESTS inside its
-     step's dispatch window (trainer._finish_step runs inside fit/dispatch);
+     fit/dispatch and fit/sync spans, and the in-band sync span NESTS inside
+     its step's dispatch window (trainer._finish_step runs inside
+     fit/dispatch; on-demand/flush syncs are exempt);
   2. the metrics JSONL carries the per-step breakdown — t_batch_wait_s /
-     t_dispatch_s / t_sync_s, a data-starvation ratio, and the HBM gauge;
-  3. the watchdog (armed with a generous deadline) stayed quiet;
-  4. measured span overhead extrapolated to a full step's span count is
+     t_dispatch_s / t_sync_s / t_h2d_s, a data-starvation ratio, the HBM
+     gauge, and t_ckpt_s on the records after each save boundary;
+  3. OVERLAP: steady-state t_batch_wait_s + t_sync_s is ~0 (prefetch keeps
+     batches device-resident; deferred metrics read finished steps), and a
+     step crossing a checkpoint boundary stays within a bounded multiple of
+     the median step time (async save = snapshot only, not
+     snapshot+serialize+write);
+  4. the watchdog (armed with a generous deadline) stayed quiet;
+  5. measured span overhead extrapolated to a full step's span count is
      < 1% of the median step time.
 
-Artifacts (trace.json, spans.jsonl, metrics.jsonl, the obs_report summary)
-land in --outdir; ci.yml uploads them so every CI run leaves an openable
-Perfetto trace behind.
+Artifacts (trace.json, spans.jsonl, metrics.jsonl, breakdown.json, the
+obs_report summary) land in --outdir; ci.yml uploads them so every CI run
+leaves an openable Perfetto trace + the step-breakdown behind.
 
 Run: JAX_PLATFORMS=cpu python scripts/obs_smoke.py --outdir obs_artifacts
 """
@@ -37,7 +46,8 @@ def check(ok: bool, what: str):
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--outdir", default="./obs_smoke_out")
-    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--save_every", type=int, default=5)
     args = ap.parse_args(argv)
     os.makedirs(args.outdir, exist_ok=True)
 
@@ -56,8 +66,11 @@ def main(argv=None):
                        image_vocab_size=32, image_fmap_size=4)
     mesh_cfg = MeshConfig()
     tc = TrainConfig(
-        batch_size=4, log_every=1, metrics_every=1, save_every_steps=0,
+        batch_size=4, log_every=1, metrics_every=1,
+        save_every_steps=args.save_every, keep_n_checkpoints=2,
         preflight_checkpoint=False,
+        async_checkpointing=True, device_prefetch=2, defer_metrics=True,
+        rollback_snapshot="auto",
         checkpoint_dir=os.path.join(args.outdir, "ckpt"),
         mesh=mesh_cfg,
         obs=ObsConfig(trace=True, trace_dir=args.outdir,
@@ -86,47 +99,109 @@ def main(argv=None):
     names = {e["name"] for e in events}
     check(len(events) > 0, f"trace.json parses; {len(events)} events")
     for want in ("fit/step", "fit/batch_wait", "fit/dispatch", "fit/sync",
-                 "dalle/step", "dalle/shard_batch"):
+                 "dalle/step", "dalle/shard_batch", "fit/checkpoint",
+                 "ckpt/snapshot", "ckpt/snapshot_good", "data/h2d"):
         check(want in names, f"span present: {want}")
-    # nesting: every fit/sync must lie inside some fit/dispatch interval
+    # nesting: every IN-BAND fit/sync must lie inside some fit/dispatch
+    # interval (on-demand save-boundary fetches and the defer-flush run in
+    # the fit loop itself, outside dispatch — by design)
     dispatch = [(e["ts"], e["ts"] + e["dur"]) for e in events
                 if e["name"] == "fit/dispatch"]
     syncs = [(e["ts"], e["ts"] + e["dur"]) for e in events
-             if e["name"] == "fit/sync"]
+             if e["name"] == "fit/sync"
+             and not (e.get("args") or {}).get("on_demand")
+             and not (e.get("args") or {}).get("flush")]
     nested = all(any(lo <= s0 and s1 <= hi + 1 for lo, hi in dispatch)
                  for s0, s1 in syncs)
-    check(bool(syncs) and nested, "fit/sync spans nest inside fit/dispatch")
+    check(bool(syncs) and nested, "in-band fit/sync spans nest inside fit/dispatch")
 
     # -- 2. breakdown metrics in the JSONL ---------------------------------
     with open(metrics_path) as fh:
         recs = [json.loads(ln) for ln in fh if ln.strip()]
-    check(len(recs) >= args.steps, f"metrics.jsonl has {len(recs)} records")
-    last = recs[-1]
-    for col in ("t_batch_wait_s", "t_dispatch_s", "t_sync_s",
+    check(len(recs) >= args.steps - 1,
+          f"metrics.jsonl has {len(recs)} records (≥ steps-1)")
+    full = [r for r in recs if "data_starvation" in r]
+    check(bool(full), "records with the windowed breakdown exist")
+    last = full[-1] if full else {}
+    for col in ("t_batch_wait_s", "t_dispatch_s", "t_sync_s", "t_h2d_s",
                 "data_starvation", "hbm_bytes_in_use", "compiles_total"):
         check(any(col in r for r in recs), f"metric column present: {col}")
     check(0.0 <= last.get("data_starvation", -1) <= 1.0,
           f"data_starvation in [0,1] (last={last.get('data_starvation')})")
+    n_ckpt = sum(1 for r in recs if r.get("t_ckpt_s"))
+    check(n_ckpt >= 1, f"t_ckpt_s recorded after save boundaries ({n_ckpt})")
 
-    # -- 3. watchdog quiet -------------------------------------------------
+    # -- 3. overlap: steady-state stalls ~0; ckpt-boundary step bounded ----
+    # per-step walls from fit/step spans, keyed by their step arg; the first
+    # two steps carry XLA compiles and are excluded from the steady state
+    step_spans = {int(e["args"]["step"]): e["dur"] / 1e6 for e in events
+                  if e["name"] == "fit/step" and (e.get("args") or {}).get("step") is not None}
+    ckpt_steps = {int(e["args"]["step"]) - 1 for e in events
+                  if e["name"] == "fit/checkpoint"}   # span step arg is post-increment
+    steady = sorted(dur for s, dur in step_spans.items()
+                    if s >= 2 and s not in ckpt_steps)
+    boundary = [dur for s, dur in step_spans.items()
+                if s >= 2 and s in ckpt_steps]
+    med_step = steady[len(steady) // 2] if steady else float("nan")
+    waits = sorted(r["t_batch_wait_s"] + r["t_sync_s"] for r in recs
+                   if "t_batch_wait_s" in r and not r.get("t_ckpt_s"))
+    if waits:
+        med_wait = waits[len(waits) // 2]
+        # "≈ 0": an in-memory iterator + device-resident batches + deferred
+        # sync leave only bookkeeping — bounded by 10% of a (tiny, ~ms-scale)
+        # step with a 5 ms absolute floor for CI scheduler noise
+        bound = max(0.10 * med_step, 0.005)
+        check(med_wait < bound,
+              f"steady-state batch_wait+sync ≈ 0 (median {med_wait * 1e3:.3f}ms"
+              f" < {bound * 1e3:.2f}ms)")
+    else:
+        check(False, "no steady-state wait/sync records")
+    if boundary and steady:
+        worst = max(boundary)
+        # async save pays one snapshot, not snapshot+serialize+write: the
+        # boundary step must stay within ~2× the median step. The 1 s
+        # absolute floor covers the toy regime this smoke runs in: orbax's
+        # fixed host dispatch cost (~0.2-0.7 s, amplified on a 1-core CI box
+        # where the background writer shares the core) dwarfs a ~20 ms toy
+        # step but vanishes next to a real model's step — there the 2× term
+        # is the binding constraint
+        bound = max(2.0 * med_step, med_step + 1.0)
+        check(worst <= bound,
+              f"checkpoint-boundary step bounded ({worst * 1e3:.1f}ms ≤ "
+              f"{bound * 1e3:.1f}ms; median step {med_step * 1e3:.1f}ms)")
+    else:
+        check(False, "no checkpoint-boundary step spans found")
+
+    # -- 4. watchdog quiet -------------------------------------------------
     wd = trainer.last_watchdog
     check(wd is not None and wd.stall_count == 0,
           f"watchdog quiet (stalls={getattr(wd, 'stall_count', '?')})")
 
-    # -- 4. span overhead < 1% of step time --------------------------------
+    # -- 5. span overhead < 1% of step time --------------------------------
     per_span = span_overhead_s()
     spans_per_step = len(events) / max(args.steps, 1)
     dispatch_times = sorted(r["t_dispatch_s"] for r in recs
                             if "t_dispatch_s" in r)
     if dispatch_times:
-        med_step = dispatch_times[len(dispatch_times) // 2]
+        med_disp = dispatch_times[len(dispatch_times) // 2]
         overhead = per_span * spans_per_step
-        check(overhead < 0.01 * med_step,
+        check(overhead < 0.01 * med_disp,
               f"span overhead {overhead * 1e6:.1f}µs ({spans_per_step:.0f} "
               f"spans/step × {per_span * 1e9:.0f}ns) < 1% of median step "
-              f"{med_step * 1e3:.2f}ms")
+              f"{med_disp * 1e3:.2f}ms")
     else:
         check(False, "no t_dispatch_s records — overhead gate unmeasurable")
+
+    # -- breakdown artifact (uploaded by ci.yml with the trace) ------------
+    breakdown = {
+        "median_step_s": med_step,
+        "median_batch_wait_plus_sync_s": waits[len(waits) // 2] if waits else None,
+        "checkpoint_boundary_steps_s": sorted(boundary),
+        "records": len(recs), "saves_observed": n_ckpt,
+        "failures": list(FAILURES),
+    }
+    with open(os.path.join(args.outdir, "breakdown.json"), "w") as fh:
+        json.dump(breakdown, fh, indent=2)
 
     print()
     print(summarize_run(args.outdir))

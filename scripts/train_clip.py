@@ -18,6 +18,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from _common import add_overlap_args, overlap_train_kwargs  # noqa: E402
+
 
 def build_parser():
     ap = argparse.ArgumentParser(description=__doc__)
@@ -56,6 +58,7 @@ def build_parser():
                             "rollback rewinds the whole k-step group)")
     train.add_argument("--no_preflight", action="store_true")
 
+    add_overlap_args(ap)
     from dalle_tpu.parallel import wrap_arg_parser
     wrap_arg_parser(ap)
     return ap
@@ -97,6 +100,7 @@ def main(argv=None):
         checkpoint_dir=args.output_dir,
         save_every_steps=args.save_every_n_steps,
         preflight_checkpoint=not args.no_preflight, scan_steps=args.scan_steps,
+        **overlap_train_kwargs(args),
         optim=OptimConfig(learning_rate=args.learning_rate,
                           grad_clip_norm=args.clip_grad_norm))
 
@@ -130,6 +134,7 @@ def main(argv=None):
     final = int(trainer.state.step)
     if trainer.ckpt.latest_step() != final:
         trainer.ckpt.save(final, trainer.state, trainer._meta())
+    trainer.ckpt.wait_until_finished()   # final step durable before exit
     if is_root:
         print(f"done at step {final}; checkpoints in {args.output_dir}")
     return 0

@@ -19,7 +19,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from _common import (add_vae_args, build_vae_from_args,  # noqa: E402
+from _common import (add_overlap_args, add_vae_args,  # noqa: E402
+                     build_vae_from_args, overlap_train_kwargs,
                      save_image_grid, save_vae_sidecar)
 
 
@@ -93,6 +94,8 @@ def build_parser():
     train.add_argument("--flops_profiler", action="store_true",
                        help="profile at step 200 then exit (ref :492-499)")
 
+    add_overlap_args(ap)
+
     tel = ap.add_argument_group("telemetry (grafttrace, docs/OBSERVABILITY.md)")
     tel.add_argument("--trace", action="store_true",
                      help="collect spans; exports <output_dir>/obs/"
@@ -156,6 +159,7 @@ def main(argv=None):
         sample_every_steps=args.sample_every_steps,
         profile_step=200 if args.flops_profiler else 0,
         log_artifacts=args.log_artifacts, scan_steps=args.scan_steps,
+        **overlap_train_kwargs(args),
         optim=OptimConfig(learning_rate=args.learning_rate,
                           grad_clip_norm=args.clip_grad_norm,
                           grad_accum_steps=args.ga_steps,
@@ -257,6 +261,9 @@ def main(argv=None):
     final = int(trainer.state.step)
     if trainer.ckpt.latest_step() != final:
         trainer.ckpt.save(final, trainer.state, trainer._meta())
+    # drain the async writer before returning: a caller (or the next
+    # process) must find the final step durable, not in flight
+    trainer.ckpt.wait_until_finished()
     if metrics_writer is not None:
         metrics_writer.close()
     if is_root:

@@ -17,6 +17,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from _common import add_overlap_args, overlap_train_kwargs  # noqa: E402
+
 
 def build_parser():
     ap = argparse.ArgumentParser(description=__doc__)
@@ -65,6 +67,7 @@ def build_parser():
     train.add_argument("--wandb_name", type=str, default=None)
     train.add_argument("--log_artifacts", action="store_true")
 
+    add_overlap_args(ap)
     from dalle_tpu.parallel import wrap_arg_parser
     wrap_arg_parser(ap)
     return ap
@@ -96,6 +99,7 @@ def main(argv=None):
         preflight_checkpoint=not args.no_preflight,
         sample_every_steps=args.sample_every_steps,
         log_artifacts=args.log_artifacts, scan_steps=args.scan_steps,
+        **overlap_train_kwargs(args),
         optim=OptimConfig(learning_rate=args.learning_rate,
                           grad_clip_norm=args.clip_grad_norm,
                           lr_scheduler="exponential",
@@ -164,6 +168,7 @@ def main(argv=None):
         trainer.ckpt.save(final, trainer.state,
                           {"hparams": model_cfg.to_dict(), "train": train_cfg.to_dict(),
                            "model_class": "DiscreteVAE"})
+    trainer.ckpt.wait_until_finished()   # final step durable before exit
     if backend.is_root_worker():
         print(f"done at step {final}; checkpoints in {args.output_dir}")
     return 0

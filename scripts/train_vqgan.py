@@ -19,6 +19,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from _common import add_overlap_args, overlap_train_kwargs  # noqa: E402
+
 
 def build_parser():
     ap = argparse.ArgumentParser(description=__doc__)
@@ -75,6 +77,7 @@ def build_parser():
     train.add_argument("--wandb_name", type=str, default=None)
     train.add_argument("--log_artifacts", action="store_true")
 
+    add_overlap_args(ap)
     from dalle_tpu.parallel import wrap_arg_parser
     wrap_arg_parser(ap)
     return ap
@@ -116,6 +119,7 @@ def main(argv=None):
         preflight_checkpoint=not args.no_preflight,
         sample_every_steps=args.sample_every_steps,
         log_artifacts=args.log_artifacts, scan_steps=args.scan_steps,
+        **overlap_train_kwargs(args),
         # taming: Adam(lr, betas=(0.5, 0.9)) for both nets (vqgan.py:121-131)
         optim=OptimConfig(learning_rate=lr, beta1=0.5, beta2=0.9,
                           grad_clip_norm=0.0))
@@ -190,6 +194,7 @@ def main(argv=None):
     final = int(trainer.state.step)
     if trainer.ckpt.latest_step() != final:
         trainer.ckpt.save(final, trainer.state, trainer._meta())
+    trainer.ckpt.wait_until_finished()   # final step durable before exit
     if is_root:
         print(f"done at step {final}; checkpoints in {args.output_dir}")
     return 0

@@ -242,12 +242,47 @@ def test_nan_guard_warns_once_when_nothing_checkable(tmp_path):
     assert tr.rollbacks == 0
 
 
+# -- deferred metrics loop logic (host-only; trainer-level defer tests live
+# in test_overlap.py) ---------------------------------------------------------
+
+def test_defer_metrics_save_on_skipped_step_keeps_writer_monotonic(tmp_path):
+    """A save boundary landing on a metrics-skipped step must flush the
+    OLDER parked record before writing its own — wandb silently drops
+    out-of-order steps, so writer steps must stay monotonic."""
+    tc = _tc(tmp_path, defer_metrics=True, metrics_every=3,
+             save_every_steps=5)
+    tr = FakeTrainer(tc)
+    w = RecordingWriter()
+    tr.fit(_batches(7), log=lambda *a: None, metrics_writer=w)
+    steps = [s for s, _ in w.records]
+    assert steps == sorted(steps), steps
+    # parked step-3 record flushed at the step-5 save, save record present,
+    # final parked boundary (6) flushed at fit exit
+    assert steps == [3, 5, 6]
+    assert tr.ckpt.saves == [5]
+
+
+def test_defer_metrics_breakdown_survives_coinciding_save_cadence(tmp_path):
+    """save_every == metrics_every: every boundary force-fetches; the parked
+    breakdown must transfer into the in-band record, not be dropped with
+    the retired deferred entry."""
+    tc = _tc(tmp_path, defer_metrics=True, metrics_every=1,
+             save_every_steps=1)
+    tr = FakeTrainer(tc)
+    w = RecordingWriter()
+    tr.fit(_batches(3), log=lambda *a: None, metrics_writer=w)
+    assert [s for s, _ in w.records] == [1, 2, 3]
+    assert all("t_batch_wait_s" in m for _, m in w.records), w.records
+
+
 # -- grafttrace integration ---------------------------------------------------
 
 def test_fit_emits_step_breakdown_and_starvation(tmp_path):
     """A slow iterator + fast step must show up as a high data_starvation
-    ratio with the full wait/dispatch/sync split in every metrics record."""
-    tc = _tc(tmp_path, obs=ObsConfig(device_poll_every=1))
+    ratio with the full wait/dispatch/sync split in every metrics record.
+    (device_prefetch off: the prefetcher front-loads the slow pulls, which
+    is the point of PR3 — this test pins the un-overlapped breakdown.)"""
+    tc = _tc(tmp_path, device_prefetch=0, obs=ObsConfig(device_poll_every=1))
 
     def slow_batches():
         for _ in range(4):

@@ -194,6 +194,7 @@ def test_four_process_dcn(tmp_path):
 _RING_SP_CHILD = """
 import jax
 jax.config.update('jax_platforms', 'cpu')
+jax.config.update('jax_cpu_collectives_implementation', 'gloo')
 import sys
 import numpy as np
 import jax.numpy as jnp
