@@ -34,4 +34,5 @@ from . import rules_rng  # noqa: F401,E402
 from . import rules_except  # noqa: F401,E402
 from . import rules_jit  # noqa: F401,E402
 from . import rules_vmem  # noqa: F401,E402
+from . import rules_scatter  # noqa: F401,E402
 from . import rules_coverage  # noqa: F401,E402

@@ -1,0 +1,149 @@
+"""Scatter-shape rules for ops code — codifying the int8 KV-scale lesson.
+
+The r5 speculative-decode ablation (ops/attention.KVCache.append_rows)
+measured a vmapped dynamic-update-slice lowering to an unsorted/aliasing
+scatter at 2.2x END-TO-END cost, and an int8 scale scatter along the
+minormost (lane) axis as the second-largest term; the fix — explicit sorted
+unique indices plus transposing the scale to sequence-major so the scatter
+never touches the lane axis — removed the whole gap. Both halves of that
+lesson are mechanical to drift back into, and only show up as wall clock on
+hardware. These rules make the drift a lint finding instead:
+
+  * ``scatter-minormost`` — an ``.at[...]`` scatter whose LAST index element
+    is not a slice writes along the minormost axis (lane-axis scatter on
+    TPU); restructure so the minormost axis stays fully sliced (transpose to
+    sequence-major like the KV scale buffer).
+  * ``scatter-missing-hints`` — an ``.at[...]`` scatter with array-valued
+    indices and neither ``unique_indices`` nor ``indices_are_sorted``: XLA
+    must assume aliasing, unsorted indices and serializes the scatter.
+    Declare the hints where they hold; where they genuinely do not, say so
+    with a suppression comment next to the call.
+
+Scoped to ``dalle_tpu/ops/`` — the numerical core where these scatters sit
+on decode hot paths. Syntactic by design (same trade as rules_jit): the
+patterns are flagged as written, zero whole-program analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Tuple
+
+from .core import FileContext, Finding, Rule, register_rule
+
+# jnp ``.at[]`` update methods that lower to scatter
+_SCATTER_METHODS = ("set", "add", "subtract", "multiply", "divide", "power",
+                    "min", "max", "apply")
+
+
+def _index_elements(sub: ast.Subscript) -> List[ast.expr]:
+    idx = sub.slice
+    return list(idx.elts) if isinstance(idx, ast.Tuple) else [idx]
+
+
+def _is_full_slice_like(node: ast.expr) -> bool:
+    """Index elements that do NOT scatter along their axis: slices, and
+    Ellipsis/None (which only expand/insert axes)."""
+    if isinstance(node, ast.Slice):
+        return True
+    return isinstance(node, ast.Constant) and node.value in (Ellipsis, None)
+
+
+def _is_static_scalar(node: ast.expr) -> bool:
+    """Statically-provable scalar int index (lowers to a single-position
+    dynamic-update-slice, which cannot alias): int literals including
+    negative ones (``-1`` parses as UnaryOp) and arithmetic over them.
+    Names/attributes stay non-scalar — they may hold index arrays."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, int) and not isinstance(node.value, bool)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, (ast.USub,
+                                                              ast.UAdd)):
+        return _is_static_scalar(node.operand)
+    if isinstance(node, ast.BinOp) and isinstance(
+            node.op, (ast.Add, ast.Sub, ast.Mult, ast.FloorDiv, ast.Mod)):
+        return _is_static_scalar(node.left) and _is_static_scalar(node.right)
+    return False
+
+
+def _scatter_calls(tree: ast.Module) -> Iterable[Tuple[ast.Call,
+                                                       ast.Subscript]]:
+    """(call, subscript) pairs for every ``X.at[IDX].<method>(...)``."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SCATTER_METHODS):
+            continue
+        sub = node.func.value
+        if (isinstance(sub, ast.Subscript)
+                and isinstance(sub.value, ast.Attribute)
+                and sub.value.attr == "at"):
+            yield node, sub
+
+
+@register_rule
+class ScatterMinormost(Rule):
+    name = "scatter-minormost"
+    description = (".at[...] scatter whose index demonstrably reaches the "
+                   "trailing axis (≥3 elements or a leading Ellipsis, "
+                   "non-slice last) — writes along the minormost (lane) "
+                   "axis, the layout TPU scatters serialize on; keep the "
+                   "minormost axis fully sliced (transpose to "
+                   "sequence-major)")
+    include = ("dalle_tpu/ops/",)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for call, sub in _scatter_calls(ctx.tree):
+            elts = _index_elements(sub)
+            if _is_full_slice_like(elts[-1]):
+                continue
+            # rank is unknowable statically, so only flag indexes that
+            # DEMONSTRABLY reach the trailing axis: a leading Ellipsis
+            # aligns the last element with it outright, and ≥3 explicit
+            # elements cover every array rank this codebase scatters
+            # (rank-3 caches/scales). Two-element indexes on rank-3 arrays
+            # leave the lane axis implicitly sliced (the blessed
+            # append_rows shape) and are never flagged.
+            reaches_minor = (len(elts) >= 3
+                             or any(isinstance(e, ast.Constant)
+                                    and e.value is Ellipsis
+                                    for e in elts[:-1]))
+            if not reaches_minor:
+                continue
+            yield Finding(
+                self.name, ctx.rel_path, call.lineno,
+                "scatter indexes the minormost axis (last index element is "
+                "not a slice) — lane-axis scatters serialize on TPU; "
+                "restructure so the trailing axis stays fully sliced, e.g. "
+                "transpose to sequence-major as KVCache.append_rows does "
+                "for the int8 scale buffer")
+
+
+@register_rule
+class ScatterMissingHints(Rule):
+    name = "scatter-missing-hints"
+    description = (".at[...] scatter with array-valued indices and neither "
+                   "unique_indices nor indices_are_sorted — XLA assumes "
+                   "aliasing/unsorted and serializes (the 2.2x append_rows "
+                   "regression); declare the hints where they hold")
+    include = ("dalle_tpu/ops/",)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for call, sub in _scatter_calls(ctx.tree):
+            elts = _index_elements(sub)
+            # "advanced" index: anything that is not a slice/Ellipsis/None
+            # and not a statically-scalar int (single-position updates
+            # don't alias). Names and gathered arrays count.
+            advanced = [e for e in elts if not _is_full_slice_like(e)
+                        and not _is_static_scalar(e)]
+            if not advanced:
+                continue
+            kwargs = {kw.arg for kw in call.keywords}
+            if kwargs & {"unique_indices", "indices_are_sorted"}:
+                continue
+            yield Finding(
+                self.name, ctx.rel_path, call.lineno,
+                "array-indexed scatter without unique_indices/"
+                "indices_are_sorted — the compiler must assume aliasing and "
+                "unsorted indices (measured 2.2x end-to-end on the b64 "
+                "speculative loop); declare the hints that hold, or "
+                "suppress here if they genuinely do not")

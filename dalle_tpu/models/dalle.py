@@ -34,7 +34,8 @@ import optax
 
 from ..config import DalleConfig
 from ..ops.quantize_weights import QDense
-from ..ops.sampling import gumbel_sample, prob_mask_like, top_k_filter
+from ..ops.sampling import (gumbel_sample, gumbel_sample_rows,
+                            prob_mask_like, top_k_filter)
 from .transformer import DivideMax, Transformer
 
 MASK_VALUE = -1e9  # max_neg/2-style fill for the logits mask
@@ -262,14 +263,15 @@ class DALLE(nn.Module):
         logits = self._finish(y[:, -1:], (tokens.shape[1] - 1, 1))[:, 0]
         return logits, cache, tokens.shape[1]
 
-    def _decode_one(self, token_id, img_pos, offset, cache):
+    def _decode_one(self, token_id, img_pos, offset, cache, use_kernel=None):
         """Embed image token sampled at image position ``img_pos`` and advance."""
         tok = self._embed_image_ids(token_id[:, None])
         if not self.cfg.rotary_emb:
             emb = self.image_pos_emb()
             tok = tok + jax.lax.dynamic_slice_in_dim(emb, img_pos, 1, axis=0)[None]
         tok = self._stabilize(tok)
-        y, cache = self.transformer.decode_step(tok, cache, offset)
+        y, cache = self.transformer.decode_step(tok, cache, offset,
+                                                use_kernel=use_kernel)
         logits = self._finish(y, (offset, 1))[:, 0]
         return logits, cache
 
@@ -277,7 +279,8 @@ class DALLE(nn.Module):
                                temperature: float = 1.0, cond_scale: float = 1.0,
                                image_prime: Optional[jnp.ndarray] = None,
                                cache_dtype=jnp.float32,
-                               topk_approx: bool = False):
+                               topk_approx: bool = False,
+                               use_kernel=None):
         """AR-sample the full image token sequence. Returns (b, image_seq_len)
         int32 codebook ids. ``text`` must be (b, text_seq_len).
         ``cache_dtype=bf16`` halves the KV-cache traffic of the decode loop;
@@ -285,7 +288,12 @@ class DALLE(nn.Module):
         quantization (ops/attention.KVCache — sampling itself always runs on
         f32 logits). ``topk_approx`` swaps the exact per-step top-k sort for
         TPU's approximate top-k unit (ops/sampling.top_k_filter) — the sort
-        is ~17% of decode wall time at batch 64.
+        is ~17% of decode wall time at batch 64. ``use_kernel`` pins the
+        Pallas decode-kernel selection (None = shape-gated auto on TPU,
+        always dense elsewhere); pin False here AND on a serve engine for
+        strict bitwise parity between the two — the single-token and
+        windowed kernels are distinct implementations, so auto mode may
+        pick different attends per path on TPU.
         (reference generate_images :490-557 minus vae decode/CLIP, which live in
         DalleWithVae)"""
         c = self.cfg
@@ -314,9 +322,11 @@ class DALLE(nn.Module):
             tok = sample_from(logits, sub)
             img_pos = n_prime + i
             offset = prefix_len + i
-            new_logits, cache = self._decode_one(tok, img_pos, offset, cache)
+            new_logits, cache = self._decode_one(tok, img_pos, offset, cache,
+                                                 use_kernel)
             if use_cfg:
-                nl, null_cache = self._decode_one(tok, img_pos, offset, null_cache)
+                nl, null_cache = self._decode_one(tok, img_pos, offset,
+                                                  null_cache, use_kernel)
                 new_logits = nl + (new_logits - nl) * cond_scale
             return (new_logits, cache, null_cache, k), tok
 
@@ -387,12 +397,10 @@ class DALLE(nn.Module):
             committed key discipline key(step, row)."""
             keys = jax.vmap(lambda t, r: jax.random.fold_in(
                 jax.random.fold_in(key, t), r))(t_idx, arange_b)
-            band = logits[:, self.num_text_tokens:]
-            filt = top_k_filter(band, thres=filter_thres, approx=topk_approx)
-            g = jax.vmap(lambda kk: jax.random.gumbel(
-                kk, (filt.shape[-1],), jnp.float32))(keys)
-            scaled = filt.astype(jnp.float32) / max(temperature, 1e-10)
-            return jnp.argmax(scaled + g, axis=-1).astype(jnp.int32)
+            return gumbel_sample_rows(keys, logits[:, self.num_text_tokens:],
+                                      thres=filter_thres,
+                                      temperature=temperature,
+                                      approx=topk_approx)
 
         def draft_tokens(tok0, out_buf, t_idx):
             if gamma == 0:
@@ -466,6 +474,79 @@ class DALLE(nn.Module):
         if return_stats:
             return out_buf, rounds, committed
         return out_buf
+
+    # -- serving: per-row-length decode primitives (dalle_tpu/serve) -------
+    # The continuous-batching engine keeps B decode slots in ONE shared
+    # cache; slots are at ragged positions (each carries its own prompt and
+    # per-row length), so every device call below threads (b,) offset
+    # vectors through transformer.decode_window. Rows that must not be
+    # touched get offset == max_seq: their k/v scatter indices land entirely
+    # out of bounds and are DROPPED (XLA scatter OOB semantics — the same
+    # contract the speculative path's mode="drop" commit relies on), so a
+    # parked row's cache is bit-identical before and after the call.
+    #
+    # Exactness contract (tests/test_serve.py): with cache max_seq ==
+    # total_seq_len — the same size single-request generation uses — every
+    # reduction in these paths has the same width as its sequential
+    # counterpart, and each request's logits (hence tokens, under the same
+    # key discipline) match generate_images_tokens bitwise, for any
+    # admission order.
+
+    def serve_img_logits(self, y):
+        """(b, dim) hidden states → (b, V) masked logits. Every served
+        position predicts image tokens, and the static allow-mask rows for
+        positions ≥ text_seq_len are identical — one row serves them all
+        (the same argument generate_images_tokens_speculative makes)."""
+        return self._finish(y[:, None], (self.cfg.text_seq_len, 1))[:, 0]
+
+    def serve_init_cache(self, batch: int, dtype=jnp.float32):
+        """Shared decode cache for ``batch`` serve slots. max_seq is exactly
+        total_seq_len so softmax reduce widths match single-request
+        generation (bitwise exactness); the park offset is max_seq itself."""
+        return self.transformer.init_cache(batch, self.cfg.total_seq_len,
+                                           dtype)
+
+    def serve_refill(self, text, cache, refill_mask, use_kernel=None):
+        """Admission: prefill new prompts into SELECTED rows of the live
+        multi-slot cache in one multi-row window. ``text`` (b, text_seq_len)
+        int32 (rows with ``refill_mask`` False are ignored); refilled rows
+        write their prompt k/v at [0, prefix_len) — overwriting the previous
+        occupant — while every other row parks at offset max_seq. Returns
+        (logits (b, V) for each refilled row's first image token, cache)."""
+        S = cache["kv_0"].kv.shape[1]   # max_seq == the park offset
+        text_b = self.remap_and_bos(text)
+        tokens = self._stabilize(self.embed_text(text_b))
+        offsets = jnp.where(refill_mask, 0, S)
+        y, cache = self.transformer.decode_window(tokens, cache, offsets,
+                                                  use_kernel=use_kernel)
+        return self.serve_img_logits(y[:, -1]), cache
+
+    def serve_prefill_row(self, text, cache_dtype=jnp.float32):
+        """Single-request prefill for the engine's per-row admission path:
+        (1, text_seq_len) text → (logits (1, V), fresh b=1 cache sized
+        total_seq_len). Bitwise identical to the sequential ``_prefill`` by
+        construction — the engine scatters the cache row into the shared
+        multi-slot cache (cheaper than the multi-row refill window when
+        admitting a small fraction of the slots)."""
+        logits, cache, _ = self._prefill(text, None, 1, dtype=cache_dtype,
+                                         extra_slots=0)
+        return logits, cache
+
+    def serve_decode(self, tok, img_pos, offsets, cache, use_kernel=None):
+        """One decode step for every slot at PER-ROW positions: ``tok`` (b,)
+        image-band token ids, ``img_pos`` (b,) image grid positions (axial
+        table rows when rotary is off), ``offsets`` (b,) absolute cache
+        write positions — parked rows pass max_seq (write dropped, output
+        discarded by the engine). Returns (logits (b, V), cache)."""
+        c = self.cfg
+        emb = self._embed_image_ids(tok[:, None])
+        if not c.rotary_emb:
+            pos = jnp.clip(img_pos, 0, c.image_seq_len - 1)
+            emb = emb + jnp.take(self.image_pos_emb(), pos, axis=0)[:, None]
+        emb = self._stabilize(emb)
+        y, cache = self.transformer.decode_window(emb, cache, offsets,
+                                                  use_kernel=use_kernel)
+        return self.serve_img_logits(y[:, 0]), cache
 
     def generate_texts_tokens(self, key, text: Optional[jnp.ndarray] = None, *,
                               batch: int = 1, filter_thres: float = 0.5,

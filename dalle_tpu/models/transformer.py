@@ -229,8 +229,12 @@ class Attention(nn.Module):
         out = out.transpose(0, 2, 1, 3).reshape(b, n, -1)
         return self.to_out(out), cache
 
-    def decode(self, x_t, cache: KVCache, offset, *, rotary=None, static_mask=None):
-        """One-token step at position ``offset`` (traced scalar)."""
+    def decode(self, x_t, cache: KVCache, offset, *, rotary=None, static_mask=None,
+               use_kernel=None):
+        """One-token step at position ``offset`` (traced scalar).
+        ``use_kernel`` pins the Pallas decode-kernel selection (None = auto)
+        — see cached_attend; plumbed so parity-critical callers can force
+        the same attend implementation on every path."""
         b = x_t.shape[0]
         q, k, v = self._split(self.to_qkv(x_t), 1)
         if rotary is not None:
@@ -238,11 +242,13 @@ class Attention(nn.Module):
             q, k, v = (apply_rotary(rot, t) for t in (q, k, v))
         cache = cache.append(k, v, offset)
         out = cached_attend(q, cache, offset + 1, static_mask=static_mask,
-                            stable=self.stable, qpos=offset)
+                            stable=self.stable, qpos=offset,
+                            use_kernel=use_kernel)
         out = out.transpose(0, 2, 1, 3).reshape(b, 1, -1)
         return self.to_out(out), cache
 
-    def decode_window(self, x_w, cache: KVCache, offsets, *, rotary=None):
+    def decode_window(self, x_w, cache: KVCache, offsets, *, rotary=None,
+                      use_kernel=None):
         """Speculative verify step: ``w`` tokens per row at PER-ROW absolute
         positions ``offsets[b] .. offsets[b]+w-1`` (offsets: (b,) traced) —
         batch rows diverge because they accept different draft lengths.
@@ -261,7 +267,8 @@ class Attention(nn.Module):
             rot = jnp.take(rotary, pos, axis=0)[:, None]         # (b,1,w,rot)
             q, k, v = (apply_rotary(rot, t) for t in (q, k, v))
         cache = cache.append_rows(k, v, offsets)
-        out = cached_attend_window(q, cache, offsets, stable=self.stable)
+        out = cached_attend_window(q, cache, offsets, stable=self.stable,
+                                   use_kernel=use_kernel)
         out = out.transpose(0, 2, 1, 3).reshape(b, w, -1)
         return self.to_out(out), cache
 
@@ -676,7 +683,8 @@ class Transformer(nn.Module):
             x = x + y
         return x, cache
 
-    def decode_window(self, x_w, cache: Dict[str, Any], offsets):
+    def decode_window(self, x_w, cache: Dict[str, Any], offsets, *,
+                      use_kernel=None):
         """w tokens per row at per-row positions ``offsets`` (b,) — the
         speculative verify forward (models/dalle.py). Requires full
         attention and no token-shift (both hold for every generation config
@@ -692,14 +700,16 @@ class Transformer(nn.Module):
         for ind in range(c.depth):
             attn_l, ff_l = self.attn_layers[ind], self.ff_layers[ind]
             y, kv = attn_l.decode_window(x_w, cache[f"kv_{ind}"], offsets,
-                                         rotary=self.rotary)
+                                         rotary=self.rotary,
+                                         use_kernel=use_kernel)
             cache[f"kv_{ind}"] = kv
             x_w = x_w + y
             y, _ = ff_l.decode_window(x_w, None, offsets)
             x_w = x_w + y
         return x_w, cache
 
-    def decode_step(self, x_t, cache: Dict[str, Any], offset):
+    def decode_step(self, x_t, cache: Dict[str, Any], offset, *,
+                    use_kernel=None):
         """One token at traced position ``offset``. Returns (y_t, cache).
         Sparse masks apply via their offset row; causality is implicit
         (reference attention.py:86 'causality is naturally enforced')."""
@@ -710,7 +720,8 @@ class Transformer(nn.Module):
             y, kv, ss = attn_l.decode(x_t, cache[f"kv_{ind}"],
                                       cache.get(f"shift_attn_{ind}"), offset,
                                       rotary=self.rotary,
-                                      static_mask=self._dense_mask(t))
+                                      static_mask=self._dense_mask(t),
+                                      use_kernel=use_kernel)
             cache[f"kv_{ind}"] = kv
             if ss is not None:
                 cache[f"shift_attn_{ind}"] = ss

@@ -94,6 +94,64 @@ class DalleWithVae:
                                     rngs=rngs or None)
         return out, aux
 
+    def _resolve_precision(self, precision: str):
+        """(params, cache_dtype) for a decode precision mode. Casts/
+        quantizes once and caches — re-transforming the full tree per call
+        would serialize GBs of work ahead of every batch's decode loop. The
+        cache keys on (source tree identity, mode), so a checkpoint reload /
+        EMA swap on the same wrapper re-derives instead of reusing stale
+        weights. Shared by ``generate_images`` and ``serve_engine``."""
+        if precision not in ("float32", "f32", "bfloat16", "bf16",
+                             "bf16_int8kv", "int8w"):
+            # a typo would otherwise fall through to the ~3x-slower f32 path
+            # with no signal that the requested fast mode never engaged
+            raise ValueError(f"unknown precision {precision!r}; expected "
+                             "float32 | bfloat16 | bf16_int8kv | int8w")
+        params, cache_dtype = self.params, jnp.float32
+        if precision in ("bfloat16", "bf16", "bf16_int8kv", "int8w"):
+            mode = "int8w" if precision == "int8w" else "bf16"
+            cache = getattr(self, "_fast_params", None)
+            if cache is None or cache[0] is not self.params:
+                # source tree changed (checkpoint reload / EMA swap): drop
+                # every derived mode
+                cache = (self.params, {})
+                object.__setattr__(self, "_fast_params", cache)
+            if mode not in cache[1]:
+                if mode == "int8w":
+                    # int8 matmul kernels + int8 shared table, everything
+                    # else bf16 (ops/quantize_weights.py)
+                    from ..ops.quantize_weights import quantize_params_int8
+                    cache[1][mode] = quantize_params_int8(self.params)
+                else:
+                    from ..train.train_state import cast_floating
+                    cache[1][mode] = cast_floating(self.params, jnp.bfloat16)
+            params = cache[1][mode]
+            cache_dtype = (jnp.int8 if precision in ("bf16_int8kv", "int8w")
+                           else jnp.bfloat16)
+        return params, cache_dtype
+
+    def serve_engine(self, *, slots: int, precision: str = "float32",
+                     filter_thres: float = 0.5, temperature: float = 1.0,
+                     topk_approx: bool = False, steps_per_sync: int = 1,
+                     use_kernel=None):
+        """Continuous-batching decode engine over this wrapper's model —
+        the serving-side sibling of ``generate_images``. ``slots`` is the
+        fixed device batch; precision modes are the same fast paths
+        (bf16 / bf16_int8kv / int8w reuse the wrapper's cached derived
+        params). The engine emits image TOKEN ids per completed request
+        (``dalle_tpu.serve.CompletedRequest``); decode pixels with
+        ``self.vae.decode(tokens[None])`` as needed — serving keeps the
+        dVAE off the per-token critical path."""
+        from ..serve.engine import DecodeEngine
+        params, cache_dtype = self._resolve_precision(precision)
+        return DecodeEngine(self.model, params, slots=slots,
+                            cache_dtype=cache_dtype,
+                            filter_thres=filter_thres,
+                            temperature=temperature,
+                            topk_approx=topk_approx,
+                            steps_per_sync=steps_per_sync,
+                            use_kernel=use_kernel)
+
     def generate_images(self, text, key, *, filter_thres: float = 0.5,
                         temperature: float = 1.0, cond_scale: float = 1.0,
                         img: Optional[jnp.ndarray] = None,
@@ -133,38 +191,7 @@ class DalleWithVae:
             assert n_prime < self.model.cfg.image_seq_len
             with span("decode/vae_encode_prime"):
                 prime = self.vae.get_codebook_indices(img)[:, :n_prime]
-        if precision not in ("float32", "f32", "bfloat16", "bf16",
-                             "bf16_int8kv", "int8w"):
-            # a typo would otherwise fall through to the ~3x-slower f32 path
-            # with no signal that the requested fast mode never engaged
-            raise ValueError(f"unknown precision {precision!r}; expected "
-                             "float32 | bfloat16 | bf16_int8kv | int8w")
-        params, cache_dtype = self.params, jnp.float32
-        if precision in ("bfloat16", "bf16", "bf16_int8kv", "int8w"):
-            # cast/quantize once and cache — re-transforming the full tree
-            # per call would serialize GBs of work ahead of every batch's
-            # decode loop. The cache keys on (source tree identity, mode), so
-            # a checkpoint reload / EMA swap on the same wrapper re-derives
-            # instead of reusing stale weights
-            mode = "int8w" if precision == "int8w" else "bf16"
-            cache = getattr(self, "_fast_params", None)
-            if cache is None or cache[0] is not self.params:
-                # source tree changed (checkpoint reload / EMA swap): drop
-                # every derived mode
-                cache = (self.params, {})
-                object.__setattr__(self, "_fast_params", cache)
-            if mode not in cache[1]:
-                if mode == "int8w":
-                    # int8 matmul kernels + int8 shared table, everything
-                    # else bf16 (ops/quantize_weights.py)
-                    from ..ops.quantize_weights import quantize_params_int8
-                    cache[1][mode] = quantize_params_int8(self.params)
-                else:
-                    from ..train.train_state import cast_floating
-                    cache[1][mode] = cast_floating(self.params, jnp.bfloat16)
-            params = cache[1][mode]
-            cache_dtype = (jnp.int8 if precision in ("bf16_int8kv", "int8w")
-                           else jnp.bfloat16)
+        params, cache_dtype = self._resolve_precision(precision)
         n_new = self.model.cfg.image_seq_len - (prime.shape[1]
                                                 if prime is not None else 0)
         with span("decode/generate_tokens", tokens=int(n_new),

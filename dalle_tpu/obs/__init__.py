@@ -15,7 +15,8 @@ from .prometheus import render_textfile, sanitize_metric_name, write_textfile
 from .report import span_overhead_s, summarize_run
 from .trace import (Tracer, configure, counter_add, disable, enabled,
                     export_chrome_trace, export_spans_jsonl, gauge_set,
-                    get_tracer, metrics_snapshot, open_spans, span)
+                    get_tracer, metrics_snapshot, open_spans, record_span,
+                    span)
 from .watchdog import StallReport, StallWatchdog
 
 _DEVICE_NAMES = ("CompileCounter", "DeviceTelemetry", "device_memory_stats",
@@ -26,7 +27,7 @@ __all__ = [
     "write_textfile", "span_overhead_s", "summarize_run", "Tracer",
     "configure", "counter_add", "disable", "enabled", "export_chrome_trace",
     "export_spans_jsonl", "gauge_set", "get_tracer", "metrics_snapshot",
-    "open_spans", "span", "StallReport", "StallWatchdog",
+    "open_spans", "record_span", "span", "StallReport", "StallWatchdog",
 ]
 
 

@@ -205,6 +205,21 @@ def metrics_snapshot() -> dict:
     return tr.snapshot_metrics() if tr is not None else {}
 
 
+def record_span(name: str, start_perf_s: float, duration_s: float,
+                **args) -> None:
+    """Record a completed span retrospectively — for long-lived OVERLAPPING
+    regions that cannot respect the per-thread with-block stack discipline
+    (e.g. one span per in-flight serve request: N requests overlap in one
+    thread, so entering N ``span`` contexts would corrupt the stack the
+    watchdog reads). ``start_perf_s`` is a ``time.perf_counter()`` timestamp
+    captured at region start; the record lands in the same ring as regular
+    spans (depth 0) and exports identically. No-op when tracing is off."""
+    tr = _tracer
+    if tr is None:
+        return
+    tr._record(name, start_perf_s, duration_s, 0, args or None)
+
+
 def open_spans() -> dict:
     """Live per-thread open-span stacks, outermost first:
     ``{"MainThread:140..": ["fit/step", "fit/dispatch"], ...}``. The stall

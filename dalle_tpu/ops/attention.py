@@ -255,9 +255,12 @@ def cached_attend(q: jnp.ndarray, cache: KVCache, length, *,
 
 def cached_attend_window(q: jnp.ndarray, cache: KVCache, starts, *,
                          stable: bool = False,
-                         scale: Optional[float] = None) -> jnp.ndarray:
+                         scale: Optional[float] = None,
+                         use_kernel: Optional[bool] = None) -> jnp.ndarray:
     """Multi-token cached decode with PER-ROW positions — the speculative
-    verify step (models/dalle.py generate_images_tokens_speculative).
+    verify step (models/dalle.py generate_images_tokens_speculative) and the
+    serving engine's per-row decode + multi-row refill prefill
+    (dalle_tpu/serve/engine.py).
 
     q: (b, h, w, d) — w window queries per row, row ``b`` occupying absolute
     positions ``starts[b] .. starts[b]+w-1`` (``starts``: (b,) traced). Query
@@ -266,7 +269,23 @@ def cached_attend_window(q: jnp.ndarray, cache: KVCache, starts, *,
     invisible (they get overwritten by later windows). Full causal attention
     only — static sparse masks would need per-row row gathers and no
     generation config uses them.
+
+    On TPU with lane-tiled shapes this runs the windowed Pallas kernel
+    (ops/decode_attention.decode_attend_window_kernel — per-row starts ride
+    a prefetched scalar vector, w query rows share one launch);
+    ``use_kernel`` overrides the auto-selection, which re-checks the RUNTIME
+    shapes (like fused_fits) so an unfit shape always falls to this dense
+    path, never a failing compile.
     """
+    from .decode_attention import (decode_attend_window_kernel,
+                                   decode_window_kernel_supported)
+    if use_kernel is None:
+        use_kernel = (jax.default_backend() == "tpu"
+                      and decode_window_kernel_supported(q, cache,
+                                                         stable=stable))
+    if use_kernel:
+        return decode_attend_window_kernel(q, cache, starts, scale=scale,
+                                           out_dtype=q.dtype)
     if scale is None:
         scale = q.shape[-1] ** -0.5
     q = q * scale

@@ -76,6 +76,23 @@ def gumbel_sample(key: jax.Array, logits: jnp.ndarray, temperature: float = 1.0,
         return jnp.argmax(logits.astype(jnp.float32) / max(temperature, 1e-10) + g, axis=axis)
 
 
+def gumbel_sample_rows(keys: jax.Array, logits: jnp.ndarray, *,
+                       thres: float = 0.5, temperature: float = 1.0,
+                       approx: bool = False) -> jnp.ndarray:
+    """Per-row filtered gumbel-argmax: one PRNG key PER ROW of (b, V)
+    logits — the batched form of ``top_k_filter`` + ``gumbel_sample`` whose
+    recipe the serve engine and the speculative verify step both rely on
+    for token-exactness, kept in one place so the two paths cannot drift.
+    The per-row (V,) gumbel draw is bitwise identical to a sequential
+    (1, V) draw under the same key (threefry bits depend only on the flat
+    element count), so a row sampled here equals that row sampled alone."""
+    filt = top_k_filter(logits, thres=thres, approx=approx)
+    g = jax.vmap(lambda k: jax.random.gumbel(
+        k, (logits.shape[-1],), jnp.float32))(keys)
+    scaled = filt.astype(jnp.float32) / max(temperature, 1e-10)
+    return jnp.argmax(scaled + g, axis=-1).astype(jnp.int32)
+
+
 def prob_mask_like(key: jax.Array, shape, prob: float) -> jnp.ndarray:
     """Bernoulli(prob) boolean mask — used for classifier-free-guidance dropout of
     the text condition (reference dalle_pytorch.py:47-49, used at :570-574)."""

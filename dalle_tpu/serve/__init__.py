@@ -1,0 +1,15 @@
+"""Continuous-batching serving for DALLE image generation.
+
+``RequestQueue`` (host FIFO) → ``SlotScheduler`` (slot ↔ request
+bookkeeping) → ``DecodeEngine`` (the device loop: B shared-cache decode
+slots, per-row lengths/offsets/RNG lanes, iteration-level refill). See
+docs/PERFORMANCE.md ("Serving") and scripts/serve_bench.py /
+scripts/serve_smoke.py.
+"""
+
+from .engine import DecodeEngine, EngineStats
+from .queue import CompletedRequest, Request, RequestQueue
+from .scheduler import SlotScheduler
+
+__all__ = ["DecodeEngine", "EngineStats", "CompletedRequest", "Request",
+           "RequestQueue", "SlotScheduler"]

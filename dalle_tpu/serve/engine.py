@@ -1,0 +1,418 @@
+"""Slot-based continuous-batching decode engine (Orca-style iteration-level
+scheduling, Yu et al., OSDI '22 — adapted to static-shape TPU serving).
+
+A fixed device batch of B decode slots shares one KV cache. Each slot
+carries its own prompt, per-row cache offset, per-row length and RNG lane —
+all (B,)-shaped device arrays, so rows at ragged positions ride one
+compiled program and admission never recompiles. When a row emits its last
+image token it is refilled from the host-side ``RequestQueue`` on the very
+next iteration by prefilling the new prompt at that row's offset in one
+multi-row window (``DALLE.serve_refill``); the other rows keep decoding —
+no drain, no batch re-formation.
+
+Two jitted device programs, compiled once per engine:
+
+  * ``refill(params, state, texts, seeds, n_rows, mask)`` — admission
+    prefill for the masked rows, with per-row decode lengths (parked rows'
+    cache writes drop out of bounds).
+  * ``step(params, state)`` — sample one token per slot under the per-row
+    key discipline, then decode it at per-row offsets
+    (``DALLE.serve_decode`` → ``transformer.decode_window`` →
+    ``cached_attend_window``, which self-selects the windowed Pallas
+    kernel on TPU).
+
+Correctness bar (tests/test_serve.py, scripts/serve_smoke.py): each
+request's tokens are BIT-EXACT against single-request
+``generate_images_tokens(text[None], PRNGKey(seed))`` for any admission
+order — the engine replicates the sequential path's split-chain key
+discipline per row and keeps every reduction width identical (cache
+max_seq == total_seq_len).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.dalle import DALLE
+from ..obs import counter_add, gauge_set, record_span
+from ..ops.sampling import gumbel_sample_rows
+from .queue import CompletedRequest, Request, RequestQueue
+from .scheduler import SlotScheduler
+
+
+@dataclasses.dataclass
+class EngineStats:
+    steps: int = 0
+    refills: int = 0
+    # running mean of occupancy at iterations where the queue still held
+    # work — the ≥90% serving bar only means something while there IS work.
+    # Sum/count (not a sample list) so a long-lived serve loop stays O(1).
+    occupancy_sum: float = 0.0
+    occupancy_n: int = 0
+    # request ids still mid-decode when a max_steps bound tripped — they
+    # were consumed from the queue and will never complete (empty on drain)
+    aborted_in_flight: List[int] = dataclasses.field(default_factory=list)
+
+    def sample_occupancy(self, value: float) -> None:
+        self.occupancy_sum += float(value)
+        self.occupancy_n += 1
+
+    @property
+    def occupancy_while_queued(self) -> float:
+        if not self.occupancy_n:
+            return 1.0
+        return self.occupancy_sum / self.occupancy_n
+
+
+class DecodeEngine:
+    """Continuous-batching image-token decode over a DALLE model.
+
+    ``slots``: device batch size B (every compiled program is shaped by it).
+    ``cache_dtype``: KV storage dtype (f32 / bf16 / int8 — same knob as
+    ``generate_images_tokens``). Sampling knobs mirror the sequential path
+    so the exactness contract holds per request.
+
+    ``use_kernel`` pins Pallas attend-kernel selection for the engine's
+    decode and refill programs (None = shape-gated auto on TPU, dense
+    elsewhere). Bitwise token parity with ``generate_images_tokens`` is
+    guaranteed when both paths resolve to the same attend implementation —
+    always true on the CPU mesh (CI enforces it there). On TPU the windowed
+    and single-token kernels are DISTINCT implementations (each within
+    ~2e-2 of dense, not bitwise), and auto-selection is shape-dependent per
+    path; for strict parity runs pin ``use_kernel=False`` here and on the
+    reference ``generate_images_tokens`` call. Auto mode trades that strict
+    guarantee for kernel throughput.
+    """
+
+    def __init__(self, model: DALLE, params, *, slots: int,
+                 cache_dtype=jnp.float32, filter_thres: float = 0.5,
+                 temperature: float = 1.0, topk_approx: bool = False,
+                 steps_per_sync: int = 1, use_kernel=None):
+        c = model.cfg
+        attn_types = tuple(c.attn_types) or ("full",)
+        if any(t != "full" for t in attn_types) or c.shift_tokens:
+            # same constraint set as speculative decode: per-row windows
+            # have no per-row sparse-mask gather and the shift ring buffers
+            # are one-token-sequential by construction
+            raise ValueError(
+                "the serve engine requires full attention and "
+                f"shift_tokens=False (got attn_types={attn_types}, "
+                f"shift_tokens={c.shift_tokens})")
+        self.model = model
+        self.params = params
+        self.slots = int(slots)
+        self.cache_dtype = cache_dtype
+        self.filter_thres = filter_thres
+        self.temperature = temperature
+        self.topk_approx = topk_approx
+        self.use_kernel = use_kernel
+
+        self.text_seq_len = c.text_seq_len
+        self.prefix_len = c.text_seq_len + 1          # <bos> + text
+        self.n_steps = c.image_seq_len
+        self.park = c.total_seq_len                   # cache max_seq
+        self.num_text_tokens = c.num_text_tokens + c.text_seq_len
+        # multi-step scheduling: run K device steps per host sync
+        # (lax.scan inside one program). K=1 is pure iteration-level
+        # scheduling — a finished row refills on the very next token. K>1
+        # amortizes per-dispatch host overhead (the serving lever when the
+        # per-token program is small relative to dispatch cost — this
+        # sandbox's CPU mesh) at the price of admission granularity: a
+        # freed slot waits up to K-1 device steps for its refill. Token
+        # exactness is unaffected — the device math is identical.
+        assert steps_per_sync >= 1
+        self.steps_per_sync = int(steps_per_sync)
+
+        self._refill_fn = jax.jit(self._refill, donate_argnums=(1,))
+        self._refill_row_fn = jax.jit(self._refill_row, donate_argnums=(1,))
+        self._step_fn = jax.jit(self._multi_step, donate_argnums=(1,))
+        self.stats = EngineStats()
+
+    # -- device programs ---------------------------------------------------
+    def _init_state(self) -> Dict:
+        cache = self.model.apply(self.params, self.slots, self.cache_dtype,
+                                 method=DALLE.serve_init_cache)
+        B = self.slots
+        texts = jax.ShapeDtypeStruct((B, self.text_seq_len), jnp.int32)
+        mask = jax.ShapeDtypeStruct((B,), jnp.bool_)
+        # logits dtype must match what the model emits (bf16 params emit
+        # bf16 logits): a f32 placeholder would silently promote the
+        # jnp.where merge and break bitwise exactness vs the sequential path
+        out_shape = jax.eval_shape(
+            lambda p, t, cc, m: self.model.apply(
+                p, t, cc, m, method=DALLE.serve_refill),
+            self.params, texts, cache, mask)
+        logits_dtype = out_shape[0].dtype
+        return {
+            "cache": cache,
+            "logits": jnp.zeros((B, out_shape[0].shape[-1]), logits_dtype),
+            "cur_key": jnp.zeros((B, 2), jnp.uint32),
+            "orig_key": jnp.zeros((B, 2), jnp.uint32),
+            # parked until admitted: j clamps to the final step, active=False
+            "t_idx": jnp.full((B,), self.n_steps, jnp.int32),
+            # per-row decode length (ragged service demand — partial-grid
+            # requests): tokens for a row with n < image_seq_len equal the
+            # first n of the full single-request generation
+            "n_row": jnp.full((B,), self.n_steps, jnp.int32),
+            "active": jnp.zeros((B,), jnp.bool_),
+        }
+
+    def _refill(self, params, state, texts, seeds, n_rows, mask):
+        new_keys = jax.vmap(jax.random.PRNGKey)(seeds)       # (B, 2) u32
+        logits_r, cache = self.model.apply(
+            params, texts, state["cache"], mask, self.use_kernel,
+            method=DALLE.serve_refill)
+        m1 = mask[:, None]
+        return {
+            "cache": cache,
+            "logits": jnp.where(m1, logits_r, state["logits"]),
+            "cur_key": jnp.where(m1, new_keys, state["cur_key"]),
+            "orig_key": jnp.where(m1, new_keys, state["orig_key"]),
+            "t_idx": jnp.where(mask, 0, state["t_idx"]),
+            "n_row": jnp.where(mask, n_rows, state["n_row"]),
+            "active": state["active"] | mask,
+        }
+
+    def _refill_row(self, params, state, text1, seed, n_tok, row):
+        """Admit ONE request into slot ``row`` (traced scalar — one
+        compiled program serves every slot): a b=1 prefill (bitwise the
+        sequential ``_prefill``) scattered into the shared cache. Under
+        staggered completions admissions arrive one or two rows at a time;
+        this costs 1/B of the multi-row refill window, which stays the
+        bulk-admission path (cold start, bursts)."""
+        logits1, cache1 = self.model.apply(
+            params, text1, self.cache_dtype, method=DALLE.serve_prefill_row)
+        cache = dict(state["cache"])
+        for name, small in cache1.items():
+            big = cache[name]
+            kv = jax.lax.dynamic_update_slice(big.kv, small.kv, (row, 0, 0))
+            if big.scale is not None:
+                sc = jax.lax.dynamic_update_slice(big.scale, small.scale,
+                                                  (row, 0, 0))
+                cache[name] = big.replace(kv=kv, scale=sc)
+            else:
+                cache[name] = big.replace(kv=kv)
+        key1 = jax.random.PRNGKey(seed)
+        return {
+            "cache": cache,
+            "logits": jax.lax.dynamic_update_slice(
+                state["logits"], logits1.astype(state["logits"].dtype),
+                (row, 0)),
+            "cur_key": jax.lax.dynamic_update_slice(
+                state["cur_key"], key1[None], (row, 0)),
+            "orig_key": jax.lax.dynamic_update_slice(
+                state["orig_key"], key1[None], (row, 0)),
+            "t_idx": state["t_idx"].at[row].set(0),
+            "n_row": state["n_row"].at[row].set(n_tok),
+            "active": state["active"].at[row].set(True),
+        }
+
+    def _step(self, params, state):
+        n_steps = self.n_steps
+        logits, t_idx, active = (state["logits"], state["t_idx"],
+                                 state["active"])
+        n_row = state["n_row"]
+        j = jnp.minimum(t_idx, n_row - 1)
+        final = j == n_row - 1
+
+        # per-row key discipline == the sequential split chain: tokens
+        # 0..image_seq_len-2 consume one split each; only the FULL
+        # sequence's last token uses fold_in(orig_key, n_steps) without
+        # consuming a split. A partial-length row's final token therefore
+        # still comes from the split chain — its tokens are exactly the
+        # first n of the full generation.
+        sp = jax.vmap(jax.random.split)(state["cur_key"])    # (B, 2, 2)
+        new_key, sub = sp[:, 0], sp[:, 1]
+        fin_key = jax.vmap(
+            lambda k: jax.random.fold_in(k, n_steps))(state["orig_key"])
+        uses_fold = final & (n_row == n_steps)
+        sample_key = jnp.where(uses_fold[:, None], fin_key, sub)
+
+        tok = gumbel_sample_rows(sample_key,
+                                 logits[:, self.num_text_tokens:],
+                                 thres=self.filter_thres,
+                                 temperature=self.temperature,
+                                 approx=self.topk_approx)
+
+        decode_rows = active & ~final
+        offsets = jnp.where(decode_rows, self.prefix_len + j, self.park)
+        new_logits, cache = self.model.apply(
+            params, tok, j, offsets, state["cache"], self.use_kernel,
+            method=DALLE.serve_decode)
+        finished = active & final
+        state = {
+            "cache": cache,
+            "logits": jnp.where(decode_rows[:, None], new_logits, logits),
+            "cur_key": jnp.where(uses_fold[:, None], state["cur_key"],
+                                 new_key),
+            "orig_key": state["orig_key"],
+            "t_idx": jnp.where(active, t_idx + 1, t_idx),
+            "n_row": n_row,
+            "active": decode_rows,
+        }
+        return tok, finished, state
+
+    def _multi_step(self, params, state):
+        """steps_per_sync × _step in one program; (K, B) tokens/finished."""
+        if self.steps_per_sync == 1:
+            tok, finished, state = self._step(params, state)
+            return tok[None], finished[None], state
+
+        def body(carry, _):
+            tok, finished, carry = self._step(params, carry)
+            return carry, (tok, finished)
+
+        state, (toks, fins) = jax.lax.scan(body, state, None,
+                                           length=self.steps_per_sync)
+        return toks, fins, state
+
+    # -- host loop ---------------------------------------------------------
+    def _pad_text(self, text: np.ndarray) -> np.ndarray:
+        out = np.zeros((self.text_seq_len,), np.int32)
+        n = min(len(text), self.text_seq_len)
+        out[:n] = text[:n]
+        return out
+
+    def _n_tokens(self, req: Request) -> int:
+        if req.max_tokens is None:
+            return self.n_steps
+        return int(np.clip(req.max_tokens, 1, self.n_steps))
+
+    def run(self, queue: RequestQueue, *, max_steps: Optional[int] = None,
+            poll_s: float = 0.02,
+            on_complete=None) -> List[CompletedRequest]:
+        """Serve until the queue is drained (closed + empty + nothing in
+        flight). Producers may keep submitting from other threads while
+        this runs. Returns completions in completion order.
+
+        A long-lived deployment (queue held open indefinitely) should pass
+        ``on_complete``: each CompletedRequest is handed to it the moment
+        its last token lands and is NOT accumulated — the return value is
+        then an empty list and memory stays O(slots) for the life of the
+        loop. Without it, every completion (including its full token array)
+        is retained until drain.
+
+        ``max_steps`` is a harness bound (bench/smoke), not a graceful
+        drain: requests still mid-decode when it trips are abandoned —
+        already consumed from the queue, never completed. Their ids are
+        recorded in ``stats.aborted_in_flight`` so the loss is visible."""
+        B = self.slots
+        sched = SlotScheduler(B)
+        state = self._init_state()
+        buffers: Dict[int, List[int]] = {}
+        completed: List[CompletedRequest] = []
+        self.stats = EngineStats()
+
+        while not (queue.drained and not sched.any_active):
+            if max_steps is not None and self.stats.steps >= max_steps:
+                break
+
+            # admission: fill every free slot the queue can cover, FIFO
+            pre_q = queue.qsize()
+            free = sched.free_slots()
+            admitted = 0
+            if free:
+                reqs = queue.take(len(free))
+                admitted = len(reqs)
+                if reqs:
+                    pairs = sched.admit(reqs)
+                    now = time.perf_counter()
+                    for slot, req in pairs:
+                        req.admitted_at = now
+                        buffers[slot] = []
+                    if 2 * len(pairs) >= B:
+                        # bulk admission: one multi-row refill window
+                        texts = np.zeros((B, self.text_seq_len), np.int32)
+                        seeds = np.zeros((B,), np.int32)
+                        n_rows = np.full((B,), self.n_steps, np.int32)
+                        mask = np.zeros((B,), bool)
+                        for slot, req in pairs:
+                            texts[slot] = self._pad_text(req.text)
+                            seeds[slot] = req.seed
+                            n_rows[slot] = self._n_tokens(req)
+                            mask[slot] = True
+                        state = self._refill_fn(self.params, state, texts,
+                                                seeds, n_rows, mask)
+                        self.stats.refills += 1
+                    else:
+                        # trickle admission (staggered completions): per-row
+                        # scatter-prefill, 1/B the window's compute
+                        for slot, req in pairs:
+                            state = self._refill_row_fn(
+                                self.params, state,
+                                self._pad_text(req.text)[None],
+                                np.int32(req.seed),
+                                np.int32(self._n_tokens(req)),
+                                np.int32(slot))
+                            self.stats.refills += 1
+            # work-conservation sample: requests that were already queued
+            # at the take instant and still went unplaced must leave every
+            # slot busy, so occupancy is sampled exactly then (an idle slot
+            # here is a real violation, not tautologically 1.0). A request
+            # landing after the take is admitted next iteration and is
+            # deliberately excluded — arrival-bound, not an idle-slot bug.
+            backlog = (pre_q - admitted) > 0
+            gauge_set("serve.queue_depth", float(queue.qsize()))
+            gauge_set("serve.slot_occupancy", sched.occupancy)
+
+            if not sched.any_active:
+                if queue.drained:
+                    break
+                queue.wait_nonempty(timeout=poll_s)
+                continue
+
+            if backlog:
+                self.stats.sample_occupancy(sched.occupancy)
+
+            toks, fins, state = self._step_fn(self.params, state)
+            toks = np.asarray(toks)               # (K, B)
+            fins = np.asarray(fins)
+            now = time.perf_counter()
+            for k in range(toks.shape[0]):
+                active = sched.active_slots()
+                if not active:
+                    break
+                for slot in active:
+                    req = sched.request_at(slot)
+                    if req.first_token_at is None:
+                        req.first_token_at = now
+                    buffers[slot].append(int(toks[k, slot]))
+                counter_add("serve.tokens_emitted_total",
+                            float(len(active)))
+                for slot in active:
+                    if not fins[k, slot]:
+                        continue
+                    req = sched.complete(slot)
+                    cr = CompletedRequest(
+                        request_id=req.request_id,
+                        tokens=np.asarray(buffers.pop(slot), np.int32),
+                        seed=req.seed,
+                        submitted_at=req.submitted_at,
+                        admitted_at=req.admitted_at,
+                        first_token_at=req.first_token_at,
+                        completed_at=now)
+                    if on_complete is not None:
+                        on_complete(cr)
+                    else:
+                        completed.append(cr)
+                    # retrospective spans: requests overlap, so the
+                    # stack-based span() contract cannot hold — see
+                    # obs.record_span
+                    record_span("serve/request", req.admitted_at,
+                                now - req.admitted_at,
+                                request_id=req.request_id,
+                                tokens=int(cr.tokens.shape[0]))
+                    record_span("serve/request_ttft", req.submitted_at,
+                                cr.ttft_s, request_id=req.request_id)
+                    counter_add("serve.requests_completed_total", 1.0)
+                    gauge_set("serve.request_latency_s", cr.latency_s)
+                self.stats.steps += 1
+        self.stats.aborted_in_flight = [
+            sched.request_at(s).request_id for s in sched.active_slots()]
+        return completed

@@ -1,0 +1,141 @@
+"""Host-side request queue for the continuous-batching decode engine.
+
+A thread-safe FIFO of generation requests. Producers (an RPC handler, the
+offered-load bench) ``submit`` from any thread; the engine loop ``take``s up
+to its free-slot count per iteration and blocks on ``wait_nonempty`` only
+when every slot is idle. ``close()`` marks the end of the workload: the
+engine drains what is queued plus what is in flight, then returns.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request: a text prompt (token ids, 0-padded to
+    text_seq_len) and the per-request PRNG seed. ``seed`` defines the
+    request's whole sampling stream — the engine's output for this request
+    is bit-identical to ``generate_images_tokens(text[None],
+    jax.random.PRNGKey(seed))``."""
+    request_id: int
+    text: np.ndarray            # (text_seq_len,) int32
+    seed: int
+    # decode only the first ``max_tokens`` of the image grid (None = the
+    # full image_seq_len). Partial-grid serving — previews, progressive
+    # decode, top-rows-for-inpainting — is what makes per-request service
+    # demand ragged; the engine's tokens for a partial request equal the
+    # FIRST max_tokens of the full single-request generation.
+    max_tokens: Optional[int] = None
+    submitted_at: float = dataclasses.field(
+        default_factory=time.perf_counter)
+    # stamped by the engine
+    admitted_at: Optional[float] = None
+    first_token_at: Optional[float] = None
+
+
+@dataclasses.dataclass
+class CompletedRequest:
+    request_id: int
+    tokens: np.ndarray          # (image_seq_len,) int32
+    seed: int
+    submitted_at: float
+    admitted_at: float
+    first_token_at: float
+    completed_at: float
+
+    @property
+    def ttft_s(self) -> float:
+        """Submission → first sampled token (queue wait included — the
+        number a caller actually experiences)."""
+        return self.first_token_at - self.submitted_at
+
+    @property
+    def latency_s(self) -> float:
+        return self.completed_at - self.submitted_at
+
+
+class RequestQueue:
+    """FIFO with close semantics. All methods are thread-safe."""
+
+    def __init__(self):
+        self._q: deque = deque()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._closed = False
+        self._next_id = 0
+
+    def submit(self, text, seed: int,
+               request_id: Optional[int] = None,
+               max_tokens: Optional[int] = None) -> Request:
+        """Enqueue a request; returns it (with its assigned id). An explicit
+        ``request_id`` must be fresh: ids at or below the high-water mark of
+        previously issued ids are rejected rather than tracked individually,
+        so a duplicate can never silently alias another request's results
+        (consumers key completions, spans and bench lookups by id)."""
+        text = np.asarray(text, np.int32)
+        assert text.ndim == 1, f"one prompt per request, got {text.shape}"
+        if max_tokens is not None and max_tokens < 1:
+            # the engine clamps to [1, image_seq_len]; 0/negative would
+            # silently come back as a 1-token generation
+            raise ValueError(f"max_tokens must be >= 1, got {max_tokens}")
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("queue is closed")
+            if request_id is None:
+                request_id = self._next_id
+            elif request_id < self._next_id:
+                raise ValueError(
+                    f"request_id {request_id} is not fresh (ids below "
+                    f"{self._next_id} may already be in flight); omit "
+                    "request_id or pass one above the high-water mark")
+            self._next_id = request_id + 1
+            req = Request(request_id=request_id, text=text, seed=seed,
+                          max_tokens=max_tokens)
+            self._q.append(req)
+            self._cond.notify_all()
+        return req
+
+    def take(self, max_n: int) -> List[Request]:
+        """Dequeue up to ``max_n`` requests in FIFO order (non-blocking)."""
+        out: List[Request] = []
+        with self._lock:
+            while self._q and len(out) < max_n:
+                out.append(self._q.popleft())
+        return out
+
+    def wait_nonempty(self, timeout: Optional[float] = None) -> bool:
+        """Block until a request is queued or the queue is closed. Returns
+        True when a request is available."""
+        with self._cond:
+            self._cond.wait_for(lambda: self._q or self._closed,
+                                timeout=timeout)
+            return bool(self._q)
+
+    def close(self) -> None:
+        """No further submissions; the engine drains and returns."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def qsize(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    @property
+    def drained(self) -> bool:
+        """Closed AND empty — nothing left to admit."""
+        with self._lock:
+            return self._closed and not self._q

@@ -1,0 +1,84 @@
+"""Slot scheduler: host-side bookkeeping for the fixed device decode batch.
+
+The device state is B anonymous slots; this maps slots ↔ requests and
+enforces the two scheduling invariants the engine tests pin down
+(tests/test_serve.py):
+
+  * work-conserving — after every admission pass, either no slot is free or
+    the queue is empty (no idle slot while the queue holds work);
+  * FIFO fairness — requests are admitted strictly in submission order (the
+    queue pops FIFO and ``admit`` pairs them with free slots in order), so
+    no request can be overtaken while waiting.
+
+Pure Python, no jax: the engine owns the device arrays, this owns the
+mapping.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
+
+from .queue import Request
+
+
+class SlotScheduler:
+    def __init__(self, n_slots: int):
+        assert n_slots >= 1
+        self.n_slots = n_slots
+        self._slots: List[Optional[Request]] = [None] * n_slots
+        self.admitted_total = 0
+        self.completed_total = 0
+        # request ids in admit order, for FIFO-fairness auditing; bounded so
+        # a long-lived engine stays O(1) — the most recent window is all a
+        # fairness check needs
+        self._admission_order: Deque[int] = collections.deque(maxlen=10_000)
+
+    # -- queries -----------------------------------------------------------
+    def free_slots(self) -> List[int]:
+        return [i for i, r in enumerate(self._slots) if r is None]
+
+    def active_slots(self) -> List[int]:
+        return [i for i, r in enumerate(self._slots) if r is not None]
+
+    def request_at(self, slot: int) -> Optional[Request]:
+        return self._slots[slot]
+
+    @property
+    def any_active(self) -> bool:
+        return any(r is not None for r in self._slots)
+
+    @property
+    def occupancy(self) -> float:
+        """Fraction of slots holding an in-flight request."""
+        return len(self.active_slots()) / self.n_slots
+
+    @property
+    def admission_order(self) -> List[int]:
+        return list(self._admission_order)
+
+    # -- transitions -------------------------------------------------------
+    def admit(self, requests: Sequence[Request]) -> List[Tuple[int, Request]]:
+        """Pair requests (already FIFO from the queue) with free slots in
+        slot order. Raises if handed more requests than free slots — the
+        engine must size its ``take`` by ``free_slots()``."""
+        free = self.free_slots()
+        if len(requests) > len(free):
+            raise ValueError(
+                f"admit({len(requests)} requests) with only {len(free)} "
+                "free slots")
+        pairs = []
+        for slot, req in zip(free, requests):
+            self._slots[slot] = req
+            self._admission_order.append(req.request_id)
+            self.admitted_total += 1
+            pairs.append((slot, req))
+        return pairs
+
+    def complete(self, slot: int) -> Request:
+        req = self._slots[slot]
+        if req is None:
+            raise ValueError(f"slot {slot} is not occupied")
+        self._slots[slot] = None
+        self.completed_total += 1
+        return req

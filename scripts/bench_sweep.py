@@ -85,6 +85,13 @@ SMALL = dict(num_text_tokens=10000, text_seq_len=256, dim=512, depth=12,
 MEDIUM = dict(num_text_tokens=49408, text_seq_len=256, dim=1024, depth=24,
               heads=16, dim_head=64, image_size=128, image_vocab_size=8192,
               image_fmap_size=16, attn_softmax_f32=False)
+# the ROADMAP item-1 mid-size shape: 12 heads × 96d (h·d = 1152) sits
+# between the measured small (h·d=512, fused +17%) and medium (h·d=1024,
+# fused +22%) tier points; _bwd_bytes(513, 1152) ≈ 23.8M fits the raised
+# 30M budget, so the fused merged-backward path engages without a new tier
+MID12H96 = dict(num_text_tokens=10000, text_seq_len=256, dim=1152, depth=12,
+                heads=12, dim_head=96, image_size=128, image_vocab_size=8192,
+                image_fmap_size=16, attn_softmax_f32=False)
 
 
 def main():
@@ -105,6 +112,19 @@ def main():
             run("small_fused_noremat_scan8_chunk256_b64",
                 dict(SMALL, use_pallas="fused", use_remat=False,
                      loss_chunk=256), 64, steps=16, scan_k=8)
+        elif w == "small12h96":
+            # ROADMAP item 1: does the 12H/96d mid-size shape want its own
+            # fused tier entry? Run on-chip and compare: a tier entry is
+            # added ONLY where fused beats the dense recipe here (the
+            # flagship d=128 precedent: measured parity → dense stays)
+            run("mid12h96_scan8_chunk256_b32", dict(MID12H96, loss_chunk=256),
+                32, steps=16, scan_k=8)
+            run("mid12h96_fused_scan8_chunk256_b32",
+                dict(MID12H96, use_pallas="fused", loss_chunk=256), 32,
+                steps=16, scan_k=8)
+            run("mid12h96_fused_noremat_scan8_chunk256_b32",
+                dict(MID12H96, use_pallas="fused", use_remat=False,
+                     loss_chunk=256), 32, steps=16, scan_k=8)
         elif w == "small128":
             run("small_b128", SMALL, 128)
         elif w == "small_opt":

@@ -57,6 +57,21 @@ def run_obs_smoke_stage() -> int:
     return subprocess.run(cmd, cwd=ROOT, env=env).returncode
 
 
+def run_serve_smoke_stage() -> int:
+    """The continuous-batching serve stage: a short offered-load run that
+    must keep slot occupancy ≥ 90% while the queue is nonempty, produce
+    token-exact outputs vs the sequential single-request reference for
+    every request, and leave valid per-request TTFT/latency spans
+    (scripts/serve_smoke.py; the workflow's matching step is skipped
+    below). Artifacts land in ./serve_artifacts — the dir ci.yml
+    uploads."""
+    cmd = [sys.executable, os.path.join(ROOT, "scripts", "serve_smoke.py"),
+           "--outdir", os.path.join(ROOT, "serve_artifacts")]
+    print(f"== [serve] {' '.join(cmd[1:])}")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(cmd, cwd=ROOT, env=env).returncode
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--changed-only", action="store_true",
@@ -69,6 +84,10 @@ def main():
 
     if run_obs_smoke_stage() != 0:
         print("ci_local: FAILED (observability smoke) — test tiers not run")
+        return 1
+
+    if run_serve_smoke_stage() != 0:
+        print("ci_local: FAILED (serve smoke) — test tiers not run")
         return 1
 
     wf = yaml.safe_load(open(os.path.join(ROOT, ".github/workflows/ci.yml")))
@@ -85,6 +104,9 @@ def main():
             continue
         if "scripts/obs_smoke.py" in cmd:
             print(f"-- [skip] {name}: already run in the obs smoke stage")
+            continue
+        if "scripts/serve_smoke.py" in cmd:
+            print(f"-- [skip] {name}: already run in the serve smoke stage")
             continue
         if any(m in cmd for m in NETWORK_MARKERS):
             # the editable-install smoke is half network, half local: keep

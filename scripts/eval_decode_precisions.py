@@ -29,9 +29,12 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def train_rainbow(args):
+def train_rainbow(args, dataset=None):
     """dVAE + DALLE on synthetic shapes; returns (dalle_model, params, text,
-    codes, train_idx)."""
+    codes, train_idx). ``dataset`` overrides the corpus (same
+    __len__/__getitem__→Sample contract as ShapesDataset) — e.g. the
+    textured proxy eval_speculative uses to measure acceptance on flatter
+    token statistics."""
     import numpy as np
     from dalle_tpu.config import (DVAEConfig, DalleConfig, OptimConfig,
                                   TrainConfig)
@@ -42,7 +45,8 @@ def train_rainbow(args):
     from dalle_tpu.train.trainer_vae import VAETrainer
 
     rng = np.random.RandomState(args.seed)
-    ds = ShapesDataset(image_size=args.image_size)
+    ds = dataset if dataset is not None else ShapesDataset(
+        image_size=args.image_size)
     vcfg = DVAEConfig(image_size=args.image_size, num_tokens=args.num_tokens,
                       codebook_dim=64, num_layers=2, hidden_dim=32,
                       num_resnet_blocks=1)

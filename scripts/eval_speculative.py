@@ -33,6 +33,47 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from eval_decode_precisions import train_rainbow  # noqa: E402
 
 
+class TexturedShapes:
+    """Natural-image-like proxy corpus: the rainbow shapes with heavy
+    per-pixel noise texture and a smooth random background gradient.
+
+    The flat-color shapes corpus gives the dVAE long runs of IDENTICAL
+    codebook tokens — the best case for the 'row'/'repeat' drafts. Real
+    photos have textured, spatially-decorrelated token fields; this proxy
+    reproduces that property (adjacent grid cells encode to different
+    codes) while keeping the caption→image mapping learnable, so the
+    measured acceptance bounds what a natural-image dVAE would give rather
+    than inheriting the shapes corpus's optimism (ROADMAP open item 2).
+    """
+
+    def __init__(self, base, noise: float = 40.0, seed: int = 0):
+        self.base = base
+        self.noise = noise
+        self.seed = seed
+        self.image_size = base.image_size
+
+    def __len__(self):
+        return len(self.base)
+
+    def __getitem__(self, i):
+        import numpy as np
+        s = self.base[i]
+        rng = np.random.RandomState(self.seed * 77003 + i)
+        img = s.image.astype(np.float32)
+        size = img.shape[0]
+        # smooth random background gradient where the render is black
+        gx, gy = np.meshgrid(np.linspace(0, 1, size), np.linspace(0, 1, size))
+        base_col = rng.uniform(20, 120, (3,))
+        grad_col = rng.uniform(-60, 60, (3,))
+        bg = base_col[None, None] + gx[..., None] * grad_col[None, None]
+        dark = (img.sum(axis=-1, keepdims=True) < 30).astype(np.float32)
+        img = img * (1 - dark) + bg * dark
+        # per-pixel texture noise over everything
+        img = img + rng.uniform(-self.noise, self.noise, img.shape)
+        img = np.clip(img, 0, 255).astype(np.uint8)
+        return type(s)(img, s.caption, s.label)
+
+
 def _p50(fn, reps):
     times = []
     for _ in range(reps):
@@ -63,6 +104,13 @@ def main(argv=None):
     ap.add_argument("--temperature", type=float, default=0.5)
     ap.add_argument("--pad_text_to", type=int, default=64)
     ap.add_argument("--gammas", type=str, default="2,4,7")
+    ap.add_argument("--corpus", type=str, default="rainbow",
+                    choices=("rainbow", "textured"),
+                    help="'textured' = the natural-image-like proxy "
+                         "(noise-textured shapes over gradient "
+                         "backgrounds: spatially decorrelated dVAE codes; "
+                         "ROADMAP open item 2)")
+    ap.add_argument("--texture_noise", type=float, default=40.0)
     ap.add_argument("--outdir", type=str, default="/tmp/eval_spec")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--small", action="store_true")
@@ -80,7 +128,12 @@ def main(argv=None):
     from dalle_tpu.models.dalle import DALLE
     from dalle_tpu.train.train_state import cast_floating
 
-    model, params, text, codes, tr_idx = train_rainbow(args)
+    dataset = None
+    if args.corpus == "textured":
+        from dalle_tpu.data.synthetic import ShapesDataset
+        dataset = TexturedShapes(ShapesDataset(image_size=args.image_size),
+                                 noise=args.texture_noise, seed=args.seed)
+    model, params, text, codes, tr_idx = train_rainbow(args, dataset=dataset)
     n_img = codes.shape[1]
     sel = tr_idx[: args.eval_b]
     # tile up to the eval batch if the dataset is smaller

@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""Offered-load sweep: static batching vs the continuous-batching engine.
+
+Both servers consume the SAME Poisson arrival trace through the same
+``RequestQueue``:
+
+  * **static** — the pre-serve pattern this PR replaces: grab what is
+    queued (up to B), pad the batch to B, run the whole
+    ``generate_images_tokens`` program end-to-end, drain, repeat. Arrivals
+    during a batch wait for the full drain; a partial grab burns empty
+    slots for the entire batch.
+  * **continuous** — ``dalle_tpu.serve.DecodeEngine``: B shared-cache
+    slots, iteration-level refill, per-row lengths.
+
+Reported per mode: completed requests/s, decoded tokens/s, request latency
+p50/p95, TTFT p50/p95, and slot occupancy. ``--load`` scales the offered
+arrival rate relative to measured static capacity (load > 1 = saturating:
+the queue is essentially never empty).
+
+CPU mesh (the sandbox's referee): JAX_PLATFORMS=cpu python
+scripts/serve_bench.py --small. On-chip: drop --small, raise --slots.
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def percentile(xs, p):
+    xs = sorted(xs)
+    return xs[min(int(len(xs) * p), len(xs) - 1)] if xs else None
+
+
+def run_static(gen, params, cfg, queue, n_requests, slots):
+    """Greedy static batching over the shared queue — the pre-serve
+    pattern: take what is queued (up to B), pad to B, run the whole batch
+    end-to-end, drain, repeat. Completions are batch-synchronized; a
+    partial grab burns its empty slots for the full batch."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    done = []
+    batch_i = 0
+    while len(done) < n_requests:
+        reqs = queue.take(slots)
+        if not reqs:
+            if queue.drained:
+                break
+            queue.wait_nonempty(timeout=0.02)
+            continue
+        texts = np.zeros((slots, cfg.text_seq_len), np.int32)
+        for i, r in enumerate(reqs):
+            texts[i, :len(r.text)] = r.text[:cfg.text_seq_len]
+        ids = np.asarray(gen(params, jnp.asarray(texts),
+                             jax.random.PRNGKey(batch_i)))
+        batch_i += 1
+        now = time.perf_counter()
+        for i, r in enumerate(reqs):
+            done.append({"request_id": r.request_id,
+                         "tokens": ids[i],
+                         "latency_s": now - r.submitted_at,
+                         "ttft_s": now - r.submitted_at})
+    return done
+
+
+def bench(args):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dalle_tpu.config import DalleConfig
+    from dalle_tpu.models.dalle import DALLE, init_dalle
+    from dalle_tpu.serve import DecodeEngine, RequestQueue
+
+    if args.small:
+        cfg = DalleConfig(num_text_tokens=64, text_seq_len=8, dim=64,
+                          depth=2, heads=2, dim_head=32, image_size=16,
+                          image_vocab_size=32, image_fmap_size=4)
+    else:
+        # large enough that per-step COMPUTE dominates per-dispatch host
+        # overhead on the 1-core CPU mesh (~8.4 ms engine step vs ~8.7 ms
+        # static per-step-equivalent at this shape) — the regime real
+        # accelerators are always in
+        cfg = DalleConfig(num_text_tokens=1000, text_seq_len=32, dim=256,
+                          depth=4, heads=4, dim_head=64, image_size=32,
+                          image_vocab_size=512, image_fmap_size=8)
+    model, params = init_dalle(cfg, jax.random.PRNGKey(0), batch=2)
+    rng = np.random.RandomState(args.seed)
+    texts = [rng.randint(1, cfg.num_text_tokens,
+                         (cfg.text_seq_len,)).astype(np.int32)
+             for _ in range(args.n_requests)]
+    # ragged service demand (the serving-realistic default): partial-grid
+    # requests decode U[n/4, n] image tokens (previews / progressive
+    # decode / top-rows-for-inpainting). The static path has no per-row
+    # early exit — every row decodes the full grid in lockstep and
+    # finished rows burn forward passes; the engine retires each row at
+    # its own length and refills the slot.
+    if args.fixed_lengths:
+        lengths = [cfg.image_seq_len] * args.n_requests
+    else:
+        lengths = [int(rng.randint(cfg.image_seq_len // 4,
+                                   cfg.image_seq_len + 1))
+                   for _ in range(args.n_requests)]
+
+    @jax.jit
+    def gen(p, t, k):
+        return model.apply(p, t, k, method="generate_images_tokens")
+
+    eng = DecodeEngine(model, params, slots=args.slots,
+                       steps_per_sync=args.steps_per_sync)
+
+    # warm BOTH paths (compiles out of the timed runs), then calibrate
+    # static capacity from a warm full batch
+    dummy = jnp.asarray(np.stack([t for t in texts[:args.slots]]))
+    np.asarray(gen(params, dummy, jax.random.PRNGKey(0)))
+    # slots+2 requests with one short row: warms the bulk refill window,
+    # the trickle (per-row scatter-prefill) path AND the step program
+    warm_q = RequestQueue()
+    for i in range(args.slots + 2):
+        warm_q.submit(texts[i % args.n_requests], seed=i, request_id=i,
+                      max_tokens=cfg.image_seq_len // 4 if i == 0 else None)
+    warm_q.close()
+    eng.run(warm_q)
+    t_batch = float("inf")
+    for r in (1, 2):                           # min-of-2: 1-core box noise
+        t0 = time.perf_counter()
+        np.asarray(gen(params, dummy, jax.random.PRNGKey(r)))
+        t_batch = min(t_batch, time.perf_counter() - t0)
+    capacity = args.slots / t_batch            # req/s at full static batches
+    inter_arrival = 1.0 / (capacity * args.load)
+    print(json.dumps({"calibration": {"t_batch_s": round(t_batch, 3),
+                                      "static_capacity_rps": round(capacity, 3),
+                                      "inter_arrival_s": round(inter_arrival, 4)}}),
+          flush=True)
+
+    # shared arrival trace (relative offsets, replayed per mode)
+    gaps = rng.exponential(inter_arrival, size=args.n_requests)
+    gaps[0] = 0.0
+
+    def producer(queue):
+        for i, gap in enumerate(gaps):
+            time.sleep(gap)
+            queue.submit(texts[i], seed=args.seed_base + i, request_id=i,
+                         max_tokens=lengths[i])
+        queue.close()
+
+    results = {}
+    for mode in ("static", "continuous"):
+        q = RequestQueue()
+        th = threading.Thread(target=producer, args=(q,))
+        t0 = time.perf_counter()
+        th.start()
+        if mode == "static":
+            done = run_static(gen, params, cfg, q, args.n_requests,
+                              args.slots)
+            occupancy = None
+        else:
+            completed = eng.run(q)
+            done = [{"request_id": c.request_id, "tokens": c.tokens,
+                     "latency_s": c.latency_s, "ttft_s": c.ttft_s}
+                    for c in completed]
+            occupancy = round(eng.stats.occupancy_while_queued, 4)
+        wall = time.perf_counter() - t0
+        th.join()
+        lat = [d["latency_s"] for d in done]
+        ttft = [d["ttft_s"] for d in done]
+        n_tok = sum(lengths[d["request_id"]] for d in done)
+        row = {"mode": mode, "slots": args.slots,
+               "requests": len(done), "wall_s": round(wall, 3),
+               "completed_per_s": round(len(done) / wall, 3),
+               "tok_per_s": round(n_tok / wall, 1),
+               "p50_latency_s": round(percentile(lat, 0.5), 4),
+               "p95_latency_s": round(percentile(lat, 0.95), 4),
+               "p50_ttft_s": round(percentile(ttft, 0.5), 4),
+               "p95_ttft_s": round(percentile(ttft, 0.95), 4),
+               "slot_occupancy": occupancy}
+        results[mode] = row
+        print(json.dumps(row), flush=True)
+
+    speedup = (results["continuous"]["completed_per_s"]
+               / results["static"]["completed_per_s"])
+    verdict = {"metric": "serve_bench_offered_load", "load": args.load,
+               "continuous_over_static_rps": round(speedup, 3),
+               "continuous_wins": speedup > 1.0}
+    print(json.dumps(verdict), flush=True)
+    return 0 if (not args.assert_win or speedup > 1.0) else 1
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--n_requests", type=int, default=64)
+    ap.add_argument("--load", type=float, default=1.15,
+                    help="offered arrival rate / measured static capacity "
+                         "(≥1 = saturating: the queue stays nonempty)")
+    ap.add_argument("--steps_per_sync", type=int, default=8,
+                    help="engine device steps per host sync (amortizes "
+                         "dispatch overhead; admission granularity)")
+    ap.add_argument("--fixed_lengths", action="store_true",
+                    help="every request decodes the full grid (parity "
+                         "regime: static scan vs engine, no ragged win)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--seed_base", type=int, default=5000,
+                    help="per-request sampling seeds = seed_base + i")
+    ap.add_argument("--small", action="store_true",
+                    help="tiny config for the CPU mesh")
+    ap.add_argument("--assert_win", dest="assert_win", action="store_true",
+                    help="exit 1 unless continuous beats static on "
+                         "completed requests/s")
+    args = ap.parse_args(argv)
+    return bench(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -93,6 +93,79 @@ def test_supported_gate():
 
 
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16, jnp.int8])
+def test_window_kernel_matches_dense_ragged(dtype):
+    """Windowed per-row-length kernel ≡ dense cached_attend_window across
+    ragged starts — including a row at 0 (fresh refill prefill) and a row
+    whose window overshoots the final cache slot (boundary clamp: positions
+    beyond start are masked, never gathered)."""
+    from dalle_tpu.ops.decode_attention import decode_attend_window_kernel
+    from dalle_tpu.ops.attention import cached_attend_window
+    rng = np.random.RandomState(0)
+    b, h, S, d, w = 4, 4, 256, 64, 5
+    cache = _cache(rng, b, h, S, d, dtype)
+    q = jnp.asarray(rng.standard_normal((b, h, w, d)), jnp.float32)
+    starts = jnp.asarray([0, 100, 197, S - 2], jnp.int32)
+    dense = cached_attend_window(q, cache, starts, use_kernel=False)
+    kern = decode_attend_window_kernel(q, cache, starts, interpret=True)
+    np.testing.assert_allclose(np.asarray(kern), np.asarray(dense),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_window_kernel_w1_matches_single_token():
+    """w=1 degenerates to the single-token decode shape: both kernels and
+    the dense path agree (starts = length-1 ↔ cached_attend's length)."""
+    from dalle_tpu.ops.decode_attention import decode_attend_window_kernel
+    rng = np.random.RandomState(1)
+    b, h, S, d = 2, 2, 128, 64
+    cache = _cache(rng, b, h, S, d, jnp.float32)
+    q = jnp.asarray(rng.standard_normal((b, h, 1, d)), jnp.float32)
+    length = jnp.int32(90)
+    dense = cached_attend(q, cache, length, use_kernel=False)
+    kern = decode_attend_window_kernel(
+        q, cache, jnp.full((b,), length - 1, jnp.int32), interpret=True)
+    np.testing.assert_allclose(np.asarray(kern), np.asarray(dense),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_cached_attend_window_kernel_flag_roundtrip():
+    """use_kernel=True routes cached_attend_window through the windowed
+    kernel (interpret on CPU) and agrees with the dense default; the
+    auto-gate (use_kernel=None) stays dense off-TPU."""
+    from dalle_tpu.ops.attention import cached_attend_window
+    rng = np.random.RandomState(2)
+    b, h, S, d, w = 2, 2, 128, 64, 3
+    cache = _cache(rng, b, h, S, d, jnp.int8)
+    q = jnp.asarray(rng.standard_normal((b, h, w, d)), jnp.float32)
+    starts = jnp.asarray([5, 77], jnp.int32)
+    dense = cached_attend_window(q, cache, starts)          # auto → dense
+    kern = cached_attend_window(q, cache, starts, use_kernel=True)
+    np.testing.assert_allclose(np.asarray(kern), np.asarray(dense),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_window_supported_gate():
+    """Runtime-shape gate: lane-tiled shapes pass; untiled S / huge windows
+    / stable softmax / VMEM-busting caches fall back to dense (a shape the
+    gate rejects must never reach a failing Mosaic compile)."""
+    from dalle_tpu.ops.decode_attention import decode_window_kernel_supported
+    ok = KVCache.init(2, 2, 256, 64)
+    q = jnp.zeros((2, 2, 5, 64))
+    assert decode_window_kernel_supported(q, ok, stable=False)
+    assert not decode_window_kernel_supported(q, ok, stable=True)
+    assert not decode_window_kernel_supported(
+        q, KVCache.init(2, 2, 200, 64), stable=False)   # S not lane-tiled
+    assert not decode_window_kernel_supported(
+        jnp.zeros((2, 2, 5, 16)), KVCache.init(2, 2, 256, 16),
+        stable=False)                                   # h*d not lane-tiled
+    assert not decode_window_kernel_supported(
+        jnp.zeros((2, 2, 100, 64)), ok, stable=False)   # window too wide
+    # merged K+V block beyond the per-program VMEM budget
+    big = KVCache.init(2, 14, 2560, 128, jnp.bfloat16)
+    assert not decode_window_kernel_supported(
+        jnp.zeros((2, 14, 5, 128), jnp.bfloat16), big, stable=False)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16, jnp.int8])
 def test_chunked_kernel_matches_dense(dtype):
     """Chunked long-cache variant (online softmax across S-blocks +
     tail-skipping clamped index maps) ≡ dense, at a length that leaves

@@ -435,6 +435,98 @@ def test_vmem_rule_flags_rogue_literal_ceiling():
 
 
 # ---------------------------------------------------------------------------
+# scatter-minormost / scatter-missing-hints
+# ---------------------------------------------------------------------------
+
+def test_scatter_minormost_flags_trailing_array_index():
+    src = """
+    def append_scales(scale, sc, idx):
+        return scale.at[:, :, idx].set(sc, unique_indices=True,
+                                       indices_are_sorted=True)
+    """
+    found = lint_source("scatter-minormost", src,
+                        rel_path="dalle_tpu/ops/_fixture.py")
+    assert len(found) == 1 and "minormost" in found[0].message
+    # a leading Ellipsis aligns the trailing element with the lane axis
+    ell = """
+    def poke(buf, idx):
+        return buf.at[..., idx].set(1.0, unique_indices=True,
+                                    indices_are_sorted=True)
+    """
+    assert len(lint_source("scatter-minormost", ell,
+                           rel_path="dalle_tpu/ops/_fixture.py")) == 1
+
+
+def test_scatter_minormost_clean_on_sequence_major_and_out_of_scope():
+    # trailing full slice (the append_rows shape) is the blessed layout
+    src = """
+    def append_rows(kv, rows, ab, idx):
+        return kv.at[ab, idx].set(rows, unique_indices=True,
+                                  indices_are_sorted=True)
+    def trailing_ellipsis(kv, idx):
+        return kv.at[idx, ...].set(0.0, unique_indices=True,
+                                   indices_are_sorted=True)
+    """
+    assert lint_source("scatter-minormost", src,
+                       rel_path="dalle_tpu/ops/_fixture.py") == []
+    # single index element: rank unknown, never flagged
+    one = """
+    def write(buf, idx, v):
+        return buf.at[idx].set(v, unique_indices=True,
+                               indices_are_sorted=True)
+    """
+    assert lint_source("scatter-minormost", one,
+                       rel_path="dalle_tpu/ops/_fixture.py") == []
+    # rule is scoped to ops code
+    bad = """
+    def f(scale, sc, idx):
+        return scale.at[:, :, idx].set(sc)
+    """
+    assert lint_source("scatter-minormost", bad,
+                       rel_path="dalle_tpu/train/_fixture.py") == []
+
+
+def test_scatter_missing_hints_flags_bare_array_scatter():
+    src = """
+    def append(kv, rows, ab, idx):
+        return kv.at[ab, idx].set(rows)
+    """
+    found = lint_source("scatter-missing-hints", src,
+                        rel_path="dalle_tpu/ops/_fixture.py")
+    assert len(found) == 1 and "unique_indices" in found[0].message
+    # .add scatters too
+    add = """
+    def accumulate(buf, idx, v):
+        return buf.at[:, idx].add(v)
+    """
+    assert len(lint_source("scatter-missing-hints", add,
+                           rel_path="dalle_tpu/ops/_fixture.py")) == 1
+
+
+def test_scatter_missing_hints_clean_cases():
+    src = """
+    def hinted(kv, rows, ab, idx):
+        return kv.at[ab, idx].set(rows, unique_indices=True,
+                                  indices_are_sorted=True)
+    def one_hint(kv, rows, idx):
+        return kv.at[idx].set(rows, unique_indices=True)
+    def static_single(buf):
+        return buf.at[0].set(1.0)
+    def static_negative(buf):
+        return buf.at[-1].set(1.0)
+    def static_arith(buf, v):
+        return buf.at[2 + 3, :].set(v)
+    def slices_only(buf, v):
+        return buf.at[:, 1:3].set(v)
+    def suppressed(kv, rows, idx):
+        # graftlint: disable=scatter-missing-hints
+        return kv.at[idx].set(rows)
+    """
+    assert lint_source("scatter-missing-hints", src,
+                       rel_path="dalle_tpu/ops/_fixture.py") == []
+
+
+# ---------------------------------------------------------------------------
 # untested-public-op
 # ---------------------------------------------------------------------------
 

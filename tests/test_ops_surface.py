@@ -99,6 +99,24 @@ def test_gumbel_quantize_hard_selects_codebook_rows():
     assert np.isfinite(float(out.loss))
 
 
+def test_gumbel_sample_rows_bitwise_matches_sequential():
+    """The property serving/speculative token-exactness rests on: a row
+    sampled in the (b, V) batch under its own key equals the same row
+    sampled alone as a (1, V) draw with gumbel_sample + top_k_filter."""
+    from dalle_tpu.ops.sampling import (gumbel_sample, gumbel_sample_rows,
+                                        top_k_filter)
+    rng = np.random.RandomState(7)
+    logits = jnp.asarray(rng.randn(3, 64).astype(np.float32))
+    keys = jax.vmap(jax.random.fold_in, (None, 0))(
+        jax.random.PRNGKey(5), jnp.arange(3, dtype=jnp.uint32))
+    got = gumbel_sample_rows(keys, logits, thres=0.5, temperature=0.9)
+    for r in range(3):
+        want = gumbel_sample(keys[r],
+                             top_k_filter(logits[r:r + 1], thres=0.5),
+                             temperature=0.9).astype(jnp.int32)
+        np.testing.assert_array_equal(got[r:r + 1], want)
+
+
 # ---------------------------------------------------------------------------
 # quantize_weights
 # ---------------------------------------------------------------------------
