@@ -1,0 +1,281 @@
+"""graftir entry registry: the programs whose contracts CI pins.
+
+Each entry builds a small-but-real instance of one production program — the
+four trainer steps on a multi-axis mesh (so the collective inventory sees
+dp/fsdp/tp), the autoregressive decode program, the serve engine's
+refill/decode programs, and the Pallas attention kernels (traced in
+interpret mode so the KERNEL body's primitives land in the histogram).
+
+Shapes here are contract-calibration shapes, not benchmarks: tiny enough
+that ``--check`` stays a CI-priced stage, structured enough that a refactor
+changing the program (an extra collective, a dtype upcast, a lost donation)
+changes the contract. Entry builders construct the REAL library objects
+(trainers, engine) rather than re-deriving the jitted fns — the contract
+must cover what production code actually runs.
+
+Waivers: ``# graftir: allow=<rule> -- <reason>`` in an entry's ``source``
+file applies to that entry (see analysis/ir_audit.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import tempfile
+from typing import Callable, Dict, Optional
+
+from .core import REPO_ROOT  # noqa: F401  (re-exported for the CLI)
+
+
+@dataclasses.dataclass
+class BuiltEntry:
+    fn: Callable                 # jitted (or jittable) callable
+    args: tuple
+    donated: int = 0             # donated LEAF count (0 = no donation audit)
+    mesh: object = None          # jax Mesh for collective axis naming
+    compile: bool = False        # compile for collectives/donation?
+    vmem: Optional[dict] = None  # kernel vmem estimator snapshot (PR 1)
+
+
+@dataclasses.dataclass(frozen=True)
+class EntrySpec:
+    name: str
+    source: str                  # repo-relative file whose waivers apply
+    build: Callable[[], BuiltEntry]
+
+
+ENTRIES: Dict[str, EntrySpec] = {}
+
+
+def register_entry(name: str, source: str):
+    def deco(fn):
+        assert name not in ENTRIES, name
+        ENTRIES[name] = EntrySpec(name, source, fn)
+        return fn
+    return deco
+
+
+# --------------------------------------------------------------------------
+# shared tiny configs (mirror the test-suite calibration configs)
+# --------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _mesh(dp=2, fsdp=2, tp=1):
+    from ..config import MeshConfig
+    from ..parallel.mesh import build_mesh
+    return build_mesh(MeshConfig(dp=dp, fsdp=fsdp, tp=tp))
+
+
+@functools.lru_cache(maxsize=None)
+def _ckpt_dir() -> str:
+    # one shared scratch dir per process (preflight_checkpoint=False and the
+    # entries never save, so nothing is written; per-entry mkdtemp would
+    # leak a /tmp dir on every audit run)
+    return tempfile.mkdtemp(prefix="graftir_")
+
+
+def _train_cfg(mesh_cfg, **kw):
+    from ..config import OptimConfig, PrecisionConfig, TrainConfig
+    return TrainConfig(batch_size=8, preflight_checkpoint=False,
+                       checkpoint_dir=_ckpt_dir(), mesh=mesh_cfg,
+                       precision=PrecisionConfig(compute="float32"),
+                       optim=OptimConfig(learning_rate=1e-2), **kw)
+
+
+@functools.lru_cache(maxsize=None)
+def _dalle_model():
+    import jax
+    from ..config import DalleConfig
+    from ..models.dalle import init_dalle
+    cfg = DalleConfig(num_text_tokens=32, text_seq_len=8, dim=32, depth=2,
+                      heads=2, dim_head=16, image_size=16,
+                      image_vocab_size=32, image_fmap_size=4)
+    return init_dalle(cfg, jax.random.PRNGKey(0))
+
+
+def _tree_leaves(tree) -> int:
+    import jax
+    return len(jax.tree.leaves(tree))
+
+
+# --------------------------------------------------------------------------
+# trainer steps (compiled: donation + collectives)
+# --------------------------------------------------------------------------
+
+@register_entry("train_step_dalle", "dalle_tpu/train/trainer_dalle.py")
+def _build_train_step_dalle() -> BuiltEntry:
+    import jax
+    import numpy as np
+    from ..config import DalleConfig, MeshConfig
+    from ..train.trainer_dalle import DalleTrainer
+    mesh_cfg = MeshConfig(dp=2, fsdp=2, tp=2)
+    cfg = DalleConfig(num_text_tokens=32, text_seq_len=8, dim=32, depth=2,
+                      heads=2, dim_head=16, image_size=16,
+                      image_vocab_size=32, image_fmap_size=4)
+    tr = DalleTrainer(cfg, _train_cfg(mesh_cfg), mesh=_mesh(2, 2, 2))
+    rng = np.random.RandomState(0)
+    text, ids = tr._put_batch((rng.randint(1, 32, (8, 8)),
+                               rng.randint(0, 32, (8, 16))))
+    key = jax.random.fold_in(tr.base_key, 0)
+    return BuiltEntry(fn=tr.step_fn, args=(tr.state, text, ids, key),
+                      donated=_tree_leaves(tr.state), mesh=tr.mesh,
+                      compile=True)
+
+
+@register_entry("train_step_vae", "dalle_tpu/train/trainer_vae.py")
+def _build_train_step_vae() -> BuiltEntry:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ..config import DVAEConfig, MeshConfig
+    from ..train.trainer_vae import VAETrainer
+    mesh_cfg = MeshConfig(dp=4, fsdp=2)
+    cfg = DVAEConfig(image_size=16, num_tokens=32, codebook_dim=16,
+                     num_layers=2, num_resnet_blocks=0, hidden_dim=8)
+    tr = VAETrainer(cfg, _train_cfg(mesh_cfg), mesh=_mesh(4, 2))
+    images = tr._put(np.random.RandomState(0).rand(8, 16, 16, 3), np.float32)
+    key = jax.random.fold_in(tr.base_key, 0)
+    return BuiltEntry(fn=tr.step_fn,
+                      args=(tr.state, images, key, jnp.float32(1.0)),
+                      donated=_tree_leaves(tr.state), mesh=tr.mesh,
+                      compile=True)
+
+
+@register_entry("train_step_clip", "dalle_tpu/train/trainer_clip.py")
+def _build_train_step_clip() -> BuiltEntry:
+    import numpy as np
+    from ..config import ClipConfig, MeshConfig
+    from ..train.trainer_clip import CLIPTrainer
+    mesh_cfg = MeshConfig(dp=2, fsdp=2, tp=2)
+    cfg = ClipConfig(dim_text=32, dim_image=32, dim_latent=32,
+                     num_text_tokens=64, text_enc_depth=1, text_seq_len=8,
+                     text_heads=2, visual_enc_depth=1, visual_heads=2,
+                     visual_image_size=16, visual_patch_size=8)
+    tr = CLIPTrainer(cfg, _train_cfg(mesh_cfg), mesh=_mesh(2, 2, 2))
+    rng = np.random.RandomState(0)
+    text, images = tr._put_batch((rng.randint(1, 64, (8, 8)),
+                                  rng.rand(8, 16, 16, 3)))
+    return BuiltEntry(fn=tr.step_fn, args=(tr.state, text, images),
+                      donated=_tree_leaves(tr.state), mesh=tr.mesh,
+                      compile=True)
+
+
+@register_entry("train_step_vqgan", "dalle_tpu/train/trainer_vqgan.py")
+def _build_train_step_vqgan() -> BuiltEntry:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ..config import MeshConfig, VQGANConfig
+    from ..models.gan import GANLossConfig
+    from ..train.trainer_vqgan import VQGANTrainer
+    mesh_cfg = MeshConfig(dp=4, fsdp=2)
+    cfg = VQGANConfig(embed_dim=16, n_embed=64, z_channels=16, resolution=32,
+                      ch=16, ch_mult=(1, 2), num_res_blocks=1,
+                      attn_resolutions=(16,))
+    tr = VQGANTrainer(cfg, _train_cfg(mesh_cfg),
+                      loss_cfg=GANLossConfig(disc_start=0,
+                                             perceptual_weight=0.0),
+                      mesh=_mesh(4, 2))
+    images = tr._put(np.random.RandomState(0).rand(8, 32, 32, 3) * 2 - 1,
+                     np.float32)
+    key = jax.random.fold_in(tr.base_key, 0)
+    return BuiltEntry(fn=tr.step_fn,
+                      args=(tr.state, images, key, jnp.float32(1.0)),
+                      donated=_tree_leaves(tr.state), mesh=tr.mesh,
+                      compile=True)
+
+
+# --------------------------------------------------------------------------
+# decode programs (trace-only: dtype/primitive/memory discipline)
+# --------------------------------------------------------------------------
+
+@register_entry("generate_images_tokens", "dalle_tpu/models/dalle.py")
+def _build_generate() -> BuiltEntry:
+    import jax
+    import jax.numpy as jnp
+    from ..models.dalle import DALLE
+    model, params = _dalle_model()
+
+    def gen(p, text, key):
+        return model.apply(p, text, key, method=DALLE.generate_images_tokens)
+
+    text = jnp.zeros((2, 8), jnp.int32)
+    return BuiltEntry(fn=gen, args=(params, text, jax.random.PRNGKey(0)))
+
+
+@functools.lru_cache(maxsize=None)
+def _engine():
+    from ..serve.engine import DecodeEngine
+    model, params = _dalle_model()
+    return DecodeEngine(model, params, slots=4)
+
+
+@register_entry("serve_decode", "dalle_tpu/serve/engine.py")
+def _build_serve_decode() -> BuiltEntry:
+    eng = _engine()
+    state = eng._init_state()
+    return BuiltEntry(fn=eng._step_fn, args=(eng.params, state),
+                      donated=_tree_leaves(state), compile=True)
+
+
+@register_entry("serve_refill", "dalle_tpu/serve/engine.py")
+def _build_serve_refill() -> BuiltEntry:
+    import jax.numpy as jnp
+    eng = _engine()
+    state = eng._init_state()
+    texts = jnp.zeros((4, eng.text_seq_len), jnp.int32)
+    seeds = jnp.zeros((4,), jnp.int32)
+    n_rows = jnp.full((4,), eng.n_steps, jnp.int32)
+    mask = jnp.ones((4,), bool)
+    return BuiltEntry(fn=eng._refill_fn,
+                      args=(eng.params, state, texts, seeds, n_rows, mask),
+                      donated=_tree_leaves(state), compile=True)
+
+
+# --------------------------------------------------------------------------
+# attention kernels (trace-only, interpret=True so the pallas kernel body's
+# primitives land in the histogram; vmem snapshot from the PR 1 estimator)
+# --------------------------------------------------------------------------
+
+def _fused_vmem(n: int, hd: int) -> dict:
+    from ..ops import fused_attention as fa
+    est = fa._bwd_bytes(n, hd)
+    cp = fa._compiler_params(est)
+    return {"bwd_bytes_est": int(est),
+            "vmem_limit_bytes": int(getattr(cp, "vmem_limit_bytes", 0) or 0)
+            if cp is not None else 0,
+            "calibration": f"n={n}, hd={hd}"}
+
+
+@register_entry("fused_qkv_attention", "dalle_tpu/ops/fused_attention.py")
+def _build_fused_attention() -> BuiltEntry:
+    import jax
+    import jax.numpy as jnp
+    from ..ops.fused_attention import fused_qkv_attention
+    n, heads, d = 128, 2, 32
+    hd = heads * d
+
+    def fwd_bwd(qkv):
+        # value-and-grad captures BOTH pallas kernels (fwd + custom-vjp bwd)
+        return jax.grad(lambda x: fused_qkv_attention(
+            x, heads=heads, interpret=True).sum())(qkv)
+
+    qkv = jnp.zeros((2, n, 3 * hd), jnp.float32)
+    return BuiltEntry(fn=fwd_bwd, args=(qkv,), vmem=_fused_vmem(n, hd))
+
+
+@register_entry("decode_attend_window", "dalle_tpu/ops/decode_attention.py")
+def _build_decode_window() -> BuiltEntry:
+    import jax.numpy as jnp
+    from ..ops.attention import KVCache
+    from ..ops.decode_attention import decode_attend_window_kernel
+    b, h, S, d, w = 4, 2, 64, 32, 4
+    cache = KVCache.init(b, h, S, d, jnp.float32)
+
+    def attend(q, kv, starts):
+        return decode_attend_window_kernel(q, cache.replace(kv=kv), starts,
+                                           interpret=True)
+
+    q = jnp.zeros((b, h, w, d), jnp.float32)
+    starts = jnp.zeros((b,), jnp.int32)
+    return BuiltEntry(fn=attend, args=(q, cache.kv, starts))

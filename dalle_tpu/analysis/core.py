@@ -163,13 +163,19 @@ def changed_files(repo_root: str = REPO_ROOT) -> List[str]:
     project-rule triggers — deleting a test file is exactly how ops lose
     coverage.
 
+    Renames (``R<score>`` status with rename detection) contribute BOTH
+    sides: the new path is the lintable file, the old path fires
+    project-rule triggers exactly like a deletion. ``--name-only`` output
+    lists only the PRE-image of a rename, so a renamed file's new content
+    would silently go unlinted.
+
     Raises on git failure: treating "git broke" as "nothing changed" would
     make --changed-only print 0 findings and exit green having linted
     nothing — the same silent-hole the CLI hard-errors unknown --select
     names to avoid."""
     try:
         diff = subprocess.run(
-            ["git", "diff", "--name-only", "HEAD"],
+            ["git", "diff", "--name-status", "-M", "HEAD"],
             cwd=repo_root, capture_output=True, text=True, check=True).stdout
         untracked = subprocess.run(
             ["git", "ls-files", "--others", "--exclude-standard"],
@@ -178,7 +184,14 @@ def changed_files(repo_root: str = REPO_ROOT) -> List[str]:
         raise RuntimeError(
             f"--changed-only cannot determine changed files (git failed: "
             f"{e}); run the full lint instead") from e
-    paths = {p.strip() for p in (diff + untracked).splitlines() if p.strip()}
+    paths = set()
+    for line in diff.splitlines():
+        fields = line.split("\t")
+        if len(fields) < 2:
+            continue
+        # "M\tpath", "D\tpath", "R095\told\tnew", "C080\tsrc\tdst"
+        paths.update(f.strip() for f in fields[1:] if f.strip())
+    paths.update(p.strip() for p in untracked.splitlines() if p.strip())
     return sorted(p for p in paths if p.endswith(".py"))
 
 

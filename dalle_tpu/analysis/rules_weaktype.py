@@ -1,0 +1,133 @@
+"""weak-type-promotion: scalar-typing hazards that flip jit signatures.
+
+The AST complement to graftir's IR-level promotion audit. Two statically
+certain patterns are flagged (dtype inference on arbitrary expressions is
+not attempted — same zero-false-positive contract as the other rules):
+
+1. **Weak-typed param initializers** — ``self.param("s", lambda k:
+   jnp.full(shape, eps))``: ``jnp.full``/``jnp.array``/``jnp.asarray`` of a
+   Python scalar without an explicit ``dtype=`` yields a WEAK-typed array.
+   A weak-typed param flips to strong after one pass through a jitted step
+   (outputs are strong), changing the input signature — every subsequent
+   step call then recompiles the whole program. This exact pattern cost
+   ~4-5 s per train_step on the layerscale params before it was found by
+   the graftir retrace probe.
+
+2. **Strong numpy scalars in jitted arithmetic** — ``x * np.float32(0.5)``
+   inside a jitted function: numpy scalars are STRONG-typed in JAX's
+   promotion lattice, so they silently widen bf16/f16 operands to f32
+   (a Python literal is weak and preserves the array dtype).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from .core import FileContext, Finding, Rule, register_rule
+from .jit_scan import body_nodes, dotted_name, find_jit_functions
+
+# constructors whose scalar-fill result is weak-typed without dtype=
+_WEAK_CTORS = {"jnp.full", "jnp.array", "jnp.asarray",
+               "jax.numpy.full", "jax.numpy.array", "jax.numpy.asarray"}
+
+# numpy scalar types that are strong in the promotion lattice
+_NP_STRONG = {"np.float16", "np.float32", "np.float64",
+              "numpy.float16", "numpy.float32", "numpy.float64"}
+
+
+def _certainly_weak_scalar(node: ast.expr, ctor: str) -> bool:
+    """Value argument that is certainly a weak-typed Python scalar. Literal
+    numbers (and their negations) always are. A bare Name is accepted for
+    ``full`` only — a fill_value is overwhelmingly a scalar variable (the
+    layerscale ``eps`` pattern this rule exists for), while ``array``/
+    ``asarray`` of a Name is routinely a strong-typed ndarray (loaded
+    weights). Calls/attributes are never flagged: ``np.float32(0.5)`` and
+    friends construct STRONG-typed values."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, float)) \
+            and not isinstance(node.value, bool)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        return _certainly_weak_scalar(node.operand, ctor)
+    return ctor.endswith("full") and isinstance(node, ast.Name)
+
+
+def _weak_ctor_call(node: ast.expr) -> Optional[str]:
+    """Name of the weak-typed constructor if ``node`` is one without dtype."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = dotted_name(node.func)
+    if name not in _WEAK_CTORS:
+        return None
+    if any(kw.arg == "dtype" for kw in node.keywords):
+        return None
+    # jnp.full(shape, fill); jnp.array(x) — the scalar rides arg 1 resp. 0,
+    # and a positional dtype would be the NEXT arg
+    value_pos, dtype_pos = (1, 2) if name.endswith("full") else (0, 1)
+    if len(node.args) > dtype_pos:        # positional dtype given
+        return None
+    if len(node.args) <= value_pos \
+            or not _certainly_weak_scalar(node.args[value_pos], name):
+        return None
+    return name
+
+
+def _returns_of(fn: ast.AST):
+    """Expressions a param initializer evaluates to (lambda body or returns)."""
+    if isinstance(fn, ast.Lambda):
+        yield fn.body
+    elif isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                yield node.value
+
+
+@register_rule
+class WeakTypePromotion(Rule):
+    name = "weak-type-promotion"
+    description = ("weak-typed param initializer (jnp.full/array of a Python "
+                   "scalar, no dtype) or strong numpy scalar in jitted "
+                   "arithmetic — signature flips force per-step recompiles; "
+                   "numpy scalars upcast bf16 to f32")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        local_defs = {n.name: n for n in ast.walk(ctx.tree)
+                      if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+        # 1. weak-typed param initializers: *.param(name, init, ...)
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "param" and len(node.args) >= 2):
+                continue
+            init = node.args[1]
+            if isinstance(init, ast.Name):
+                init = local_defs.get(init.id, init)
+            for ret in _returns_of(init):
+                ctor = _weak_ctor_call(ret)
+                if ctor:
+                    findings.append(Finding(
+                        self.name, ctx.rel_path, ret.lineno,
+                        f"param initializer builds a WEAK-typed array "
+                        f"({ctor} of a Python scalar, no dtype=) — the param "
+                        "flips to strong after one jitted step, changing the "
+                        "input signature and recompiling the program on "
+                        "every call; pass an explicit dtype"))
+
+        # 2. strong numpy scalars in jitted arithmetic
+        for info in find_jit_functions(ctx.tree):
+            for node in body_nodes(info.func_node):
+                if not isinstance(node, ast.BinOp):
+                    continue
+                for side in (node.left, node.right):
+                    if (isinstance(side, ast.Call)
+                            and dotted_name(side.func) in _NP_STRONG):
+                        findings.append(Finding(
+                            self.name, ctx.rel_path, node.lineno,
+                            f"{dotted_name(side.func)}() scalar in jitted "
+                            "arithmetic is STRONG-typed — it upcasts "
+                            "bf16/f16 operands to its own dtype; use a "
+                            "Python literal (weak, dtype-preserving) or a "
+                            "jnp scalar of the array's dtype"))
+        return findings

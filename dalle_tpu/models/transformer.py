@@ -393,7 +393,13 @@ class TransformerLayer(nn.Module):
         self.norm = nn.LayerNorm(name="norm")
         self.norm_out = nn.LayerNorm(name="norm_out") if self.sandwich else None
         eps = layerscale_init_eps(self.index)
-        self.scale = self.param("scale", lambda k: jnp.full((1, 1, self.dim), eps))
+        # explicit dtype: jnp.full of a Python float is WEAK-typed, and a
+        # weak-typed param flips to strong after one pass through a jitted
+        # step (outputs are strong), changing the input signature — every
+        # train_step call then recompiles the whole program (graftlint
+        # weak-type-promotion; graftir caught this as a per-step retrace)
+        self.scale = self.param(
+            "scale", lambda k: jnp.full((1, 1, self.dim), eps, jnp.float32))
 
     def _post(self, y):
         if self.norm_out is not None:

@@ -68,19 +68,29 @@ def spec_for(path: str, shape: Tuple[int, ...],
 
 def _fit_spec(spec: P, shape: Tuple[int, ...], mesh: Optional[Mesh]) -> P:
     """Clip a spec to the array rank and drop axes that don't divide the dim
-    (falls back to replication on that dim, like t5x's logical-axis fallback)."""
+    (falls back to replication on that dim, like t5x's logical-axis fallback).
+
+    Size-1 mesh axes are dropped from tuple entries — ``('tp', 'fsdp')`` on a
+    tp=1 mesh becomes ``'fsdp'``. Placement is identical either way, but the
+    spelling matters: GSPMD emits the normalized form on a jitted step's
+    OUTPUTS, so a second same-config trainer built with the un-normalized
+    input spelling would miss the executable cache and recompile the whole
+    step (~seconds) for a byte-identical program."""
     parts = list(spec)
     parts = parts[: len(shape)] + [None] * (len(shape) - len(parts))
     if mesh is not None:
         for i, ax in enumerate(parts):
             if ax is None:
                 continue
-            axes = ax if isinstance(ax, tuple) else (ax,)
+            axes = tuple(a for a in (ax if isinstance(ax, tuple) else (ax,))
+                         if mesh.shape.get(a, 1) > 1)
             size = 1
             for a in axes:
-                size *= mesh.shape.get(a, 1)
+                size *= mesh.shape[a]
             if size == 1 or shape[i] % size != 0:
                 parts[i] = None
+            else:
+                parts[i] = axes[0] if len(axes) == 1 else axes
     while parts and parts[-1] is None:
         parts.pop()
     return P(*parts)
@@ -122,3 +132,25 @@ def shard_params(mesh: Mesh, params, rules=None):
 def constrain(mesh: Mesh, x, *spec_axes):
     """Sharding constraint helper for activations inside jitted steps."""
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec_axes)))
+
+
+def commit_to_mesh(mesh: Mesh, tree):
+    """Replicate every leaf that is not already committed to a mesh sharding.
+
+    ``TrainState.create`` builds the step counter and the optimizer's count
+    scalars eagerly (``jnp.zeros``) — uncommitted single-device arrays. The
+    params (and the mu/nu moments derived from them) are mesh-committed, so
+    the FIRST train_step call carries a mixed signature, while its outputs
+    come back fully mesh-committed: the second call then misses the
+    executable cache and recompiles the whole program once (graftir caught
+    this as a one-step retrace on every trainer). Committing the stray
+    leaves up front makes the first call's signature the steady-state one —
+    one compile for the life of the trainer."""
+    repl = NamedSharding(mesh, P())
+
+    def place(x):
+        if isinstance(x, jax.Array) and not x.committed:
+            return jax.device_put(x, repl)
+        return x
+
+    return jax.tree.map(place, tree)

@@ -20,6 +20,34 @@ import optax
 from ..config import OptimConfig
 
 
+class _ValueEqMethod:
+    """Value-comparable wrapper for a bound method held in a static field.
+
+    Static fields ride the pytree treedef, which jit compares with ``==``.
+    Bound methods compare by ``__self__`` IDENTITY, so two equal-config
+    trainers passing ``model.apply`` get unequal TrainState treedefs and the
+    shared train step silently retraces (and recompiles, seconds per
+    program) once per trainer instance. Flax modules compare by config, so
+    delegating equality to (underlying function, module) restores cross-
+    trainer cache hits while ``state.apply_fn(params, x)`` keeps working."""
+
+    __slots__ = ("_func", "_self")
+
+    def __init__(self, method):
+        self._func = method.__func__
+        self._self = method.__self__
+
+    def __call__(self, *args, **kwargs):
+        return self._func(self._self, *args, **kwargs)
+
+    def __eq__(self, other):
+        return (type(other) is _ValueEqMethod and self._func is other._func
+                and self._self == other._self)
+
+    def __hash__(self):
+        return hash((self._func, self._self))
+
+
 @flax.struct.dataclass
 class TrainState:
     step: jnp.ndarray
@@ -31,6 +59,9 @@ class TrainState:
 
     @classmethod
     def create(cls, *, apply_fn, params, tx):
+        import inspect
+        if inspect.ismethod(apply_fn):
+            apply_fn = _ValueEqMethod(apply_fn)
         return cls(step=jnp.zeros((), jnp.int32), params=params,
                    opt_state=tx.init(params), apply_fn=apply_fn, tx=tx)
 
@@ -143,6 +174,53 @@ def make_scanned_steps(step_body: Callable):
         return state, metrics
 
     return steps
+
+
+_JIT_STEP_CACHE: dict = {}
+
+
+def jit_step(body, state=None, *, donate_argnums=(0,)):
+    """jit a ``(state, *batch) -> (state, metrics)`` step body, pinning the
+    returned state's shardings to the input ``state``'s when it is given.
+
+    Without the pin, GSPMD freely propagates shardings onto output leaves
+    whose inputs the partition rules left replicated (a size-1-fallback
+    bias next to a tp-sharded kernel, a conv_out kernel whose own dims
+    don't divide). The step's state sharding then has no fixed point: the
+    first call returns differently-sharded leaves, so the second call
+    compiles a SECOND executable, and — because a replicated input buffer
+    cannot alias a sharded output — every mismatched donated leaf silently
+    loses donation, keeping the old state live in HBM (the graftir donation
+    audit counts exactly this). Metrics stay unpinned — every trainer's
+    metrics are scalars, replicated either way.
+
+    Memoized on (body, shardings): the step-body factories are lru_cached on
+    (model, dtype, ...), so two equal-config trainers pass the SAME body
+    object and the same sharding tree — they must get the same jitted
+    wrapper back, or the second trainer's first step recompiles the whole
+    program (~5 s) for a byte-identical executable."""
+    if state is None:
+        key = (body, donate_argnums)
+        out_shardings = None
+    else:
+        shardings = jax.tree.map(lambda x: x.sharding, state)
+        leaves, treedef = jax.tree.flatten(shardings)
+        key = (body, donate_argnums, treedef, tuple(leaves))
+        out_shardings = (shardings, None)
+    fn = _JIT_STEP_CACHE.get(key)
+    if fn is None:
+        if out_shardings is None:
+            fn = jax.jit(body, donate_argnums=donate_argnums)
+        else:
+            fn = jax.jit(body, donate_argnums=donate_argnums,
+                         out_shardings=out_shardings)
+        # bound the cache: un-memoized bodies (the vqgan factories build a
+        # fresh closure per trainer) would otherwise pin dead executables
+        # forever; insertion-order eviction keeps the recent/live ones
+        while len(_JIT_STEP_CACHE) >= 256:
+            _JIT_STEP_CACHE.pop(next(iter(_JIT_STEP_CACHE)))
+        _JIT_STEP_CACHE[key] = fn
+    return fn
 
 
 def compute_dtype(precision) -> Any:

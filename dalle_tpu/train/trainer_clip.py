@@ -9,7 +9,7 @@ trainer (NaN rollback, checkpoints, meter, bf16 compute).
 
 from __future__ import annotations
 
-from functools import partial
+import functools
 
 import jax
 import numpy as np
@@ -18,14 +18,17 @@ import optax
 from ..config import ClipConfig, TrainConfig
 from ..models.clip import CLIP, init_clip
 from ..obs import span
-from ..parallel import shard_params
+from ..parallel import commit_to_mesh, shard_params
 from .base_trainer import BaseTrainer
 from .metrics import ThroughputMeter, count_params, transformer_train_flops
 from .train_state import (TrainState, cast_floating, compute_dtype,
-                          make_optimizer)
+                          jit_step, make_optimizer)
 
 
+@functools.lru_cache(maxsize=64)
 def _clip_step_body(model: CLIP, dtype=None):
+    # memoized on (model-config, dtype) so equal-config trainers hand
+    # jit_step the SAME body object and share one jitted wrapper
     def loss_fn(params, text, images):
         x = images if dtype is None else images.astype(dtype)
         return model.apply(cast_floating(params, dtype), text, x,
@@ -39,9 +42,10 @@ def _clip_step_body(model: CLIP, dtype=None):
     return step
 
 
-def make_clip_train_step(model: CLIP, dtype=None):
-    """Returns step(state, text, images) -> (state, metrics)."""
-    return partial(jax.jit, donate_argnums=(0,))(_clip_step_body(model, dtype))
+def make_clip_train_step(model: CLIP, dtype=None, state=None):
+    """Returns step(state, text, images) -> (state, metrics). ``state`` pins
+    the output state's shardings (train_state.jit_step)."""
+    return jit_step(_clip_step_body(model, dtype), state)
 
 
 def make_clip_train_multi_step(model: CLIP, dtype=None):
@@ -61,10 +65,11 @@ class CLIPTrainer(BaseTrainer):
         self.model, params = init_clip(model_cfg, self.base_key)
         params = shard_params(self.mesh, params)
         tx = make_optimizer(train_cfg.optim)
-        self.state = TrainState.create(apply_fn=self.model.apply, params=params,
-                                       tx=tx)
+        self.state = commit_to_mesh(self.mesh, TrainState.create(
+            apply_fn=self.model.apply, params=params, tx=tx))
         self.step_fn = make_clip_train_step(
-            self.model, dtype=compute_dtype(train_cfg.precision))
+            self.model, dtype=compute_dtype(train_cfg.precision),
+            state=self.state)
         self._multi_step_fn = None   # built lazily on first train_steps()
         n = count_params(self.state.params)
         self.num_params = n

@@ -27,11 +27,11 @@ import optax
 from ..config import DalleConfig, TrainConfig
 from ..models.dalle import DALLE, init_dalle
 from ..obs import span
-from ..parallel import shard_params
+from ..parallel import commit_to_mesh, shard_params
 from .base_trainer import BaseTrainer
 from .metrics import ThroughputMeter, count_params, transformer_train_flops
 from .train_state import (TrainState, cast_floating, compute_dtype,
-                          make_optimizer)
+                          jit_step, make_optimizer)
 
 
 def _make_dalle_loss_fn(model: DALLE, *, null_cond_prob: float,
@@ -53,17 +53,13 @@ def _make_dalle_loss_fn(model: DALLE, *, null_cond_prob: float,
 
 
 @functools.lru_cache(maxsize=64)
-def make_dalle_train_step(model: DALLE, *, null_cond_prob: float = 0.0,
-                          use_dropout: bool = False, dtype=None):
-    """Returns step(state, text, image_ids, key) -> (state, metrics). jit-once
-    with the state donated; ``null_cond_prob``/``use_dropout`` are compile-time
-    (they select rng wiring). ``dtype`` (e.g. bf16) is the compute precision:
-    params are cast inside the step, master copies stay f32 — the TPU-native
-    replacement for the DeepSpeed fp16 engine (SURVEY.md §2.9 Apex AMP row)."""
+def _dalle_step_body(model: DALLE, *, null_cond_prob: float = 0.0,
+                     use_dropout: bool = False, dtype=None):
+    # memoized on (model-config, rng wiring, dtype) so equal-config trainers
+    # hand jit_step the SAME body object and share one jitted wrapper
     loss_fn = _make_dalle_loss_fn(model, null_cond_prob=null_cond_prob,
                                   use_dropout=use_dropout, dtype=dtype)
 
-    @partial(jax.jit, donate_argnums=(0,))
     def step(state: TrainState, text, image_ids, key):
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, text, image_ids, key)
@@ -72,6 +68,20 @@ def make_dalle_train_step(model: DALLE, *, null_cond_prob: float = 0.0,
         return new_state, metrics
 
     return step
+
+
+def make_dalle_train_step(model: DALLE, *, null_cond_prob: float = 0.0,
+                          use_dropout: bool = False, dtype=None, state=None):
+    """Returns step(state, text, image_ids, key) -> (state, metrics). jit-once
+    (the (body, shardings)-memoized train_state.jit_step) with the state
+    donated; ``null_cond_prob``/``use_dropout`` are compile-time (they select
+    rng wiring). ``state`` pins the output state's shardings to the input's —
+    see jit_step. ``dtype`` (e.g. bf16) is the compute precision: params are
+    cast inside the step, master copies stay f32 — the TPU-native replacement
+    for the DeepSpeed fp16 engine (SURVEY.md §2.9 Apex AMP row)."""
+    return jit_step(_dalle_step_body(model, null_cond_prob=null_cond_prob,
+                                     use_dropout=use_dropout, dtype=dtype),
+                    state)
 
 
 @functools.lru_cache(maxsize=64)
@@ -132,12 +142,12 @@ class DalleTrainer(BaseTrainer):
             model_cfg, self.base_key, sp_mesh=self.mesh if sp > 1 else None)
         params = shard_params(self.mesh, params)
         tx = make_optimizer(train_cfg.optim)
-        self.state = TrainState.create(apply_fn=self.model.apply, params=params,
-                                       tx=tx)
+        self.state = commit_to_mesh(self.mesh, TrainState.create(
+            apply_fn=self.model.apply, params=params, tx=tx))
         use_dropout = (model_cfg.attn_dropout > 0 or model_cfg.ff_dropout > 0)
         self.step_fn = make_dalle_train_step(
             self.model, null_cond_prob=null_cond_prob, use_dropout=use_dropout,
-            dtype=compute_dtype(train_cfg.precision))
+            dtype=compute_dtype(train_cfg.precision), state=self.state)
         self._multi_step_kw = dict(null_cond_prob=null_cond_prob,
                                    use_dropout=use_dropout,
                                    dtype=compute_dtype(train_cfg.precision))

@@ -28,11 +28,11 @@ import optax
 from ..config import AnnealConfig, DVAEConfig, TrainConfig
 from ..models.dvae import DiscreteVAE, init_dvae
 from ..obs import span
-from ..parallel import shard_params
+from ..parallel import commit_to_mesh, shard_params
 from .base_trainer import BaseTrainer
 from .metrics import ThroughputMeter, count_params
 from .train_state import (TrainState, cast_floating, compute_dtype,
-                          make_optimizer)
+                          jit_step, make_optimizer)
 
 
 def anneal_temperature(cfg: AnnealConfig, global_step: int) -> float:
@@ -40,7 +40,10 @@ def anneal_temperature(cfg: AnnealConfig, global_step: int) -> float:
                cfg.temp_min)
 
 
+@functools.lru_cache(maxsize=64)
 def _vae_step_body(model: DiscreteVAE, dtype=None):
+    # memoized on (model-config, dtype) so equal-config trainers hand
+    # jit_step the SAME body object and share one jitted wrapper
     def loss_fn(params, images, key, temp):
         if dtype is not None:
             images = images.astype(dtype)
@@ -58,12 +61,13 @@ def _vae_step_body(model: DiscreteVAE, dtype=None):
     return step
 
 
-@functools.lru_cache(maxsize=64)
-def make_vae_train_step(model: DiscreteVAE, dtype=None):
-    """Returns step(state, images, key, temp) -> (state, metrics). jit-once;
-    the state is donated so params/moments update in place in HBM. ``dtype``
+def make_vae_train_step(model: DiscreteVAE, dtype=None, state=None):
+    """Returns step(state, images, key, temp) -> (state, metrics). jit-once
+    (the (body, shardings)-memoized train_state.jit_step); the state is
+    donated so params/moments update in place in HBM. ``state`` pins the
+    output state's shardings to the input's — see jit_step. ``dtype``
     selects the compute precision (params cast per-step; masters stay f32)."""
-    return partial(jax.jit, donate_argnums=(0,))(_vae_step_body(model, dtype))
+    return jit_step(_vae_step_body(model, dtype), state)
 
 
 @functools.lru_cache(maxsize=64)
@@ -95,10 +99,11 @@ class VAETrainer(BaseTrainer):
         self.model, params = init_dvae(model_cfg, self.base_key)
         params = shard_params(self.mesh, params)
         tx = make_optimizer(train_cfg.optim)
-        self.state = TrainState.create(apply_fn=self.model.apply, params=params,
-                                       tx=tx)
+        self.state = commit_to_mesh(self.mesh, TrainState.create(
+            apply_fn=self.model.apply, params=params, tx=tx))
         self.step_fn = make_vae_train_step(
-            self.model, dtype=compute_dtype(train_cfg.precision))
+            self.model, dtype=compute_dtype(train_cfg.precision),
+            state=self.state)
         self._multi_step_fn = None   # built lazily on first train_steps()
 
         n = count_params(self.state.params)

@@ -26,7 +26,6 @@ TPU design:
 from __future__ import annotations
 
 import math
-from functools import partial
 from typing import Any, Callable, Optional
 
 import flax.struct
@@ -42,11 +41,11 @@ from ..models.gan import (GANLossConfig, NLayerDiscriminator, adaptive_disc_weig
 from ..models.lpips import LPIPS, init_lpips
 from ..models.vqgan import VQModel, init_vqgan
 from ..obs import span
-from ..parallel import shard_params
+from ..parallel import commit_to_mesh, shard_params
 from .base_trainer import BaseTrainer
 from .metrics import ThroughputMeter, count_params
 from .train_state import (TrainState, cast_floating, compute_dtype,
-                          make_optimizer)
+                          jit_step, make_optimizer)
 
 
 class LambdaWarmUpCosineScheduler:
@@ -96,11 +95,13 @@ class GANTrainState:
 
 def make_vqgan_train_step(model: VQModel, disc: NLayerDiscriminator,
                           lpips: Optional[LPIPS], loss_cfg: GANLossConfig,
-                          dtype=None, scanned: bool = False):
+                          dtype=None, scanned: bool = False, state=None):
     """Returns step(state, images, key, temp) -> (state, metrics) implementing
     both optimizer updates of vqperceptual.py:76-136 in one XLA program.
-    ``scanned``: lift the same body into a k-steps-per-dispatch program over
-    stacked (imagess, keys, temps) (train_state.make_scanned_steps)."""
+    ``state`` pins the output state's shardings to the input's
+    (train_state.jit_step). ``scanned``: lift the same body into a
+    k-steps-per-dispatch program over stacked (imagess, keys, temps)
+    (train_state.make_scanned_steps)."""
     lc = loss_cfg
     d_loss_fn = hinge_d_loss if lc.disc_loss == "hinge" else vanilla_d_loss
 
@@ -192,11 +193,12 @@ def make_vqgan_train_step(model: VQModel, disc: NLayerDiscriminator,
     if scanned:
         from .train_state import make_scanned_steps
         return make_scanned_steps(step)
-    return partial(jax.jit, donate_argnums=(0,))(step)
+    return jit_step(step, state)
 
 
 def make_vq_simple_train_step(model: VQModel, loss_cfg: GANLossConfig,
-                              mode: str, dtype=None, scanned: bool = False):
+                              mode: str, dtype=None, scanned: bool = False,
+                              state=None):
     """Single-optimizer VQ variants (taming vqgan.py:159-258):
     ``nodisc`` — L1 recon + codebook loss (VQNoDiscModel);
     ``segmentation`` — BCE over label-map logits + codebook loss
@@ -227,7 +229,7 @@ def make_vq_simple_train_step(model: VQModel, loss_cfg: GANLossConfig,
     if scanned:
         from .train_state import make_scanned_steps
         return make_scanned_steps(step)
-    return partial(jax.jit, donate_argnums=(0,))(step)
+    return jit_step(step, state)
 
 
 class VQGANTrainer(BaseTrainer):
@@ -251,11 +253,11 @@ class VQGANTrainer(BaseTrainer):
         if loss_mode != "gan":
             gen_params = shard_params(self.mesh, gen_params)
             tx = make_optimizer(train_cfg.optim)
-            self.state = TrainState.create(apply_fn=self.model.apply,
-                                           params=gen_params, tx=tx)
+            self.state = commit_to_mesh(self.mesh, TrainState.create(
+                apply_fn=self.model.apply, params=gen_params, tx=tx))
             self.step_fn = make_vq_simple_train_step(
                 self.model, self.loss_cfg, loss_mode,
-                dtype=compute_dtype(train_cfg.precision))
+                dtype=compute_dtype(train_cfg.precision), state=self.state)
             self.disc = self.lpips = None
             self._finish_init(temp_scheduler)
             return
@@ -298,13 +300,13 @@ class VQGANTrainer(BaseTrainer):
         gen_tx = make_optimizer(train_cfg.optim)
         self.disc_optim = disc_optim or train_cfg.optim
         disc_tx = make_optimizer(self.disc_optim)
-        self.state = GANTrainState.create(
+        self.state = commit_to_mesh(self.mesh, GANTrainState.create(
             gen_params=gen_params, disc_params=disc_params,
             lpips_params=lpips_params, batch_stats=batch_stats,
-            gen_tx=gen_tx, disc_tx=disc_tx)
+            gen_tx=gen_tx, disc_tx=disc_tx))
         self.step_fn = make_vqgan_train_step(
             self.model, self.disc, self.lpips, self.loss_cfg,
-            dtype=compute_dtype(train_cfg.precision))
+            dtype=compute_dtype(train_cfg.precision), state=self.state)
         self._finish_init(temp_scheduler)
 
     def _finish_init(self, temp_scheduler):

@@ -16,8 +16,9 @@ The graftlint stage runs FIRST, before any workflow step: static findings
 are cheaper than a test tier, so they should gate it. --changed-only
 narrows the lint to files with UNCOMMITTED changes vs HEAD (the fast
 mid-edit loop) — after a commit it lints nothing, so the pre-push / CI
-gate is the default full lint. The workflow's own lint step is skipped
-here to avoid running the pass twice.
+gate is the default full lint. The graftir contract stage follows (IR-level
+drift is cheaper to surface than a test tier). The workflow's own
+lint/ir_audit steps are skipped here to avoid running each pass twice.
 """
 
 import argparse
@@ -39,6 +40,19 @@ def run_lint_stage(changed_only: bool) -> int:
     print(f"== [lint] {' '.join(cmd[1:])}")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     return subprocess.run(cmd, cwd=ROOT, env=env).returncode
+
+
+def run_ir_audit_stage() -> int:
+    """The graftir stage: rebuild every registered entry point's live
+    program contract (tracing; compiling the trainer/serve entries for
+    collectives + donation aliasing) and diff against the goldens under
+    contracts/. Drift fails with the human-readable report; the report +
+    drift.json land in ./ir_artifacts — the dir ci.yml uploads
+    (scripts/ir_audit.py; the workflow's matching step is skipped below)."""
+    cmd = [sys.executable, os.path.join(ROOT, "scripts", "ir_audit.py"),
+           "--check", "--report", os.path.join(ROOT, "ir_artifacts")]
+    print(f"== [graftir] {' '.join(cmd[1:])}")
+    return subprocess.run(cmd, cwd=ROOT).returncode
 
 
 def run_obs_smoke_stage() -> int:
@@ -82,6 +96,10 @@ def main():
         print("ci_local: FAILED (lint stage) — test tiers not run")
         return 1
 
+    if run_ir_audit_stage() != 0:
+        print("ci_local: FAILED (graftir contract drift) — test tiers not run")
+        return 1
+
     if run_obs_smoke_stage() != 0:
         print("ci_local: FAILED (observability smoke) — test tiers not run")
         return 1
@@ -101,6 +119,9 @@ def main():
         cmd = step["run"]
         if "scripts/lint.py" in cmd:
             print(f"-- [skip] {name}: already run in the lint stage")
+            continue
+        if "scripts/ir_audit.py" in cmd:
+            print(f"-- [skip] {name}: already run in the graftir stage")
             continue
         if "scripts/obs_smoke.py" in cmd:
             print(f"-- [skip] {name}: already run in the obs smoke stage")

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""graftir CLI — check/update the jaxpr-level program contracts.
+
+    python scripts/ir_audit.py --check              # CI gate: fail on drift
+    python scripts/ir_audit.py --update             # regenerate goldens
+    python scripts/ir_audit.py --explain ENTRY      # pretty-print a contract
+    python scripts/ir_audit.py --list-entries
+    python scripts/ir_audit.py --check --entries train_step_dalle,serve_decode
+
+--check rebuilds every registered entry's live contract (tracing each
+program; compiling the trainer/serve entries for collectives + donation) and
+diffs it against the golden under contracts/. Drift fails with a
+human-readable report ("+1 all-gather 12.6 MB on axis 'fsdp'"); intentional
+changes are accepted with --update (commit the JSON diff — it is the
+machine-checked before/after comm+dtype story for the PR). --report writes
+the report + a JSON drift dump for the CI artifact upload.
+
+Waivers are source comments next to the code they excuse
+(``# graftir: allow=donation -- why``); see docs/ANALYSIS.md.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+# must run before jax initializes: the trainer entries trace on the 8-device
+# virtual CPU mesh (same environment the test suite pins in conftest.py)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument("--check", action="store_true",
+                      help="diff live contracts against goldens (default)")
+    mode.add_argument("--update", action="store_true",
+                      help="regenerate the golden contracts")
+    mode.add_argument("--explain", metavar="ENTRY",
+                      help="pretty-print one entry's live contract")
+    ap.add_argument("--entries", help="comma-separated subset of entries")
+    ap.add_argument("--contracts-dir",
+                    default=os.path.join(ROOT, "contracts"),
+                    help="golden directory (default: contracts/)")
+    ap.add_argument("--report", metavar="DIR",
+                    help="write report.txt + drift.json into DIR (CI artifact)")
+    ap.add_argument("--list-entries", action="store_true")
+    args = ap.parse_args(argv)
+
+    import jax
+    jax.config.update("jax_default_matmul_precision", "float32")
+
+    from dalle_tpu.analysis import contracts as C
+    from dalle_tpu.analysis import ir_audit as A
+
+    if args.list_entries:
+        width = max(len(n) for n in C.ENTRIES)
+        for name, spec in sorted(C.ENTRIES.items()):
+            print(f"{name:<{width}}  {spec.source}")
+        return 0
+
+    names = sorted(C.ENTRIES)
+    if args.entries:
+        names = [n.strip() for n in args.entries.split(",") if n.strip()]
+        unknown = [n for n in names if n not in C.ENTRIES]
+        if unknown:
+            sys.exit(f"ir_audit.py: unknown entries: {', '.join(unknown)} "
+                     "(see --list-entries)")
+
+    if args.explain:
+        if args.explain not in C.ENTRIES:
+            sys.exit(f"ir_audit.py: unknown entry {args.explain!r} "
+                     "(see --list-entries)")
+        spec = C.ENTRIES[args.explain]
+        _, live = A.audit_entry(args.explain, spec, args.contracts_dir,
+                                update=False)
+        print(A.explain(live))
+        return 0
+
+    update = bool(args.update)
+    reports = []
+    for name in names:
+        print(f"-- [{'update' if update else 'check'}] {name}", flush=True)
+        report, _ = A.audit_entry(name, C.ENTRIES[name], args.contracts_dir,
+                                  update=update)
+        reports.append(report)
+
+    sources = {n: C.ENTRIES[n].source for n in names}
+    scope = f"{len(names)} entr{'y' if len(names) == 1 else 'ies'}"
+    text = A.render_report(reports, sources, scope)
+    print(text)
+
+    if args.report:
+        os.makedirs(args.report, exist_ok=True)
+        with open(os.path.join(args.report, "report.txt"), "w",
+                  encoding="utf-8") as fh:
+            fh.write(text + "\n")
+        with open(os.path.join(args.report, "drift.json"), "w",
+                  encoding="utf-8") as fh:
+            json.dump([{"entry": r.name, "drift": r.drift,
+                        "waived": r.waived, "problems": r.problems}
+                       for r in reports], fh, indent=1, sort_keys=True)
+            fh.write("\n")
+
+    return 1 if any(r.failed for r in reports) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -530,6 +530,140 @@ def test_scatter_missing_hints_clean_cases():
 # untested-public-op
 # ---------------------------------------------------------------------------
 
+# ---------------------------------------------------------------------------
+# weak-type-promotion
+# ---------------------------------------------------------------------------
+
+def test_weaktype_flags_weak_param_initializer():
+    # the exact layerscale pattern: jnp.full of a Python float, no dtype —
+    # the param flips weak→strong after one jitted step and every later
+    # step call recompiles
+    src = """
+    import jax.numpy as jnp
+    class Layer:
+        def setup(self):
+            self.scale = self.param("scale", lambda k: jnp.full((1, 4), 1e-5))
+    """
+    found = lint_source("weak-type-promotion", src)
+    assert len(found) == 1 and "WEAK-typed" in found[0].message
+
+
+def test_weaktype_flags_named_initializer_function():
+    src = """
+    import jax.numpy as jnp
+    def init(key):
+        return jnp.array(0.5)
+    class Layer:
+        def setup(self):
+            self.gate = self.param("gate", init)
+    """
+    found = lint_source("weak-type-promotion", src)
+    assert len(found) == 1 and "jnp.array" in found[0].message
+
+
+def test_weaktype_flags_scalar_name_fill_in_full():
+    # the layerscale shape: the fill rides a local scalar variable
+    src = """
+    import jax.numpy as jnp
+    def init_eps(i):
+        return 0.1
+    class Layer:
+        def setup(self):
+            eps = init_eps(self.index)
+            self.scale = self.param("scale",
+                                    lambda k: jnp.full((1, 4), eps))
+    """
+    found = lint_source("weak-type-promotion", src)
+    assert len(found) == 1 and "jnp.full" in found[0].message
+
+
+def test_weaktype_param_initializer_clean_cases():
+    # explicit dtype (kw or positional), list-literal fill (strong), a
+    # strong numpy-scalar fill (Call), and asarray of a loaded ndarray
+    # (Name: routinely strong-typed) must all stay silent
+    src = """
+    import numpy as np
+    import jax.numpy as jnp
+    pretrained = np.ones((4,), np.float32)
+    class Layer:
+        def setup(self):
+            self.a = self.param("a", lambda k: jnp.full((4,), 1.0, jnp.float32))
+            self.b = self.param("b", lambda k: jnp.full((4,), 2.0,
+                                                        jnp.bfloat16))
+            self.c = self.param("c", lambda k: jnp.array([1.0, 2.0]))
+            self.d = self.param("d", lambda k: jnp.asarray(3.0,
+                                                           dtype=jnp.float32))
+            self.e = self.param("e", lambda k: jnp.asarray(pretrained))
+            self.f = self.param("f", lambda k: jnp.full((4,),
+                                                        np.float32(1.0)))
+    """
+    assert lint_source("weak-type-promotion", src) == []
+
+
+def test_weaktype_flags_numpy_scalar_in_jitted_arithmetic():
+    src = """
+    import jax
+    import numpy as np
+    @jax.jit
+    def f(x):
+        return x * np.float32(0.5)
+    """
+    found = lint_source("weak-type-promotion", src)
+    assert len(found) == 1 and "STRONG-typed" in found[0].message
+
+
+def test_weaktype_numpy_scalar_clean_cases():
+    # Python literal (weak), numpy scalar OUTSIDE jit, and np.float32 as a
+    # dtype argument (not arithmetic) are all fine
+    src = """
+    import jax
+    import numpy as np
+    @jax.jit
+    def f(x):
+        return x * 0.5
+    def g(x):
+        return x * np.float32(0.5)
+    @jax.jit
+    def h(x):
+        return x.astype(np.float32)
+    """
+    assert lint_source("weak-type-promotion", src) == []
+
+
+# ---------------------------------------------------------------------------
+# --changed-only rename following
+# ---------------------------------------------------------------------------
+
+def test_changed_files_follows_renames(tmp_path):
+    import subprocess
+    from dalle_tpu.analysis.core import changed_files
+
+    def git(*args):
+        subprocess.run(["git", *args], cwd=tmp_path, check=True,
+                       capture_output=True)
+
+    git("init", "-q")
+    git("config", "user.email", "t@t")
+    git("config", "user.name", "t")
+    (tmp_path / "old_name.py").write_text("x = 1\n" * 60)
+    (tmp_path / "steady.py").write_text("y = 2\n")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    # rename with a small edit: similarity stays high enough that
+    # --name-status -M reports R<score>\told\tnew on one line
+    (tmp_path / "old_name.py").rename(tmp_path / "new_name.py")
+    text = (tmp_path / "new_name.py").read_text()
+    (tmp_path / "new_name.py").write_text(text + "z = 3\n")
+    (tmp_path / "steady.py").write_text("y = 4\n")
+    git("add", "-A")
+    changed = changed_files(repo_root=str(tmp_path))
+    # BOTH sides of the rename: new path gets linted, old path fires
+    # project-rule triggers like a deletion
+    assert "new_name.py" in changed
+    assert "old_name.py" in changed
+    assert "steady.py" in changed
+
+
 def test_project_rules_see_full_set_under_explicit_paths(tmp_path):
     # linting ONE file must not blind project rules to the rest of the tree
     (tmp_path / "dalle_tpu" / "ops").mkdir(parents=True)

@@ -68,7 +68,11 @@ def test_ranks_distortion_strength(tiny, kind):
         f"kind {kind}: {np.asarray(d_w)} vs {np.asarray(d_s)}")
 
 
-def _tiny_net_trainer(tmp_path):
+@pytest.fixture(scope="module")
+def tiny_net_trainer(tmp_path_factory):
+    # module-scoped: both tests read/step the SAME trainer (the step test
+    # only advances state, which the weights-inspection test doesn't care
+    # about) — a second construction would re-run the GAN init for nothing
     from dalle_tpu.config import TrainConfig, VQGANConfig
     from dalle_tpu.models.gan import GANLossConfig
     from dalle_tpu.train.trainer_vqgan import VQGANTrainer
@@ -76,25 +80,25 @@ def _tiny_net_trainer(tmp_path):
     cfg = VQGANConfig(embed_dim=16, n_embed=32, z_channels=16, resolution=32,
                       ch=16, ch_mult=(1, 2), num_res_blocks=1,
                       attn_resolutions=(16,))
-    tc = TrainConfig(batch_size=8, checkpoint_dir=str(tmp_path),
+    tc = TrainConfig(batch_size=8,
+                     checkpoint_dir=str(tmp_path_factory.mktemp("tinynet")),
                      preflight_checkpoint=False)
     return VQGANTrainer(cfg, tc, loss_cfg=GANLossConfig(disc_start=0))
 
 
-def test_vqgan_trainer_defaults_to_tiny_net(tmp_path):
+def test_vqgan_trainer_defaults_to_tiny_net(tiny_net_trainer):
     """GAN-mode VQGANTrainer with perceptual_weight > 0 must pick up the
     shipped weights (perceptual_net='tiny' default), not a random/ones init."""
-    tr = _tiny_net_trainer(tmp_path)
-    lin0 = np.asarray(tr.state.params["lpips"]["params"]["lin0"])
+    lin0 = np.asarray(
+        tiny_net_trainer.state.params["lpips"]["params"]["lin0"])
     assert not np.allclose(lin0, 1.0)
 
 
 @pytest.mark.slow
-def test_vqgan_trainer_tiny_net_step(tmp_path):
+def test_vqgan_trainer_tiny_net_step(tiny_net_trainer):
     """One GAN step trains end-to-end with the perceptual term live (the
     generator+disc+LPIPS compile costs ~80s on this box → slow tier; the
     wiring check above stays default)."""
-    tr = _tiny_net_trainer(tmp_path)
     imgs = np.random.RandomState(0).rand(8, 32, 32, 3).astype(np.float32)
-    m = tr.train_step(imgs * 2 - 1)
+    m = tiny_net_trainer.train_step(imgs * 2 - 1)
     assert np.isfinite(m["loss"])
