@@ -35,6 +35,9 @@ class BuiltEntry:
     mesh: object = None          # jax Mesh for collective axis naming
     compile: bool = False        # compile for collectives/donation?
     vmem: Optional[dict] = None  # kernel vmem estimator snapshot (PR 1)
+    # precision-flow provenance roles, [(role, label)] per flattened arg
+    # leaf; None = infer from pytree paths (precision_flow.infer_roles)
+    roles: Optional[list] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -203,11 +206,41 @@ def _build_generate() -> BuiltEntry:
     return BuiltEntry(fn=gen, args=(params, text, jax.random.PRNGKey(0)))
 
 
+@register_entry("generate_images_tokens_int8w", "dalle_tpu/models/dalle.py")
+def _build_generate_int8w() -> BuiltEntry:
+    # the quantized decode fast path (wrapper precision="int8w"): int8
+    # matmul kernels + bf16 everything else + int8 KV. Its contract pins
+    # the quantization boundary map — every dequant site and scale axis —
+    # alongside the f32 entry above
+    import jax
+    import jax.numpy as jnp
+    from ..models.dalle import DALLE
+    from ..ops.quantize_weights import quantize_params_int8
+    model, params = _dalle_model()
+    qv = quantize_params_int8(params)
+
+    def gen(p, text, key):
+        return model.apply(p, text, key, cache_dtype=jnp.int8,
+                           method=DALLE.generate_images_tokens)
+
+    text = jnp.zeros((2, 8), jnp.int32)
+    return BuiltEntry(fn=gen, args=(qv, text, jax.random.PRNGKey(0)))
+
+
 @functools.lru_cache(maxsize=None)
 def _engine():
+    # the PRODUCTION serve configuration: int8 weights (per-channel scales
+    # in the mirrored ``quant`` collection) + int8 KV — the serve-engine
+    # default since DalleWithVae.serve_engine flipped to precision="int8w".
+    # The contract (and the precision boundary map in it) pins the
+    # quantized program; the precision_audit CI stage certifies its
+    # quantization safety rules hold.
+    import jax.numpy as jnp
+    from ..ops.quantize_weights import quantize_params_int8
     from ..serve.engine import DecodeEngine
     model, params = _dalle_model()
-    return DecodeEngine(model, params, slots=4)
+    return DecodeEngine(model, quantize_params_int8(params), slots=4,
+                        cache_dtype=jnp.int8)
 
 
 @register_entry("serve_decode", "dalle_tpu/serve/engine.py")

@@ -46,11 +46,14 @@ import numpy as np
 
 from .core import REPO_ROOT
 
-SCHEMA = 1
+SCHEMA = 2
 
-# drift checks a source waiver can silence, and the invariant checks
+# drift checks a source waiver can silence, and the invariant checks.
+# "precision" covers both the contract's quantization-boundary-map drift
+# (below) and the precision-flow rule findings scripts/precision_audit.py
+# enforces (analysis/precision_flow.py).
 RULES = ("primitives", "promotions", "transfers", "collectives", "memory",
-         "donation")
+         "donation", "precision")
 
 # memory estimate is analytic; small jaxpr-preserving refactors can move it
 # a little without a real regression — compare with tolerance
@@ -404,8 +407,12 @@ def donation_report(hlo_text: str, donated_leaves: int) -> dict:
 
 def build_contract(name: str, built) -> dict:
     """Extract the full contract dict for a BuiltEntry (see contracts.py)."""
+    from . import precision_flow
     jax = _jax()
     closed = jax.make_jaxpr(built.fn)(*built.args)
+    roles = getattr(built, "roles", None)
+    if roles is None:
+        roles = precision_flow.infer_roles(built.args)
     contract = {
         "schema": SCHEMA,
         "entry": name,
@@ -415,6 +422,7 @@ def build_contract(name: str, built) -> dict:
         "collectives": [],
         "donation": None,
         "memory": peak_memory_estimate(closed),
+        "precision": precision_flow.analyze(closed, roles).boundary,
         "vmem": built.vmem,
     }
     if built.compile:
@@ -519,6 +527,29 @@ def diff_contracts(old: dict, new: dict) -> Dict[str, List[str]]:
         out["memory"] = [
             f"peak est {_fmt_bytes(om)} -> {_fmt_bytes(nm)} "
             f"({(nm - om) / om:+.1%}, tol {MEMORY_RTOL:.0%})"]
+
+    # precision: the quantization boundary map (graftnum,
+    # analysis/precision_flow.py) — which matmuls consume int8 and at what
+    # accumulator width, where dequants happen and which axes their
+    # per-channel scales ride, plus the value-class histogram
+    po, pn = old.get("precision") or {}, new.get("precision") or {}
+    prec: List[str] = []
+    co, cn = po.get("class_counts", {}), pn.get("class_counts", {})
+    for cls in sorted(set(co) | set(cn)):
+        a, b = co.get(cls, 0), cn.get(cls, 0)
+        if a != b:
+            prec.append(f"value class {cls}: {a} -> {b} ({b - a:+d})")
+    prec += _diff_events(
+        po.get("int8_dots", []), pn.get("int8_dots", []),
+        ("site", "accum"),
+        lambda e: f"int8 dot (accum '{e['accum']}') at {e['site']}")
+    prec += _diff_events(
+        po.get("dequants", []), pn.get("dequants", []),
+        ("site", "dst", "scale_axes"),
+        lambda e: f"dequant ->{e['dst']} (scale axes {e['scale_axes']}) "
+                  f"at {e['site']}")
+    if prec:
+        out["precision"] = prec
     return out
 
 
@@ -685,6 +716,20 @@ def explain(contract: dict) -> str:
             lines.append("  (none)")
         for e in c.get(key) or []:
             lines.append(f"  {render(e)}")
+    prec = c.get("precision") or {}
+    lines.append("precision:")
+    cc = prec.get("class_counts", {})
+    if cc:
+        lines.append("  classes: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(cc.items())))
+    for e in prec.get("int8_dots") or []:
+        lines.append(f"  {e['count']}x int8 dot (accum '{e['accum']}') at "
+                     f"{e['site']}")
+    for e in prec.get("dequants") or []:
+        lines.append(f"  {e['count']}x dequant ->{e['dst']} (scale axes "
+                     f"{e['scale_axes']}) at {e['site']}")
+    if not prec:
+        lines.append("  (none)")
     mem = c.get("memory", {})
     lines.append(f"memory: peak est {_fmt_bytes(mem.get('peak_bytes_est', 0))}"
                  f" (args {_fmt_bytes(mem.get('arg_bytes', 0))}, outputs "

@@ -1,0 +1,166 @@
+"""hardcoded-dtype: dtype literals that bypass the precision plumbing.
+
+The AST-level companion of the graftnum precision-flow audit
+(:mod:`dalle_tpu.analysis.precision_flow`): that layer certifies the
+*traced* program's precision discipline; this rule catches the source
+pattern that silently pins a dtype before any config can reach it. The
+repo's precision policy flows through explicit knobs — ``PrecisionConfig``
+→ ``cast_floating`` for params/compute, ``cache_dtype`` for KV storage,
+``quantize_params_int8`` for weights — so model/op code that hard-codes a
+float dtype opts a tensor out of every one of those paths at once: a
+``jnp.float32`` activation in a bf16 model silently re-widens everything
+downstream, and a ``dtype="bfloat16"`` string survives refactors that
+rename the real config field.
+
+Three statically certain patterns (zero-false-positive contract, like the
+other rules):
+
+1. **String dtype literals** — ``dtype="bfloat16"`` (keyword, or
+   positional in a known creator's dtype slot) anywhere in model/op code:
+   stringly-typed precision that no config plumbing can see.
+2. **jnp float scalar casts** — ``jnp.float32(x)`` / ``jnp.bfloat16(x)``:
+   STRONG-typed scalars (the jnp twin of ``weak-type-promotion``'s numpy
+   check) that widen/narrow whatever they touch regardless of the
+   configured compute dtype.
+3. **Float dtype literals in array creation inside nn.Module classes** —
+   ``jnp.full(shape, v, jnp.float32)`` in a module body creates a tensor
+   whose dtype no precision mode can change. Function-signature DEFAULTS
+   are exempt (``dtype=jnp.float32`` as a default IS the config surface),
+   as are integer/bool dtypes (token ids and masks are not precision
+   knobs).
+
+Scope: ``dalle_tpu/models`` + ``dalle_tpu/ops`` — the code the precision
+modes transform. Deliberate pins (e.g. a param initializer that must stay
+f32 to avoid weak-type retraces) carry a
+``# graftlint: disable=hardcoded-dtype`` suppression next to the line,
+with the why in the surrounding comment.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from .core import FileContext, Finding, Rule, register_rule
+from .jit_scan import dotted_name
+
+_FLOAT_DTYPE_NAMES = set()
+for _mod in ("jnp", "jax.numpy", "np", "numpy"):
+    for _dt in ("float16", "float32", "float64", "bfloat16"):
+        _FLOAT_DTYPE_NAMES.add(f"{_mod}.{_dt}")
+
+_JNP_SCALAR_CTORS = {f"{m}.{d}" for m in ("jnp", "jax.numpy")
+                     for d in ("float16", "float32", "float64", "bfloat16")}
+
+_CREATORS_DTYPE_POS = {}
+for _mod in ("jnp", "jax.numpy"):
+    for _fn, _pos in (("zeros", 1), ("ones", 1), ("empty", 1),
+                      ("full", 2), ("array", 1), ("asarray", 1)):
+        _CREATORS_DTYPE_POS[f"{_mod}.{_fn}"] = _pos
+
+_MODULE_BASES = {"nn.Module", "flax.linen.Module", "linen.Module"}
+
+
+_FLOAT_DTYPE_STRS = {"float16", "float32", "float64", "bfloat16",
+                     "f16", "f32", "f64", "bf16"}
+
+
+def _float_dtype_literal(node: ast.AST) -> Optional[str]:
+    """A float dtype pinned as a literal: the jnp/np attribute form OR a
+    string constant naming one (positional ``jnp.zeros((4,), "bfloat16")``
+    is the same bypass as the keyword form)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+            and node.value in _FLOAT_DTYPE_STRS:
+        return f'"{node.value}"'
+    name = dotted_name(node)
+    return name if name in _FLOAT_DTYPE_NAMES else None
+
+
+def _default_nodes(tree: ast.Module) -> set:
+    """ids of every AST node inside a function-signature default — a dtype
+    default IS the configurable surface, not a bypass of it."""
+    out = set()
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)):
+            continue
+        for d in list(fn.args.defaults) + [d for d in fn.args.kw_defaults
+                                           if d is not None]:
+            for sub in ast.walk(d):
+                out.add(id(sub))
+    return out
+
+
+def _module_class_nodes(tree: ast.Module) -> List[ast.ClassDef]:
+    return [n for n in ast.walk(tree)
+            if isinstance(n, ast.ClassDef)
+            and any(dotted_name(b) in _MODULE_BASES or
+                    dotted_name(b).endswith(".Module") for b in n.bases)]
+
+
+@register_rule
+class HardcodedDtype(Rule):
+    name = "hardcoded-dtype"
+    description = ("dtype literal in model/op code bypasses the precision "
+                   "plumbing (PrecisionConfig/cast_floating/cache_dtype) — "
+                   "string dtypes, jnp float scalar casts, or float dtype "
+                   "literals in nn.Module array creation")
+    include = ("dalle_tpu/models", "dalle_tpu/ops")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        defaults = _default_nodes(ctx.tree)
+
+        # 1 + 2: string dtype kwargs and jnp float scalar casts, anywhere
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or id(node) in defaults:
+                continue
+            str_dtype = None
+            for kw in node.keywords:
+                if kw.arg == "dtype" and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    str_dtype = kw.value.value
+            pos = _CREATORS_DTYPE_POS.get(dotted_name(node.func))
+            if str_dtype is None and pos is not None \
+                    and len(node.args) > pos \
+                    and isinstance(node.args[pos], ast.Constant) \
+                    and isinstance(node.args[pos].value, str):
+                str_dtype = node.args[pos].value     # positional string
+            if str_dtype is not None:
+                findings.append(Finding(
+                    self.name, ctx.rel_path, node.lineno,
+                    f'dtype="{str_dtype}" string literal — '
+                    "stringly-typed precision no config plumbing can "
+                    "see; thread the configured dtype object instead"))
+            fname = dotted_name(node.func)
+            if fname in _JNP_SCALAR_CTORS and node.args:
+                findings.append(Finding(
+                    self.name, ctx.rel_path, node.lineno,
+                    f"{fname}() scalar cast is STRONG-typed and pins its "
+                    "dtype regardless of the configured compute dtype — "
+                    "use a Python literal (weak) or the incoming array's "
+                    "dtype"))
+
+        # 3: float dtype literals in array creation inside nn.Module bodies
+        for cls in _module_class_nodes(ctx.tree):
+            for node in ast.walk(cls):
+                if not isinstance(node, ast.Call) or id(node) in defaults:
+                    continue
+                fname = dotted_name(node.func)
+                pos = _CREATORS_DTYPE_POS.get(fname)
+                if pos is None:
+                    continue
+                dt = None
+                for kw in node.keywords:
+                    if kw.arg == "dtype":
+                        dt = _float_dtype_literal(kw.value)
+                if dt is None and len(node.args) > pos:
+                    dt = _float_dtype_literal(node.args[pos])
+                if dt is not None:
+                    findings.append(Finding(
+                        self.name, ctx.rel_path, node.lineno,
+                        f"{fname}(..., {dt}) inside an nn.Module hard-pins "
+                        "a float dtype no precision mode can change — "
+                        "derive it from the input/config, or suppress with "
+                        "the why if the pin is deliberate"))
+        return findings

@@ -397,8 +397,10 @@ class TransformerLayer(nn.Module):
         # weak-typed param flips to strong after one pass through a jitted
         # step (outputs are strong), changing the input signature — every
         # train_step call then recompiles the whole program (graftlint
-        # weak-type-promotion; graftir caught this as a per-step retrace)
-        self.scale = self.param(
+        # weak-type-promotion; graftir caught this as a per-step retrace).
+        # The f32 pin is deliberate: params are created full-width by repo
+        # policy (precision modes cast derived trees, never initializers)
+        self.scale = self.param(  # graftlint: disable=hardcoded-dtype
             "scale", lambda k: jnp.full((1, 1, self.dim), eps, jnp.float32))
 
     def _post(self, y):

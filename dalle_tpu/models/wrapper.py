@@ -130,7 +130,7 @@ class DalleWithVae:
                            else jnp.bfloat16)
         return params, cache_dtype
 
-    def serve_engine(self, *, slots: int, precision: str = "float32",
+    def serve_engine(self, *, slots: int, precision: str = "int8w",
                      filter_thres: float = 0.5, temperature: float = 1.0,
                      topk_approx: bool = False, steps_per_sync: int = 1,
                      use_kernel=None):
@@ -138,7 +138,21 @@ class DalleWithVae:
         the serving-side sibling of ``generate_images``. ``slots`` is the
         fixed device batch; precision modes are the same fast paths
         (bf16 / bf16_int8kv / int8w reuse the wrapper's cached derived
-        params). The engine emits image TOKEN ids per completed request
+        params).
+
+        The DEFAULT is ``int8w``: int8 matmul kernels + int8 tied table
+        (per-channel scales, ops/quantize_weights.py) unified with the
+        int8 KV cache — decode is bandwidth-bound on exactly those two
+        streams, so this is the minimum-HBM serving configuration
+        (scripts/eval_decode_precisions.py reports the bytes-per-token
+        ledger). The quantized program is certified by the graftnum
+        precision audit (analysis/precision_flow.py; the serve_decode /
+        serve_refill graftir entries pin its boundary map), and per-request
+        tokens remain BIT-exact against same-precision single-request
+        generation (tests/test_serve.py). Pass ``precision="float32"`` for
+        the full-width engine.
+
+        The engine emits image TOKEN ids per completed request
         (``dalle_tpu.serve.CompletedRequest``); decode pixels with
         ``self.vae.decode(tokens[None])`` as needed — serving keeps the
         dVAE off the per-token critical path."""

@@ -55,6 +55,23 @@ def run_ir_audit_stage() -> int:
     return subprocess.run(cmd, cwd=ROOT).returncode
 
 
+def run_precision_audit_stage() -> int:
+    """The graftnum stage: trace every registered entry point and run the
+    precision-flow analysis (low-precision accumulation, int8 matmul
+    accumulator width, dequant scale discipline, double rounding, orphaned
+    scales — analysis/precision_flow.py). Findings name file::function and
+    fail the stage; waivers are '# graftir: allow=precision -- why' source
+    comments. The per-entry quantization boundary map + report land in
+    ./precision_artifacts — the dir ci.yml uploads alongside ir_artifacts
+    (scripts/precision_audit.py; the workflow's matching step is skipped
+    below)."""
+    cmd = [sys.executable, os.path.join(ROOT, "scripts",
+                                        "precision_audit.py"),
+           "--report", os.path.join(ROOT, "precision_artifacts")]
+    print(f"== [graftnum] {' '.join(cmd[1:])}")
+    return subprocess.run(cmd, cwd=ROOT).returncode
+
+
 def run_obs_smoke_stage() -> int:
     """The grafttrace + host-overlap smoke stage: a short synthetic traced
     fit (device prefetch + async checkpointing + deferred metrics ON) that
@@ -96,8 +113,21 @@ def main():
         print("ci_local: FAILED (lint stage) — test tiers not run")
         return 1
 
-    if run_ir_audit_stage() != 0:
+    rc = run_ir_audit_stage()
+    if rc == 3:
+        # the audit's distinct missing-golden code: a NEW entry point
+        # without a golden, not drift in any pinned program
+        print("ci_local: FAILED (graftir goldens MISSING — new entry "
+              "point? run scripts/ir_audit.py --update and commit) — "
+              "test tiers not run")
+        return 1
+    if rc != 0:
         print("ci_local: FAILED (graftir contract drift) — test tiers not run")
+        return 1
+
+    if run_precision_audit_stage() != 0:
+        print("ci_local: FAILED (graftnum precision findings) — test tiers "
+              "not run")
         return 1
 
     if run_obs_smoke_stage() != 0:
@@ -122,6 +152,9 @@ def main():
             continue
         if "scripts/ir_audit.py" in cmd:
             print(f"-- [skip] {name}: already run in the graftir stage")
+            continue
+        if "scripts/precision_audit.py" in cmd:
+            print(f"-- [skip] {name}: already run in the graftnum stage")
             continue
         if "scripts/obs_smoke.py" in cmd:
             print(f"-- [skip] {name}: already run in the obs smoke stage")

@@ -97,6 +97,72 @@ def train_rainbow(args, dataset=None):
     return dt.model, dt.state.params, text, codes, tr_idx
 
 
+def decode_hbm_bytes_per_token(cfg, mode: str) -> dict:
+    """Analytic decode HBM ledger, bytes per generated token at batch 1 —
+    the bandwidth-bound worst case AR decode lives in. Each token streams
+    every matmul kernel from HBM once (weights amortize over batch; the KV
+    read never does) plus the KV prefix at its average length. Counted:
+    the four per-layer kernels (qkv/out/w1/w2), the output head (tied
+    table or Dense kernel — same element count), the KV read at mean
+    prefix length, and the f32 per-channel scales int8 storage adds.
+    Excluded as noise: biases, layernorms, embedding gathers (one row per
+    token), KV writes (one position per token).
+
+    ``mode``: f32 | bf16 | bf16_int8kv | int8w_int8kv (the decode_modes
+    vocabulary; the fast-topk mode shares bf16_int8kv's bytes)."""
+    h, d, dim, depth = cfg.heads, cfg.dim_head, cfg.dim, cfg.depth
+    hd = h * d
+    mult = getattr(cfg, "ff_mult", 4)
+    total_tokens = (cfg.num_text_tokens + cfg.text_seq_len
+                    + cfg.image_vocab_size)
+    kernels = []
+    for _ in range(depth):
+        kernels += [(dim, 3 * hd), (hd, dim),
+                    (dim, dim * mult * 2), (dim * mult, dim)]
+    kernels.append((dim, total_tokens))           # head / tied table
+    w_el = sum(i * o for i, o in kernels)
+    w_scale_el = sum(o for _, o in kernels)       # per-output-channel f32
+
+    # mean attended prefix over the image band: bos + text + half the grid
+    avg_len = cfg.text_seq_len + 1 + cfg.image_seq_len / 2
+    kv_el = depth * 2 * hd * avg_len
+    kv_scale_el = depth * 2 * h * avg_len         # per-(h, pos) f32, int8
+
+    w_bytes = {"f32": 4, "bf16": 2, "bf16_int8kv": 2,
+               "int8w_int8kv": 1}[mode] * w_el
+    if mode == "int8w_int8kv":
+        w_bytes += 4 * w_scale_el
+    kv_bytes = {"f32": 4, "bf16": 2, "bf16_int8kv": 1,
+                "int8w_int8kv": 1}[mode] * kv_el
+    if mode in ("bf16_int8kv", "int8w_int8kv"):
+        kv_bytes += 4 * kv_scale_el
+    return {"weights_mb": round(w_bytes / 2**20, 2),
+            "kv_mb": round(kv_bytes / 2**20, 2),
+            "total_mb": round((w_bytes + kv_bytes) / 2**20, 2)}
+
+
+_LEDGER_MODE = {"f32": "f32", "bf16": "bf16", "bf16_int8kv": "bf16_int8kv",
+                "int8w_int8kv": "int8w_int8kv",
+                "int8kv_fast_topk": "bf16_int8kv"}
+
+
+def print_ledger(cfg, label: str):
+    rows = {}
+    base = None
+    for mode in ("f32", "bf16", "bf16_int8kv", "int8w_int8kv"):
+        led = decode_hbm_bytes_per_token(cfg, mode)
+        if base is None:
+            base = led["total_mb"]
+        led["vs_f32"] = round(base / led["total_mb"], 2)
+        rows[mode] = led
+        print(f"{mode:>14}: weights {led['weights_mb']:8.2f} MB/tok  "
+              f"kv {led['kv_mb']:7.2f} MB/tok  total {led['total_mb']:8.2f} "
+              f"MB/tok  ({led['vs_f32']}x less than f32)")
+    print(json.dumps({"metric": "decode_hbm_ledger", "config": label,
+                      "rows": rows}))
+    return rows
+
+
 def decode_modes(model, params):
     """[(name, decode_params, cache_dtype, topk_approx)] for every decode
     fast path."""
@@ -137,7 +203,20 @@ def main(argv=None):
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--small", action="store_true",
                     help="CPU-sized: 16px, fewer steps")
+    ap.add_argument("--ledger", action="store_true",
+                    help="print the analytic HBM-bytes-per-token ledger "
+                         "for the flagship serve config and exit (no "
+                         "training — the numbers docs/PERFORMANCE.md "
+                         "quotes)")
     args = ap.parse_args(argv)
+
+    if args.ledger:
+        from dalle_tpu.config import DalleConfig as _DC
+        flagship = _DC(num_text_tokens=49408, text_seq_len=256, dim=1792,
+                       depth=24, heads=14, dim_head=128, image_size=128,
+                       image_vocab_size=8192, image_fmap_size=16)
+        print_ledger(flagship, "flagship-1.4B (24L/14H/1792d, 256+256)")
+        return 0
     if args.small:
         args.image_size, args.num_tokens = 16, 32
         args.vae_steps, args.dalle_steps = 300, 500
@@ -167,10 +246,12 @@ def main(argv=None):
         # the axon tunnel can lie about block_until_ready: hard-sync
         float(jnp.sum(gen(p, t, key)))
         dt_ms = (time.perf_counter() - t0) / (args.timing_iters + 1) * 1e3
+        led = decode_hbm_bytes_per_token(model.cfg, _LEDGER_MODE[name])
         rows.append({"mode": name, "token_exact": round(acc, 4),
-                     "decode_ms": round(dt_ms, 1)})
+                     "decode_ms": round(dt_ms, 1),
+                     "hbm_mb_per_tok": led["total_mb"]})
         print(f"{name:>14}: token-exact {acc:.4f}  decode {dt_ms:.1f} ms "
-              f"(batch {len(sel)})")
+              f"(batch {len(sel)})  hbm {led['total_mb']} MB/tok")
 
     base = rows[0]["token_exact"]
     for r in rows:

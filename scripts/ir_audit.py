@@ -109,7 +109,21 @@ def main(argv=None):
                        for r in reports], fh, indent=1, sort_keys=True)
             fh.write("\n")
 
-    return 1 if any(r.failed for r in reports) else 0
+    # distinct exit codes so CI logs can tell the two failure classes
+    # apart: 1 = real contract drift (or waiver problems) — a regression;
+    # 3 = ONLY missing goldens — a new entry point that needs --update,
+    # not a change in any pinned program
+    drifted = [r for r in reports
+               if r.problems or any(k != "missing" for k in r.drift)]
+    missing = [r for r in reports if "missing" in r.drift]
+    if drifted:
+        return 1
+    if missing:
+        print(f"ir_audit: exit 3 — {len(missing)} golden(s) MISSING (new "
+              "entry point?), no drift in existing contracts; run "
+              "scripts/ir_audit.py --update and commit the new golden(s)")
+        return 3
+    return 0
 
 
 if __name__ == "__main__":

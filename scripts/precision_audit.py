@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""graftnum CLI — precision-flow audit over the registered graftir entries.
+
+    python scripts/precision_audit.py                 # CI gate
+    python scripts/precision_audit.py --entries serve_decode,serve_refill
+    python scripts/precision_audit.py --report precision_artifacts
+    python scripts/precision_audit.py --list-rules
+
+Traces every registered entry point (dalle_tpu/analysis/contracts.py — no
+compilation, this is the cheap half of the graftir pipeline) and runs the
+forward precision-flow analysis (dalle_tpu/analysis/precision_flow.py):
+low-precision accumulation in reductions, int8 matmuls without a full-width
+accumulator, dequantized values consumed without their scale, dequant
+scales on a contracted axis, double rounding, quantization-defeating
+upcasts, orphaned scales. Findings name their ``file::function`` site and
+fail the stage; a justified exception is a source waiver in the entry's
+source file, graftir-style::
+
+    # graftir: allow=precision -- <reason>
+
+``--report DIR`` writes ``report.txt`` plus ``boundary_map.json`` — the
+per-entry quantization boundary map (int8 matmul sites × accumulator
+dtypes, dequant sites × scale axes, value-class histogram) that ci.yml
+uploads alongside the ir_artifacts. The same boundary map is pinned as the
+``precision`` section of the contract goldens, so absolute safety lives
+here and drift lives in ``scripts/ir_audit.py --check``.
+
+The two stages DO each trace the entries (separate processes; jaxprs
+don't serialize across them). That duplication is deliberate: a drifted
+or missing golden must not block the safety audit and a rule finding must
+not mask a drift report — the gates fail independently with their own
+artifacts. Tracing is the cheap half of the graftir pipeline (the trainer
+COMPILES, which dominate ir_audit's wall clock, are not repeated here).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+# must run before jax initializes: entries trace on the 8-device virtual
+# CPU mesh (same environment as the test suite and ir_audit)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--entries", help="comma-separated subset of entries")
+    ap.add_argument("--report", metavar="DIR",
+                    help="write report.txt + boundary_map.json into DIR")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    import jax
+    jax.config.update("jax_default_matmul_precision", "float32")
+
+    from dalle_tpu.analysis import contracts as C
+    from dalle_tpu.analysis import ir_audit as A
+    from dalle_tpu.analysis import precision_flow as pf
+
+    if args.list_rules:
+        for rule in pf.PRECISION_RULES:
+            print(rule)
+        return 0
+
+    names = sorted(C.ENTRIES)
+    if args.entries:
+        names = [n.strip() for n in args.entries.split(",") if n.strip()]
+        unknown = [n for n in names if n not in C.ENTRIES]
+        if unknown:
+            sys.exit(f"precision_audit.py: unknown entries: "
+                     f"{', '.join(unknown)} (see ir_audit.py --list-entries)")
+
+    failures = 0
+    waived_count = 0
+    boundary_map = {}
+    lines = []
+    for name in names:
+        print(f"-- [trace] {name}", flush=True)
+        spec = C.ENTRIES[name]
+        built = spec.build()
+        rep = pf.analyze_fn(built.fn, built.args,
+                            roles=getattr(built, "roles", None))
+        boundary_map[name] = rep.boundary
+        waivers, _problems = A.collect_waivers(spec.source)
+        waiver = waivers.get("precision")
+        for f in rep.findings:
+            n = f" (x{f['count']})" if f.get("count", 1) > 1 else ""
+            line = (f"{name} ({spec.source}): [{f['rule']}] {f['site']}: "
+                    f"{f['detail']}{n}")
+            if waiver is not None:
+                lines.append(f"{line} [waived: {waiver.reason}]")
+                waived_count += 1
+            else:
+                lines.append(line)
+                failures += 1
+
+    scope = f"{len(names)} entr{'y' if len(names) == 1 else 'ies'}"
+    if failures:
+        lines.append(f"graftnum: {failures} precision finding(s) ({scope})")
+        lines.append("fix the site, or waive with "
+                     "'# graftir: allow=precision -- <reason>' in the "
+                     "entry's source file")
+    else:
+        extra = f", {waived_count} waived" if waived_count else ""
+        lines.append(f"graftnum: precision flow clean ({scope}{extra})")
+    text = "\n".join(lines)
+    print(text)
+
+    if args.report:
+        os.makedirs(args.report, exist_ok=True)
+        with open(os.path.join(args.report, "report.txt"), "w",
+                  encoding="utf-8") as fh:
+            fh.write(text + "\n")
+        with open(os.path.join(args.report, "boundary_map.json"), "w",
+                  encoding="utf-8") as fh:
+            json.dump(boundary_map, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

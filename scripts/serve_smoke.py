@@ -34,6 +34,12 @@ def main(argv=None):
     ap.add_argument("--slots", type=int, default=3)
     ap.add_argument("--n_requests", type=int, default=8)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--precision", choices=("int8w", "float32"),
+                    default="int8w",
+                    help="serving precision under test (default: the "
+                         "engine's int8-weights + int8-KV production "
+                         "default; references run the same mode, so the "
+                         "exactness bar stays bitwise)")
     args = ap.parse_args(argv)
     os.makedirs(args.outdir, exist_ok=True)
 
@@ -50,15 +56,25 @@ def main(argv=None):
                       heads=2, dim_head=32, image_size=16,
                       image_vocab_size=24, image_fmap_size=4)
     model, params = init_dalle(cfg, jax.random.PRNGKey(args.seed), batch=2)
+    if args.precision == "int8w":
+        # the serving default (DalleWithVae.serve_engine): int8 matmul
+        # kernels + per-channel scales, everything else bf16, int8 KV
+        from dalle_tpu.ops.quantize_weights import quantize_params_int8
+        params = quantize_params_int8(params)
+        cache_dtype = jnp.int8
+    else:
+        cache_dtype = jnp.float32
     rng = np.random.RandomState(args.seed)
     texts = [rng.randint(1, 20, (cfg.text_seq_len,)).astype(np.int32)
              for _ in range(args.n_requests)]
 
-    # sequential references, one per request under its own key
+    # sequential references, one per request under its own key — same
+    # params tree and cache dtype as the engine, so exactness is bitwise
     refs = {}
     for i, t in enumerate(texts):
         ids = model.apply(params, jnp.asarray(t[None]),
                           jax.random.PRNGKey(1000 + i),
+                          cache_dtype=cache_dtype,
                           method=DALLE.generate_images_tokens)
         refs[i] = np.asarray(ids[0])
 
@@ -77,7 +93,8 @@ def main(argv=None):
 
     th = threading.Thread(target=producer)
     th.start()
-    eng = DecodeEngine(model, params, slots=args.slots)
+    eng = DecodeEngine(model, params, slots=args.slots,
+                       cache_dtype=cache_dtype)
     t0 = time.perf_counter()
     done = eng.run(q)
     wall = time.perf_counter() - t0
@@ -125,6 +142,7 @@ def main(argv=None):
         os.path.join(args.outdir, "serve_spans.jsonl"))
     summary = {
         "requests": args.n_requests, "slots": args.slots,
+        "precision": args.precision,
         "wall_s": round(wall, 3), "steps": eng.stats.steps,
         "refills": eng.stats.refills,
         "occupancy_while_queued": round(occ, 4),

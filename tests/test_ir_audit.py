@@ -333,7 +333,8 @@ def test_audit_entry_waiver_suppresses_drift(tmp_path):
     _write(tmp_path, "src.py",
            "x = 1  # graftir: allow=promotions -- f32 logits on purpose\n"
            "# graftir: allow=primitives -- ditto\n"
-           "# graftir: allow=memory -- ditto\n")
+           "# graftir: allow=memory -- ditto\n"
+           "# graftir: allow=precision -- ditto (value classes move too)\n")
     report, _ = A.audit_entry("synth", _spec(tmp_path, _upcast_fn, src), cdir,
                               repo_root=str(tmp_path))
     assert not report.failed
@@ -366,9 +367,11 @@ def test_cli_check_update_explain_flows(tmp_path, monkeypatch):
     rdir = str(tmp_path / "report")
 
     assert cli.main(["--list-entries"]) == 0
-    # no golden yet: --check fails and the report artifact names the gap
+    # no golden yet: --check fails with the DISTINCT missing-golden code
+    # (3, not 1) so CI logs separate "new entry point needs --update" from
+    # a real regression; the report artifact still names the gap
     assert cli.main(["--check", "--contracts-dir", cdir,
-                     "--report", rdir]) == 1
+                     "--report", rdir]) == 3
     drift = json.load(open(os.path.join(rdir, "drift.json")))
     assert drift[0]["entry"] == "synth" and "missing" in drift[0]["drift"]
     assert cli.main(["--update", "--contracts-dir", cdir]) == 0
@@ -378,6 +381,46 @@ def test_cli_check_update_explain_flows(tmp_path, monkeypatch):
     assert cli.main(["--explain", "synth", "--contracts-dir", cdir]) == 0
     with pytest.raises(SystemExit, match="unknown entr"):
         cli.main(["--check", "--entries", "nope"])
+
+
+def test_cli_exit_codes_distinguish_missing_from_drift(tmp_path,
+                                                       monkeypatch, capsys):
+    """Acceptance for the CI-log contract: only-missing goldens exit 3 and
+    SAY so; any real drift exits 1 even when another entry is also
+    missing (a regression must never be soft-pedaled as 'new entry')."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import ir_audit as cli
+    finally:
+        sys.path.pop(0)
+    from dalle_tpu.analysis import contracts as C
+    _write(tmp_path, "src.py", "x = 1\n")
+    monkeypatch.setattr(A, "REPO_ROOT", str(tmp_path))
+    cdir = str(tmp_path / "contracts")
+
+    entries = {
+        "pinned": EntrySpec("pinned", "src.py",
+                            lambda: BuiltEntry(fn=_clean_fn,
+                                               args=(_X_BF16,)))}
+    monkeypatch.setattr(C, "ENTRIES", dict(entries))
+    assert cli.main(["--update", "--contracts-dir", cdir]) == 0
+
+    # add a second entry with no golden: exit 3, message names the way out
+    entries["fresh"] = EntrySpec("fresh", "src.py",
+                                 lambda: BuiltEntry(fn=_clean_fn,
+                                                    args=(_X_BF16,)))
+    monkeypatch.setattr(C, "ENTRIES", dict(entries))
+    capsys.readouterr()
+    assert cli.main(["--check", "--contracts-dir", cdir]) == 3
+    out = capsys.readouterr().out
+    assert "exit 3" in out and "MISSING" in out and "--update" in out
+
+    # now ALSO drift the pinned entry: the regression code wins
+    entries["pinned"] = EntrySpec("pinned", "src.py",
+                                  lambda: BuiltEntry(fn=_upcast_fn,
+                                                     args=(_X_BF16,)))
+    monkeypatch.setattr(C, "ENTRIES", dict(entries))
+    assert cli.main(["--check", "--contracts-dir", cdir]) == 1
 
 
 # ---------------------------------------------------------------------------

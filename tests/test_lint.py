@@ -664,6 +664,78 @@ def test_changed_files_follows_renames(tmp_path):
     assert "steady.py" in changed
 
 
+# ---------------------------------------------------------------------------
+# hardcoded-dtype
+# ---------------------------------------------------------------------------
+
+def test_hardcoded_dtype_flags_string_dtype_literal():
+    src = """
+    import jax.numpy as jnp
+    def f(x):
+        a = jnp.zeros((4,), dtype="bfloat16")
+        b = jnp.zeros((4,), "float32")        # positional: same bypass
+        return a + b
+    """
+    found = lint_source("hardcoded-dtype", src, "dalle_tpu/models/_f.py")
+    assert len(found) == 2
+    assert all("string literal" in f.message for f in found)
+
+
+def test_hardcoded_dtype_flags_jnp_scalar_cast():
+    src = """
+    import jax.numpy as jnp
+    def f(x):
+        return x * jnp.float32(0.5)
+    """
+    found = lint_source("hardcoded-dtype", src, "dalle_tpu/ops/_f.py")
+    assert len(found) == 1 and "STRONG-typed" in found[0].message
+
+
+def test_hardcoded_dtype_module_array_creation_and_exemptions():
+    src = """
+    import jax.numpy as jnp
+    import flax.linen as nn
+
+    def helper():
+        # float creation OUTSIDE an nn.Module: init-helper territory, exempt
+        return jnp.zeros((2,), jnp.float32)
+
+    class M(nn.Module):
+        def setup(self):
+            self.s = self.param("s", lambda k: jnp.full((1,), 0.1,
+                                                        jnp.float32))
+
+        def __call__(self, x, dtype=jnp.float32):
+            # signature default IS the config surface, exempt
+            ids = jnp.zeros((2,), jnp.int32)     # int dtype: not precision
+            return x + ids.sum()
+    """
+    found = lint_source("hardcoded-dtype", src, "dalle_tpu/models/_f.py")
+    assert len(found) == 1
+    assert "jnp.full" in found[0].message and "nn.Module" in found[0].message
+
+
+def test_hardcoded_dtype_suppression_and_scope():
+    src = """
+    import jax.numpy as jnp
+    import flax.linen as nn
+
+    class M(nn.Module):
+        def setup(self):
+            # deliberate f32 pin (weak-type retrace fix)
+            self.s = self.param(  # graftlint: disable=hardcoded-dtype
+                "s", lambda k: jnp.full((1,), 0.1, jnp.float32))
+    """
+    assert lint_source("hardcoded-dtype", src, "dalle_tpu/models/_f.py") == []
+    # out of scope: train/ applies precision via cast_floating, not flagged
+    src2 = """
+    import jax.numpy as jnp
+    def f(x):
+        return x * jnp.float32(0.5)
+    """
+    assert lint_source("hardcoded-dtype", src2, "dalle_tpu/train/_f.py") == []
+
+
 def test_project_rules_see_full_set_under_explicit_paths(tmp_path):
     # linting ONE file must not blind project rules to the rest of the tree
     (tmp_path / "dalle_tpu" / "ops").mkdir(parents=True)

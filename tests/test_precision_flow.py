@@ -1,0 +1,369 @@
+"""graftnum precision-flow analyzer (analysis/precision_flow.py): each
+quantization-safety rule on synthetic programs — injected hazards caught
+with named file::function sites — plus clean bills for the repo's real
+quantized decode/serve programs, boundary-map structure, role inference,
+the contract `precision` section diff, and the waiver path through
+scripts/precision_audit.py (the end-to-end acceptance bar: an int8
+dot_general without an f32 accumulator and a wrong-axis dequant scale are
+both caught through the audit pipeline)."""
+
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_tpu.analysis import ir_audit as A
+from dalle_tpu.analysis import precision_flow as pf
+from dalle_tpu.analysis.contracts import BuiltEntry, EntrySpec
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# tracing-only module (make_jaxpr, no jit compiles) — the budget covers
+# the eager dispatch of fixture-array construction
+pytestmark = pytest.mark.recompile_budget(120)
+
+X = jnp.zeros((4, 8), jnp.float32)
+Q = jnp.zeros((8, 16), jnp.int8)          # (in, out) int8 kernel
+S_OUT = jnp.zeros((1, 16), jnp.float32)   # per-output-channel scale (good)
+S_IN = jnp.zeros((8, 1), jnp.float32)     # per-input-channel scale (wrong)
+
+ROLES = [("activation", "x"), ("param", "q"), ("scale", "quant/s")]
+
+
+def _rules(report):
+    return sorted({f["rule"] for f in report.findings})
+
+
+# ---------------------------------------------------------------------------
+# the rules, one injected hazard each
+# ---------------------------------------------------------------------------
+
+def _qdense_like(x, q, s):
+    k = q.astype(x.dtype) * s.astype(x.dtype)
+    return jax.lax.dot_general(x, k, (((1,), (0,)), ((), ())))
+
+
+def test_clean_dequant_is_green_and_mapped():
+    rep = pf.analyze_fn(_qdense_like, (X, Q, S_OUT), roles=ROLES)
+    assert rep.findings == []
+    (ev,) = rep.boundary["dequants"]
+    assert ev["scale_axes"] == "1" and ev["dst"] == "float32"
+    assert "test_precision_flow.py::_qdense_like" in ev["site"]
+    assert rep.boundary["int8_dots"] == []
+    # class_counts histograms eqn OUTPUTS: the dequant convert + scale
+    # multiply land in f32 here
+    assert rep.boundary["class_counts"]["f32"] >= 2
+
+
+def test_wrong_axis_dequant_scale_caught_with_site():
+    rep = pf.analyze_fn(_qdense_like, (X, Q, S_IN), roles=ROLES)
+    (f,) = [f for f in rep.findings if f["rule"] == "dequant-scale-axis"]
+    assert "test_precision_flow.py::_qdense_like" in f["site"]
+    assert "contracted axis" in f["detail"]
+
+
+def test_int8_dot_without_f32_accum_caught_with_site():
+    def bad(x8, q):
+        return jax.lax.dot_general(x8, q, (((1,), (0,)), ((), ())))
+
+    x8 = jnp.zeros((4, 8), jnp.int8)
+    rep = pf.analyze_fn(bad, (x8, Q))
+    (f,) = [f for f in rep.findings if f["rule"] == "int8-dot-accum"]
+    assert "test_precision_flow.py::bad" in f["site"]
+    assert rep.boundary["int8_dots"] == [
+        {"site": f["site"], "accum": "none", "count": 1}]
+
+    def good(x8, q):
+        return jax.lax.dot_general(x8, q, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+
+    rep = pf.analyze_fn(good, (x8, Q))
+    assert rep.findings == []
+    assert rep.boundary["int8_dots"][0]["accum"] == "float32"
+
+
+def test_unscaled_dequant_reaching_matmul_caught():
+    def bad(x, q):
+        return x @ q.astype(x.dtype)     # int8 kernel cast without scale
+
+    rep = pf.analyze_fn(bad, (X, Q), roles=ROLES[:2])
+    assert _rules(rep) == ["unscaled-dequant"]
+
+
+def test_arbitrary_multiply_does_not_count_as_the_scale():
+    """A dropout/attention-mask multiply between the int8 convert and the
+    matmul must NOT silence unscaled-dequant — only a value with scale
+    EVIDENCE (seeded scale provenance or an amax-derived chain) completes
+    the dequant, and a later true scale-mul still can."""
+    mask = jnp.zeros((8, 16), jnp.float32)
+    roles = ROLES + [("activation", "mask")]
+
+    def refactor_bug(x, q, s, mask):
+        del s                            # the scale multiply was dropped
+        return x @ (q.astype(x.dtype) * mask)
+
+    rep = pf.analyze_fn(refactor_bug, (X, Q, S_OUT, mask), roles=roles)
+    assert {"unscaled-dequant", "orphaned-scale"} <= set(_rules(rep))
+
+    def masked_then_scaled(x, q, s, mask):
+        return x @ ((q.astype(x.dtype) * mask) * s)
+
+    rep = pf.analyze_fn(masked_then_scaled, (X, Q, S_OUT, mask), roles=roles)
+    assert rep.findings == [] and rep.boundary["dequants"]
+
+    def in_program_scale(x, q):
+        # amax-derived scale with no input provenance (the KV-cache
+        # _quantize_int8 shape) IS evidence
+        scale = jnp.max(jnp.abs(x)) / 127.0
+        return x @ (q.astype(x.dtype) * scale)
+
+    rep = pf.analyze_fn(in_program_scale, (X, Q), roles=ROLES[:2])
+    assert rep.findings == []
+
+
+def test_double_rounding_caught():
+    def bad(q, s):
+        deq = q.astype(jnp.bfloat16) * s.astype(jnp.bfloat16)
+        return deq.astype(jnp.int8)      # requantize without rescaling
+
+    rep = pf.analyze_fn(bad, (Q, S_OUT), roles=ROLES[1:])
+    assert "double-rounding" in _rules(rep)
+
+
+def test_quant_upcast_flagged_only_when_a_matmul_consumes_it():
+    def bad(x, q, s):
+        k = q.astype(jnp.bfloat16) * s.astype(jnp.bfloat16)
+        return x @ k.astype(jnp.float32)   # dequant materializes at f32
+
+    rep = pf.analyze_fn(bad, (X, Q, S_OUT), roles=ROLES)
+    assert "quant-upcast" in _rules(rep)
+
+    def benign(q, s):
+        # a norm/stat-style f32 upcast of a dequantized value is REQUIRED
+        # by the reduction rule, not a hazard — no matmul consumes it
+        k = q.astype(jnp.bfloat16) * s.astype(jnp.bfloat16)
+        return jnp.mean(k.astype(jnp.float32))
+
+    rep = pf.analyze_fn(benign, (Q, S_OUT), roles=ROLES[1:])
+    assert rep.findings == []
+
+
+def test_low_precision_reduction_caught_and_jnp_sum_is_safe():
+    def bad(x):
+        return jax.lax.reduce_sum_p.bind(x.astype(jnp.bfloat16),
+                                         axes=(0, 1))
+
+    rep = pf.analyze_fn(bad, (X,))
+    (f,) = rep.findings
+    assert f["rule"] == "low-precision-reduction"
+    assert "test_precision_flow.py::bad" in f["site"]
+
+    def safe(x):
+        # jnp.sum upcasts its accumulator to f32 on half-width inputs —
+        # the idiomatic path is green by construction
+        return jnp.sum(x.astype(jnp.bfloat16))
+
+    assert pf.analyze_fn(safe, (X,)).findings == []
+
+
+def test_orphaned_scale_caught():
+    def bad(x, q, s):
+        del s
+        return x @ q.astype(x.dtype)
+
+    rep = pf.analyze_fn(bad, (X, Q, S_OUT), roles=ROLES)
+    (f,) = [f for f in rep.findings if f["rule"] == "orphaned-scale"]
+    assert "quant/s" in f["detail"]
+
+
+def test_dequant_inside_scan_body_still_tracked():
+    def scanned(x, q, s):
+        def body(c, _):
+            k = q.astype(c.dtype) * s.astype(c.dtype)
+            return c @ k, None
+        y, _ = jax.lax.scan(body, jnp.zeros((4, 16), jnp.float32)[:, :8]
+                            @ jnp.zeros((8, 8), jnp.float32), None, length=2)
+        return y
+
+    q = jnp.zeros((8, 8), jnp.int8)
+    s = jnp.zeros((1, 8), jnp.float32)
+    rep = pf.analyze_fn(scanned, (X, q, s), roles=ROLES)
+    assert rep.findings == []
+    assert any("::body" in e["site"] for e in rep.boundary["dequants"])
+
+
+# ---------------------------------------------------------------------------
+# role inference
+# ---------------------------------------------------------------------------
+
+def test_infer_roles_labels_quant_scales_params_and_cache():
+    from dalle_tpu.ops.attention import KVCache
+    args = ({"params": {"dense": {"kernel": Q, "scale": X}},
+             "quant": {"dense": {"kernel_scale": S_OUT}}},
+            {"cache": {"kv_0": KVCache.init(2, 2, 8, 4, jnp.int8)}},
+            X)
+    roles = pf.infer_roles(args)
+    by_label = {label: role for role, label in roles}
+    assert by_label["0/params/dense/kernel"] == "param"
+    # a PARAM named 'scale' (layerscale/layernorm) is not a quant scale
+    assert by_label["0/params/dense/scale"] == "param"
+    assert by_label["0/quant/dense/kernel_scale"] == "scale"
+    kv_roles = {label: role for role, label in roles if "kv_0" in label}
+    assert set(kv_roles.values()) == {"kv", "scale"}
+    assert by_label["2"] == "activation"
+
+
+# ---------------------------------------------------------------------------
+# the repo's real quantized programs are green
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_quantized():
+    from dalle_tpu.config import DalleConfig
+    from dalle_tpu.models.dalle import init_dalle
+    from dalle_tpu.ops.quantize_weights import quantize_params_int8
+    cfg = DalleConfig(num_text_tokens=32, text_seq_len=6, dim=32, depth=2,
+                      heads=2, dim_head=16, image_size=16,
+                      image_vocab_size=24, image_fmap_size=4)
+    model, params = init_dalle(cfg, jax.random.PRNGKey(0))
+    return model, quantize_params_int8(params)
+
+
+def test_quantized_generate_program_is_green(tiny_quantized):
+    from dalle_tpu.models.dalle import DALLE
+    model, qv = tiny_quantized
+
+    def gen(p, text, key):
+        return model.apply(p, text, key, cache_dtype=jnp.int8,
+                           method=DALLE.generate_images_tokens)
+
+    rep = pf.analyze_fn(gen, (qv, jnp.zeros((2, 6), jnp.int32),
+                              jax.random.PRNGKey(0)))
+    assert rep.findings == []
+    sites = {e["site"] for e in rep.boundary["dequants"]}
+    assert "dalle_tpu/ops/quantize_weights.py::__call__" in sites
+    assert "dalle_tpu/ops/attention.py::read_kv" in sites
+
+
+def test_serve_engine_default_programs_are_green(tiny_quantized):
+    from dalle_tpu.serve.engine import DecodeEngine
+    model, qv = tiny_quantized
+    eng = DecodeEngine(model, qv, slots=2, cache_dtype=jnp.int8)
+    rep = pf.analyze_fn(eng._multi_step, (eng.params, eng._init_state()))
+    assert rep.findings == []
+    assert rep.boundary["dequants"]
+    texts = jnp.zeros((2, eng.text_seq_len), jnp.int32)
+    rep = pf.analyze_fn(
+        eng._refill, (eng.params, eng._init_state(), texts,
+                      jnp.zeros((2,), jnp.int32),
+                      jnp.full((2,), eng.n_steps, jnp.int32),
+                      jnp.ones((2,), bool)))
+    assert rep.findings == []
+
+
+# ---------------------------------------------------------------------------
+# contract integration: the `precision` section + drift
+# ---------------------------------------------------------------------------
+
+def test_contract_carries_precision_section_and_diffs():
+    built_good = BuiltEntry(fn=_qdense_like, args=(X, Q, S_OUT), roles=ROLES)
+    golden = A.build_contract("t", built_good)
+    assert golden["precision"]["dequants"]
+    assert golden["schema"] == A.SCHEMA
+
+    def with_int8_dot(x, q, s):
+        y = _qdense_like(x, q, s)
+        x8 = jnp.round(jnp.clip(x, -1, 1) * 127).astype(jnp.int8)
+        return y + jax.lax.dot_general(
+            x8, q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    live = A.build_contract("t", BuiltEntry(fn=with_int8_dot,
+                                            args=(X, Q, S_OUT), roles=ROLES))
+    drift = A.diff_contracts(golden, live)
+    assert "precision" in drift
+    text = "\n".join(drift["precision"])
+    assert "int8 dot" in text and "with_int8_dot" in text
+    # and the diff is empty on itself
+    assert A.diff_contracts(live, live) == {}
+
+
+def test_explain_renders_precision_section():
+    live = A.build_contract("t", BuiltEntry(fn=_qdense_like,
+                                            args=(X, Q, S_OUT), roles=ROLES))
+    text = A.explain(live)
+    assert "precision:" in text
+    assert "dequant ->float32 (scale axes 1)" in text
+
+
+def test_registry_goldens_all_have_precision_section():
+    from dalle_tpu.analysis import contracts as C
+    cdir = os.path.join(REPO, "contracts")
+    for name in C.ENTRIES:
+        golden = A.load_contract(A.contract_path(cdir, name))
+        assert golden is not None, name
+        prec = golden.get("precision")
+        assert prec and prec.get("class_counts"), name
+    # the quantized serve/generate entries pin a NON-empty boundary map —
+    # the int8-weights serving default is certified, not assumed
+    for name in ("serve_decode", "serve_refill",
+                 "generate_images_tokens_int8w"):
+        golden = A.load_contract(A.contract_path(cdir, name))
+        assert golden["precision"]["dequants"], name
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: the precision_audit CLI catches injected hazards + waivers
+# ---------------------------------------------------------------------------
+
+def _audit_cli():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import precision_audit as cli
+    finally:
+        sys.path.pop(0)
+    return cli
+
+
+def test_precision_audit_cli_catches_injected_hazards(tmp_path, monkeypatch,
+                                                      capsys):
+    cli = _audit_cli()
+    from dalle_tpu.analysis import contracts as C
+
+    def bad_fn(x8, q, s):
+        bad_dot = jax.lax.dot_general(x8, q, (((1,), (0,)), ((), ())))
+        wrong = q.astype(jnp.float32) * s.astype(jnp.float32)
+        return bad_dot.astype(jnp.float32) + jax.lax.dot_general(
+            jnp.zeros((4, 8), jnp.float32), wrong, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    x8 = jnp.zeros((4, 8), jnp.int8)
+    src = tmp_path / "bad_entry.py"
+    src.write_text("x = 1\n")
+    monkeypatch.setattr(C, "ENTRIES", {
+        "bad": EntrySpec("bad", "bad_entry.py", lambda: BuiltEntry(
+            fn=bad_fn, args=(x8, Q, S_IN),
+            roles=[("activation", "x8"), ("param", "q"), ("scale", "s")]))})
+    monkeypatch.setattr(A, "REPO_ROOT", str(tmp_path))
+
+    rdir = str(tmp_path / "art")
+    assert cli.main(["--report", rdir]) == 1
+    out = capsys.readouterr().out
+    assert "[int8-dot-accum]" in out and "[dequant-scale-axis]" in out
+    assert "test_precision_flow.py::bad_fn" in out     # named site
+    bm = json.load(open(os.path.join(rdir, "boundary_map.json")))
+    assert bm["bad"]["int8_dots"]
+
+    # a reasoned waiver in the entry's source file turns the gate green
+    src.write_text("x = 1  # graftir: allow=precision -- fixture hazard\n")
+    assert cli.main(["--report", rdir]) == 0
+    out = capsys.readouterr().out
+    assert "[waived: fixture hazard]" in out
+
+    with pytest.raises(SystemExit, match="unknown entries"):
+        cli.main(["--entries", "nope"])
+    assert cli.main(["--list-rules"]) == 0

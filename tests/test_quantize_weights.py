@@ -67,6 +67,57 @@ def test_quantize_params_does_not_mutate_source():
     assert v["params"]["kernel"].dtype == jnp.float32
 
 
+def test_zero_variance_rows_produce_safe_nonzero_scales():
+    """All-zero and all-equal channels: amax hits 0 (or one value) and a
+    naive amax/127 scale would be 0 — dividing by it NaNs the whole
+    kernel. The 1e-8 floor must keep every scale strictly positive, the
+    roundtrip finite, and exact values exactly representable."""
+    w = np.zeros((64, 8), np.float32)
+    w[:, 1] = 0.25                       # zero-variance nonzero channel
+    w[:, 2] = -3.0
+    q, scale = quantize_kernel_int8(jnp.asarray(w), axis=0)
+    scale = np.asarray(scale)
+    assert (scale > 0).all()             # the floor, not a zero scale
+    deq = np.asarray(q, np.float32) * scale
+    assert np.isfinite(deq).all()
+    np.testing.assert_array_equal(deq[:, 0], 0.0)          # zeros exact
+    np.testing.assert_allclose(deq[:, 1], 0.25, rtol=1e-6)  # ±127 exact
+    np.testing.assert_allclose(deq[:, 2], -3.0, rtol=1e-6)
+    # row-wise (shared_emb) flavor of the same edge
+    q, scale = quantize_kernel_int8(jnp.zeros((4, 16)), axis=1)
+    assert (np.asarray(scale) > 0).all() and scale.shape == (4, 1)
+    np.testing.assert_array_equal(np.asarray(q), 0)
+
+
+@pytest.mark.parametrize("shape", [(100, 37), (7, 129), (130, 128)])
+def test_non_multiple_dims_roundtrip_error_bounded(shape):
+    """Vocab/feature dims off the 128-lane grid (ragged tokenizers, odd
+    heads) must quantize with the same per-element error bound as aligned
+    shapes — no padding assumption hides in the math."""
+    rng = np.random.RandomState(3)
+    w = jnp.asarray(rng.standard_normal(shape) * 0.2, jnp.float32)
+    for axis in (0, 1):
+        q, scale = quantize_kernel_int8(w, axis=axis)
+        want = [1, 1]
+        want[1 - axis] = shape[1 - axis]
+        assert scale.shape == tuple(want)
+        deq = q.astype(jnp.float32) * scale
+        # symmetric rounding: error ≤ scale/2 per element, every element
+        assert float(jnp.max(jnp.abs(deq - w) / scale)) <= 0.5 + 1e-6
+
+
+def test_qdense_non_multiple_features_end_to_end():
+    x = jnp.asarray(np.random.RandomState(4).rand(3, 37), jnp.float32)
+    m = QDense(29)
+    v = m.init(jax.random.PRNGKey(0), x)
+    out_f = m.apply(v, x)
+    qv = quantize_params_int8(v, compute_dtype=None)
+    assert qv["params"]["kernel"].shape == (37, 29)
+    out_q = m.apply(qv, x)
+    err = float(jnp.max(jnp.abs(out_f - out_q)))
+    assert err < 0.02 * max(float(jnp.max(jnp.abs(out_f))), 1.0)
+
+
 def test_qdense_int8_without_scales_raises():
     x = jnp.ones((2, 8))
     m = QDense(4)

@@ -16,12 +16,12 @@ from dalle_tpu.config import DalleConfig
 from dalle_tpu.models.dalle import DALLE, init_dalle
 from dalle_tpu.serve import DecodeEngine, RequestQueue, SlotScheduler
 
-# ceiling = the module's cold full-run total (measured 625) + ~15% slack
-# for cross-jax-version compile-count variance (the test_speculative
-# convention). Each engine instance compiles its own refill+step pair; an
-# engine change that recompiles per admission or per slot count would blow
-# straight through this.
-pytestmark = pytest.mark.recompile_budget(725)
+# ceiling = the module's cold full-run total (measured 722 with the int8w
+# default-path matrix) + ~15% slack for cross-jax-version compile-count
+# variance (the test_speculative convention). Each engine instance compiles
+# its own refill+step pair; an engine change that recompiles per admission
+# or per slot count would blow straight through this.
+pytestmark = pytest.mark.recompile_budget(830)
 
 CFG = dict(num_text_tokens=32, text_seq_len=6, dim=32, depth=2, heads=2,
            dim_head=16, image_size=16, image_vocab_size=24, image_fmap_size=4)
@@ -186,6 +186,71 @@ def test_engine_int8_cache_exact(model_params):
     q.close()
     eng = DecodeEngine(model, bf16, slots=2, cache_dtype=jnp.int8,
                        topk_approx=True, temperature=0.5)
+    for c in eng.run(q):
+        np.testing.assert_array_equal(c.tokens, refs[c.request_id])
+
+
+def test_engine_int8w_default_exact_bulk_and_trickle(model_params):
+    """int8 weights + int8 KV — the serve-engine DEFAULT since the
+    precision-flow audit certified it: tokens stay bit-exact vs same-mode
+    single-request generation through BOTH admission paths. slots=2 with a
+    closed 4-deep queue forces bulk refill windows; slots=3 with ragged
+    per-request lengths staggers completions through the per-row trickle
+    scatter-prefill."""
+    from dalle_tpu.ops.quantize_weights import quantize_params_int8
+    model, params = model_params
+    qv = quantize_params_int8(params)
+    refs = {i: _reference(model, qv, t, 300 + i, cache_dtype=jnp.int8)
+            for i, t in enumerate(TEXTS)}
+
+    # bulk: every admission covers >= half the slots -> refill window
+    q = RequestQueue()
+    for i, t in enumerate(TEXTS[:4]):
+        q.submit(t, seed=300 + i, request_id=i)
+    q.close()
+    eng = DecodeEngine(model, qv, slots=2, cache_dtype=jnp.int8)
+    for c in eng.run(q):
+        np.testing.assert_array_equal(c.tokens, refs[c.request_id])
+
+    # trickle: ragged lengths free slots one at a time mid-flight
+    lens = [16, 3, 9, 1, 12]
+    q = RequestQueue()
+    for i, t in enumerate(TEXTS):
+        q.submit(t, seed=300 + i, request_id=i, max_tokens=lens[i])
+    q.close()
+    eng = DecodeEngine(model, qv, slots=3, cache_dtype=jnp.int8)
+    done = eng.run(q)
+    assert sorted(c.request_id for c in done) == list(range(5))
+    for c in done:
+        assert c.tokens.shape == (lens[c.request_id],)
+        np.testing.assert_array_equal(c.tokens,
+                                      refs[c.request_id][:lens[c.request_id]])
+
+
+def test_wrapper_serve_engine_defaults_to_int8w(model_params):
+    """DalleWithVae.serve_engine() with no precision argument builds the
+    int8-weights + int8-KV engine from the wrapper's cached derived tree,
+    and its requests match the wrapper-mode sequential reference exactly."""
+    from dalle_tpu.models.wrapper import DalleWithVae
+    model, params = model_params
+    dv = DalleWithVae(model, params, None)   # vae unused on the token path
+    eng = dv.serve_engine(slots=2)
+    assert eng.cache_dtype == jnp.int8
+    assert "quant" in eng.params             # per-channel scales present
+    int8_leaves = [l for l in jax.tree_util.tree_leaves(eng.params["params"])
+                   if hasattr(l, "dtype") and l.dtype == jnp.int8]
+    assert int8_leaves
+    # the derived tree is the wrapper's cached int8w mode — a second engine
+    # must reuse it, not re-quantize
+    assert dv.serve_engine(slots=2).params is eng.params
+
+    refs = {i: _reference(model, eng.params, t, 500 + i,
+                          cache_dtype=jnp.int8)
+            for i, t in enumerate(TEXTS[:2])}
+    q = RequestQueue()
+    for i, t in enumerate(TEXTS[:2]):
+        q.submit(t, seed=500 + i, request_id=i)
+    q.close()
     for c in eng.run(q):
         np.testing.assert_array_equal(c.tokens, refs[c.request_id])
 
