@@ -251,6 +251,26 @@ def _build_serve_decode() -> BuiltEntry:
                       donated=_tree_leaves(state), compile=True)
 
 
+@register_entry("serve_decode_aot", "dalle_tpu/gateway/aot.py")
+def _build_serve_decode_aot() -> BuiltEntry:
+    # the program gateway/aot.py EXPORTS for replica cold-start: the
+    # production gateway configuration (int8w like _engine, but
+    # steps_per_sync=4 — the K-step scan the serve_gateway CLI ships).
+    # Pinning it through the aot module's own aval builder means a change
+    # to what the export lowers (not just to the engine) drifts this
+    # contract before stale AOT bundles can ship.
+    import jax.numpy as jnp
+    from ..gateway.aot import _program_args
+    from ..ops.quantize_weights import quantize_params_int8
+    from ..serve.engine import DecodeEngine
+    model, params = _dalle_model()
+    eng = DecodeEngine(model, quantize_params_int8(params), slots=4,
+                       cache_dtype=jnp.int8, steps_per_sync=4)
+    args = _program_args(eng)["step"]
+    return BuiltEntry(fn=eng._step_fn, args=args,
+                      donated=_tree_leaves(args[1]), compile=True)
+
+
 @register_entry("serve_refill", "dalle_tpu/serve/engine.py")
 def _build_serve_refill() -> BuiltEntry:
     import jax.numpy as jnp

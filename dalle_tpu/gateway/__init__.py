@@ -1,0 +1,37 @@
+"""Serving gateway: HTTP/SSE front end + replica fleet over dalle_tpu/serve.
+
+The network layer the continuous-batching engine was missing — the
+user-facing half of the paper's flow, grown to multi-tenant production
+shape:
+
+  * ``server.Gateway`` — stdlib HTTP server: submit/stream (SSE grid rows
+    as the engine commits them), /healthz, /metrics (Prometheus);
+  * ``admission`` — per-tenant token-bucket quotas + SLO-aware rejection
+    (predicted-miss requests get 429 + Retry-After, not a queue slot);
+  * ``replica``/``router`` — health-checked replicas, least-backlog
+    dispatch, deterministic mid-stream failover, graceful drain;
+  * ``aot`` — serialized engine executables so a cold replica serves
+    without retracing or recompiling (plus the persistent XLA compile
+    cache for everything else).
+
+Scheduling policy (priority/deadline/shedding) lives serve-side
+(``dalle_tpu.serve.PolicyQueue``); this package only decides WHAT enters a
+queue and WHERE. See docs/SERVING.md.
+"""
+
+from .admission import (AdmissionController, Decision, SloEstimator,
+                        TenantQuotas, TokenBucket)
+from .aot import (enable_compilation_cache, engine_fingerprint,
+                  load_engine_aot, save_engine_aot)
+from .replica import Replica, ReplicaFailure, ResultStream
+from .router import NoReplicaAvailable, ReplicaRouter, RoutedStream
+from .server import Gateway
+from .sse import RowPixelDecoder, iter_sse, sse_event
+
+__all__ = [
+    "AdmissionController", "Decision", "SloEstimator", "TenantQuotas",
+    "TokenBucket", "enable_compilation_cache", "engine_fingerprint",
+    "load_engine_aot", "save_engine_aot", "Replica", "ReplicaFailure",
+    "ResultStream", "NoReplicaAvailable", "ReplicaRouter", "RoutedStream",
+    "Gateway", "RowPixelDecoder", "iter_sse", "sse_event",
+]

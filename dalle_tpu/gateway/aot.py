@@ -1,0 +1,188 @@
+"""AOT-serialized engine executables: replica cold-start without retracing.
+
+Autoscaling under a traffic spike is only real if a new replica reaches
+"serving" in seconds. A fresh ``DecodeEngine`` pays trace + XLA compile for
+its three device programs (step scan, bulk refill window, per-row
+scatter-prefill) on first dispatch — minutes at flagship scale. This module
+exports those programs ONCE (``jax.jit(...).lower(...).compile()`` +
+``jax.experimental.serialize_executable``) and lets a cold replica load the
+serialized executables straight into the engine
+(``DecodeEngine.install_executables``): zero trace, zero compile, asserted
+in CI via the backend-compile counter (scripts/gateway_smoke.py).
+
+An executable is only valid for the exact program it was compiled from, so
+the bundle carries a FINGERPRINT — model config, slot count, cache dtype,
+sampling knobs, param avals, jax version, backend platform and device count
+— and ``load_engine_aot`` refuses a mismatch (fall back to jit, never run a
+wrong program). The fingerprinted step program is additionally pinned as
+the ``serve_decode_aot`` graftir contract entry, so a refactor that changes
+what the export lowers fails CI before it ships stale bundles.
+
+Two layers of cold-start speedup compose here:
+
+  * this module — skips trace AND compile for the engine's own programs;
+  * the persistent XLA compilation cache (``enable_compilation_cache`` /
+    ``scripts/_common.add_compile_cache_args``) — skips compile (not trace)
+    for EVERYTHING else the process jits, across processes and restarts.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+from typing import Optional
+
+# re-exported because the persistent cache is the second half of the
+# cold-start story this module owns (docs/SERVING.md); the implementation
+# is provider-neutral jax plumbing and lives with the other generic utils
+# so train CLIs don't import the gateway package for it
+from ..utils.misc import enable_compilation_cache  # noqa: F401
+
+PROGRAMS = ("step", "refill", "refill_row")
+_BUNDLE = "programs.pkl"
+_MANIFEST = "manifest.json"
+
+
+def _aval_digest(tree) -> str:
+    """Order-stable digest of a pytree's (path, shape, dtype) leaves — the
+    part of the fingerprint that catches a changed param tree (different
+    depth/width/quantization) without hashing gigabytes of weights."""
+    import jax
+    rows = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        rows.append((jax.tree_util.keystr(path), tuple(leaf.shape),
+                     str(leaf.dtype)))
+    return hashlib.sha256(repr(sorted(rows)).encode()).hexdigest()
+
+
+def engine_fingerprint(engine) -> dict:
+    """Everything that determines the engine's compiled programs. Two
+    engines with equal fingerprints compile byte-identical programs; a
+    bundle loads iff fingerprints match exactly."""
+    import jax
+    return {
+        "jax_version": jax.__version__,
+        "platform": jax.devices()[0].platform,
+        "device_count": jax.device_count(),
+        "model_cfg": engine.model.cfg.to_dict(),
+        "slots": engine.slots,
+        "cache_dtype": str(engine.cache_dtype.__name__
+                           if hasattr(engine.cache_dtype, "__name__")
+                           else engine.cache_dtype),
+        "steps_per_sync": engine.steps_per_sync,
+        "filter_thres": engine.filter_thres,
+        "temperature": engine.temperature,
+        "topk_approx": engine.topk_approx,
+        "use_kernel": engine.use_kernel,
+        "param_avals": _aval_digest(engine.params),
+    }
+
+
+def _program_args(engine):
+    """Abstract (ShapeDtypeStruct) call signatures for the three engine
+    programs — the avals the host loop passes at every dispatch. Built via
+    ``jax.eval_shape`` so export never allocates a second KV cache."""
+    import jax
+    import jax.numpy as jnp
+    params = jax.tree.map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), engine.params)
+    state = jax.eval_shape(engine._init_state)
+    B, T = engine.slots, engine.text_seq_len
+    i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)  # noqa: E731
+    return {
+        "step": (params, state),
+        "refill": (params, state, i32(B, T), i32(B),
+                   i32(B), jax.ShapeDtypeStruct((B,), jnp.bool_)),
+        "refill_row": (params, state, i32(1, T), i32(), i32(), i32()),
+    }
+
+
+def step_lowering(engine):
+    """The exact lowering the export serializes for the decode-step scan —
+    exposed so the graftir ``serve_decode_aot`` entry pins the same program
+    this module ships (analysis/contracts.py)."""
+    return engine._step_fn.lower(*_program_args(engine)["step"])
+
+
+def save_engine_aot(engine, out_dir: str) -> dict:
+    """Compile and serialize the engine's three device programs into
+    ``out_dir`` (``programs.pkl`` + ``manifest.json``). Returns the
+    manifest. Run this on ANY machine with the target topology (the
+    exporter pays the compile, cold replicas don't)."""
+    from jax.experimental.serialize_executable import serialize
+    if engine.aot_loaded:
+        # a loaded executable can't be re-lowered; exporting must start
+        # from a jit engine so the bundle is compiled fresh for this config
+        raise ValueError("cannot export from an AOT-loaded engine; build a "
+                         "fresh DecodeEngine and export that")
+    os.makedirs(out_dir, exist_ok=True)
+    args = _program_args(engine)
+    fns = {"step": engine._step_fn, "refill": engine._refill_fn,
+           "refill_row": engine._refill_row_fn}
+    bundle = {}
+    for name in PROGRAMS:
+        compiled = fns[name].lower(*args[name]).compile()
+        payload, in_tree, out_tree = serialize(compiled)
+        bundle[name] = (payload, in_tree, out_tree)
+    manifest = {"fingerprint": engine_fingerprint(engine),
+                "programs": list(PROGRAMS),
+                "payload_bytes": {n: len(bundle[n][0]) for n in PROGRAMS}}
+    with open(os.path.join(out_dir, _BUNDLE), "wb") as fh:
+        pickle.dump(bundle, fh)
+    tmp = os.path.join(out_dir, _MANIFEST + ".tmp")
+    with open(tmp, "w") as fh:
+        json.dump(manifest, fh, indent=2)
+    os.replace(tmp, os.path.join(out_dir, _MANIFEST))
+    return manifest
+
+
+def fingerprint_mismatch(engine, aot_dir: str) -> Optional[str]:
+    """None when the bundle under ``aot_dir`` matches ``engine``; otherwise
+    a human-readable first-divergence description (missing bundle counts)."""
+    path = os.path.join(aot_dir, _MANIFEST)
+    if not os.path.exists(path):
+        return f"no AOT manifest at {path}"
+    with open(path) as fh:
+        saved = json.load(fh).get("fingerprint", {})
+    live = engine_fingerprint(engine)
+    for key in sorted(set(saved) | set(live)):
+        if saved.get(key) != live.get(key):
+            return (f"fingerprint mismatch on {key!r}: "
+                    f"bundle={saved.get(key)!r} engine={live.get(key)!r}")
+    return None
+
+
+def load_engine_aot(engine, aot_dir: str, *, strict: bool = False) -> bool:
+    """Install the serialized executables from ``aot_dir`` into ``engine``.
+    Returns True on success; on fingerprint mismatch returns False (the
+    engine keeps its jit path — correct, just cold) or raises when
+    ``strict``. Loading performs NO trace and NO backend compile — the
+    gateway smoke pins that with a compile-counter delta of zero across a
+    served request."""
+    from jax.experimental.serialize_executable import deserialize_and_load
+    from ..obs import counter_add
+    reason = fingerprint_mismatch(engine, aot_dir)
+    if reason is not None:
+        if strict:
+            raise ValueError(f"refusing AOT bundle {aot_dir}: {reason}")
+        # fall back to jit loudly: a silently-cold replica looks healthy
+        # but pays the full retrace — the one thing the operator deployed
+        # the bundle to avoid (classic cause: --aot_export run with
+        # different fleet flags than serving, e.g. --slots)
+        import warnings
+        warnings.warn(f"AOT bundle {aot_dir} refused ({reason}); "
+                      "falling back to jit (cold start pays full "
+                      "trace+compile)", stacklevel=2)
+        counter_add("gateway.aot_miss_total", 1.0)
+        return False
+    with open(os.path.join(aot_dir, _BUNDLE), "rb") as fh:
+        bundle = pickle.load(fh)
+    loaded = {name: deserialize_and_load(*bundle[name]) for name in PROGRAMS}
+    engine.install_executables(step=loaded["step"], refill=loaded["refill"],
+                               refill_row=loaded["refill_row"])
+    counter_add("gateway.aot_load_total", 1.0)
+    return True
+
+

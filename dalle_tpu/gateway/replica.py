@@ -1,0 +1,235 @@
+"""One serving replica: a decode engine + policy queue + worker thread.
+
+A replica is the unit of capacity and of failure. It owns a
+``DecodeEngine`` (optionally cold-started from an AOT bundle — see
+gateway/aot.py), a ``PolicyQueue`` feeding it, and the single worker thread
+running ``engine.run``. Every submitted request gets a ``ResultStream`` —
+a small thread-safe event pipe the engine callbacks feed (rows, completion)
+and an HTTP handler drains from its own thread; the engine thread never
+blocks on a slow consumer (``put`` is unbounded, events are token-row
+sized).
+
+Failure semantics: if the worker thread dies (a device error, a poisoned
+request — simulated in tests via ``fail_after_rows``), the replica marks
+itself unhealthy and every in-flight AND still-queued request's stream gets
+a terminal ``replica_failed`` event. The router (gateway/router.py) turns
+that into failover: per-request seeds make regeneration deterministic, so a
+resubmitted stream's rows are bit-identical and the client never sees the
+crash — only the rows it hasn't received yet.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import threading
+from typing import Callable, List, Optional, Tuple
+
+from ..obs import counter_add, gauge_set
+from ..serve.queue import Request
+from ..serve.scheduler import PolicyQueue, SchedulingPolicy
+
+Event = Tuple[str, object]     # ("row"|"done"|"shed"|"replica_failed", ...)
+
+_ids = itertools.count()
+
+
+class ReplicaFailure(RuntimeError):
+    """Injected worker failure (tests / chaos): the worker thread treats it
+    like any other crash — unhealthy replica, failover events."""
+
+
+class ResultStream:
+    """Per-request event pipe: engine thread puts, consumer thread gets.
+    Terminal events: ``done``, ``shed``, ``replica_failed``."""
+
+    TERMINAL = ("done", "shed", "replica_failed")
+
+    def __init__(self, request: Optional[Request]):
+        self.request = request
+        self._q: _queue.Queue = _queue.Queue()
+
+    def put(self, kind: str, payload=None) -> None:
+        self._q.put((kind, payload))
+
+    def events(self, timeout: Optional[float] = 30.0, still_alive=None):
+        """Yield events until a terminal one (inclusive). ``timeout``
+        between events guards a consumer against a WEDGED replica —
+        surfaced as ``replica_failed`` so the router's failover path
+        handles both identically. ``still_alive`` (a callable) refines
+        that: while it returns True the wait just continues, because a
+        healthy replica with a deep backlog legitimately produces no
+        events for a long time, and declaring it failed would resubmit
+        work that is still queued — doubling offered load exactly when
+        the system is backlogged (the metastable-overload failure mode)."""
+        while True:
+            try:
+                kind, payload = self._q.get(timeout=timeout)
+            except _queue.Empty:
+                if still_alive is not None and still_alive():
+                    continue
+                yield ("replica_failed", "event timeout")
+                return
+            yield (kind, payload)
+            if kind in self.TERMINAL:
+                return
+
+
+class Replica:
+    """``start()`` → serving; ``submit`` → ResultStream; ``drain()`` →
+    graceful stop (finish queued + in-flight work, then the worker exits).
+    """
+
+    def __init__(self, engine, *, replica_id: Optional[str] = None,
+                 maxsize: Optional[int] = None,
+                 policy: Optional[SchedulingPolicy] = None,
+                 aot_dir: Optional[str] = None,
+                 on_served: Optional[Callable] = None):
+        self.replica_id = (replica_id if replica_id is not None
+                           else f"replica-{next(_ids)}")
+        self.engine = engine
+        self.aot_loaded = False
+        if aot_dir is not None:
+            from .aot import load_engine_aot
+            self.aot_loaded = load_engine_aot(engine, aot_dir)
+        self.queue = PolicyQueue(maxsize=maxsize, policy=policy,
+                                 on_shed=self._on_shed)
+        self.on_served = on_served
+        self._streams: dict = {}            # request_id -> ResultStream
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self.failed: Optional[BaseException] = None
+        self._fail_after_rows: Optional[int] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "Replica":
+        assert self._thread is None, "replica already started"
+        self._thread = threading.Thread(target=self._work,
+                                        name=self.replica_id, daemon=True)
+        self._thread.start()
+        return self
+
+    def _work(self):
+        try:
+            self.engine.run(self.queue, on_complete=self._on_complete,
+                            on_rows=self._on_rows)
+        except BaseException as exc:  # noqa: BLE001 - any worker death is a
+            # replica failure; the fleet (not this thread) decides what's
+            # recoverable, so classify nothing here and fail the streams
+            self.failed = exc
+            counter_add("gateway.replica_failures_total", 1.0)
+            try:
+                self.queue.close()
+            except Exception:  # noqa: BLE001 - already-closed race is fine
+                pass
+            with self._lock:
+                streams = list(self._streams.values())
+                self._streams.clear()
+            for s in streams:
+                s.put("replica_failed", repr(exc))
+
+    @property
+    def healthy(self) -> bool:
+        return (self._thread is not None and self._thread.is_alive()
+                and self.failed is None)
+
+    @property
+    def draining(self) -> bool:
+        return self.queue.closed
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Graceful: no new submissions; queued + in-flight requests finish
+        and their streams complete; then the worker thread exits."""
+        self.queue.close()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    # -- load --------------------------------------------------------------
+    @property
+    def queue_depth(self) -> int:
+        return self.queue.qsize()
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return len(self._streams)
+
+    @property
+    def load(self) -> int:
+        """Dispatch metric for the router: everything accepted and not yet
+        completed. A stream is registered at submit and removed at
+        completion/shed/failure, so ``inflight`` counts queued AND in-slot
+        requests — exactly the backlog a new request would wait behind."""
+        return self.inflight
+
+    # -- submission --------------------------------------------------------
+    def submit(self, text, seed: int, *, max_tokens: Optional[int] = None,
+               tenant: str = "default", priority: int = 0,
+               deadline_at: Optional[float] = None) -> ResultStream:
+        if not self.healthy:
+            raise ReplicaFailure(f"{self.replica_id} is not serving")
+        # register the stream BEFORE the request becomes takeable: the
+        # engine thread polls every ~20ms, so a post-submit registration
+        # races a fast completion whose events would be dropped. _lock
+        # serializes this replica's submitters, so the reserved id is ours.
+        with self._lock:
+            rid = self.queue.next_request_id
+            stream = ResultStream(None)
+            self._streams[rid] = stream
+        try:
+            req = self.queue.submit(text, seed, request_id=rid,
+                                    max_tokens=max_tokens, tenant=tenant,
+                                    priority=priority,
+                                    deadline_at=deadline_at)
+        except BaseException:  # noqa: BLE001 - re-raised; the pre-registered
+            # stream must be unwound for ANY submit failure (incl.
+            # KeyboardInterrupt) or the id leaks a dead stream entry
+            with self._lock:
+                self._streams.pop(rid, None)
+            raise
+        stream.request = req
+        return stream
+
+    # -- engine callbacks (engine thread) ----------------------------------
+    def _stream_for(self, request_id: int,
+                    pop: bool = False) -> Optional[ResultStream]:
+        with self._lock:
+            if pop:
+                return self._streams.pop(request_id, None)
+            return self._streams.get(request_id)
+
+    def _on_rows(self, req: Request, row: int, tokens: List[int]) -> None:
+        if self._fail_after_rows is not None:
+            self._fail_after_rows -= 1
+            if self._fail_after_rows < 0:
+                raise ReplicaFailure(
+                    f"injected failure on {self.replica_id}")
+        s = self._stream_for(req.request_id)
+        if s is not None:
+            s.put("row", (row, list(tokens)))
+
+    def _on_complete(self, cr) -> None:
+        s = self._stream_for(cr.request_id, pop=True)
+        if self.on_served is not None:
+            self.on_served(cr)
+        if s is not None:
+            s.put("done", cr)
+
+    def _on_shed(self, req: Request) -> None:
+        counter_add("gateway.shed_total", 1.0)
+        s = self._stream_for(req.request_id, pop=True)
+        if s is not None:
+            s.put("shed", req)
+
+    # -- chaos hook (tests / smoke) ----------------------------------------
+    def fail_after_rows(self, n: int) -> None:
+        """Kill the worker after ``n`` more streamed rows — deterministic
+        mid-stream replica death for failover tests."""
+        self._fail_after_rows = int(n)
+
+    def health(self) -> dict:
+        return {"replica_id": self.replica_id, "healthy": self.healthy,
+                "draining": self.draining, "queue_depth": self.queue_depth,
+                "inflight": self.inflight, "aot_loaded": self.aot_loaded,
+                "shed_total": self.queue.shed_total,
+                "error": repr(self.failed) if self.failed else None}

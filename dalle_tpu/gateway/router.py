@@ -1,0 +1,181 @@
+"""Replica router: health-checked, queue-depth-aware dispatch + failover.
+
+The router is the fleet's one policy point: every admitted request is
+dispatched to the healthy replica with the least backlog (queued +
+in-slot — join-the-shortest-queue, the right greedy under homogeneous
+replicas), overflowing to the next-best when a bounded queue rejects. On a
+mid-stream replica death it resubmits the request — same text, same seed —
+to another replica and splices the two streams: generation is deterministic
+per seed, so the resumed stream's rows are bit-identical and the router
+simply skips rows the client already has. Failover is therefore EXACT, not
+best-effort; the only client-visible artifact is added latency.
+
+``drain()`` is the graceful-shutdown half: stop accepting (the gateway
+returns 503), let every replica finish its queued + in-flight work, join
+the workers.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from typing import List, Optional
+
+from ..obs import counter_add, gauge_set
+from ..serve.queue import QueueFull
+from .replica import Replica, ReplicaFailure, ResultStream
+
+_gids = itertools.count()
+
+
+class NoReplicaAvailable(RuntimeError):
+    """No healthy replica could accept the request (all dead or all full)."""
+
+
+class RoutedStream:
+    """A request's event stream across failovers. Yields normalized,
+    JSON-ready events:
+
+      ("row",  {"row": r, "tokens": [...]})
+      ("done", {"tokens": [...], "ttft_s": .., "latency_s": ..,
+                "replica": id, "failovers": n})
+      ("error",{"reason": "deadline_shed" | "replica_failed", "detail": ..})
+
+    Rows repeat after a failover (the replacement replica regenerates from
+    token 0); the stream suppresses every row below the high-water mark, so
+    consumers see each row exactly once and in order."""
+
+    def __init__(self, router: "ReplicaRouter", stream: ResultStream,
+                 replica: Replica, submit_kwargs: dict, gateway_id: int):
+        self.router = router
+        self.gateway_id = gateway_id
+        self._stream = stream
+        self._replica = replica
+        self._kw = submit_kwargs
+        self.failovers = 0
+
+    @property
+    def replica_id(self) -> str:
+        return self._replica.replica_id
+
+    def events(self, timeout: Optional[float] = 30.0):
+        next_row = 0
+        while True:
+            for kind, payload in self._stream.events(
+                    timeout=timeout,
+                    # a quiet stream on a HEALTHY replica is backlog, not
+                    # failure: keep waiting instead of resubmitting work
+                    # that is still queued (duplicate-load spiral)
+                    still_alive=lambda: self._replica.healthy):
+                if kind == "row":
+                    row, tokens = payload
+                    if row < next_row:
+                        continue           # already delivered pre-failover
+                    next_row = row + 1
+                    yield ("row", {"row": row, "tokens": tokens})
+                elif kind == "done":
+                    yield ("done", {
+                        "tokens": [int(t) for t in payload.tokens],
+                        "ttft_s": payload.ttft_s,
+                        "latency_s": payload.latency_s,
+                        "replica": self._replica.replica_id,
+                        "failovers": self.failovers})
+                    return
+                elif kind == "shed":
+                    yield ("error", {"reason": "deadline_shed",
+                                     "detail": "deadline passed while "
+                                               "queued; request shed"})
+                    return
+                else:                      # replica_failed
+                    counter_add("gateway.failovers_total", 1.0)
+                    self.failovers += 1
+                    if self.failovers > len(self.router.replicas):
+                        # failover budget: a request that has killed (or
+                        # been failed by) more replicas than the fleet has
+                        # is itself the likely poison — stop resubmitting
+                        # it before it takes the whole fleet down again
+                        yield ("error", {"reason": "replica_failed",
+                                         "detail": "failover budget "
+                                                   "exhausted"})
+                        return
+                    try:
+                        self._replica, self._stream = \
+                            self.router._dispatch(**self._kw)
+                    except (NoReplicaAvailable, QueueFull) as exc:
+                        yield ("error", {"reason": "replica_failed",
+                                         "detail": f"no failover target: "
+                                                   f"{exc}"})
+                        return
+                    break                  # re-enter on the new stream
+            else:
+                return
+
+
+class ReplicaRouter:
+    def __init__(self, replicas: List[Replica]):
+        assert replicas
+        self.replicas = list(replicas)
+        self.draining = False
+
+    # -- fleet state -------------------------------------------------------
+    def healthy_replicas(self) -> List[Replica]:
+        return [r for r in self.replicas if r.healthy]
+
+    def health(self) -> dict:
+        rows = [r.health() for r in self.replicas]
+        healthy = sum(1 for r in rows if r["healthy"])
+        gauge_set("gateway.replicas_healthy", float(healthy))
+        return {"status": ("draining" if self.draining else
+                           "ok" if healthy else "unavailable"),
+                "replicas": rows}
+
+    @property
+    def total_backlog(self) -> int:
+        return sum(r.load for r in self.healthy_replicas())
+
+    # -- dispatch ----------------------------------------------------------
+    def _dispatch(self, **submit_kwargs):
+        """(replica, stream) on the least-loaded healthy replica, walking
+        the load order on QueueFull; raises when the fleet is exhausted."""
+        candidates = sorted(self.healthy_replicas(), key=lambda r: r.load)
+        if not candidates:
+            raise NoReplicaAvailable("no healthy replicas")
+        last: Optional[BaseException] = None
+        for replica in candidates:
+            try:
+                return replica, replica.submit(**submit_kwargs)
+            except RuntimeError as exc:
+                # QueueFull, ReplicaFailure and a closed queue (racing
+                # drain) are all RuntimeErrors → try next-best; anything
+                # escaping here would drop the client connection instead
+                # of a clean 429/503
+                last = exc
+        raise last if isinstance(last, QueueFull) else \
+            NoReplicaAvailable(repr(last))
+
+    def submit(self, text, seed: int, *, max_tokens: Optional[int] = None,
+               tenant: str = "default", priority: int = 0,
+               deadline_s: Optional[float] = None) -> RoutedStream:
+        """Dispatch one request; raises QueueFull / NoReplicaAvailable when
+        nothing can take it (the gateway maps those to 429/503)."""
+        if self.draining:
+            raise NoReplicaAvailable("gateway is draining")
+        deadline_at = (time.perf_counter() + deadline_s
+                       if deadline_s is not None else None)
+        kw = dict(text=text, seed=seed, max_tokens=max_tokens,
+                  tenant=tenant, priority=priority, deadline_at=deadline_at)
+        replica, stream = self._dispatch(**kw)
+        return RoutedStream(self, stream, replica, kw, next(_gids))
+
+    # -- shutdown ----------------------------------------------------------
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Graceful: stop accepting, finish all accepted work, join all
+        workers."""
+        self.draining = True
+        for r in self.replicas:
+            try:
+                r.queue.close()
+            except Exception:  # noqa: BLE001 - double-close race is fine
+                pass
+        for r in self.replicas:
+            r.drain(timeout=timeout)

@@ -1,0 +1,275 @@
+"""The HTTP/SSE gateway: stdlib-only network front end for the fleet.
+
+``http.server.ThreadingHTTPServer`` — one thread per connection, no new
+dependency — in front of admission control (gateway/admission.py), the
+replica router (gateway/router.py) and the SSE encoder (gateway/sse.py).
+This is the paper's user-facing flow (PAPER.md L7 ``sampler.py``) grown
+into a multi-tenant service: submit, watch rows stream, get the exact
+token sequence single-request generation would have produced.
+
+API (docs/SERVING.md is the operator guide):
+
+  POST /v1/generate     JSON body: {"text": [token ids...], "seed": int,
+                        "max_tokens"?, "tenant"?, "priority"?,
+                        "deadline_s"?, "stream"?: bool, "pixels"?: bool}
+      stream=false → 200 JSON {request_id, tokens, ttft_s, latency_s, ...}
+      stream=true  → 200 text/event-stream of row/done/error events
+                     (gateway/sse.py wire format; pixels=true adds dVAE
+                     preview bands per row when the gateway has a VAE)
+      429 {"error": "quota" | "slo" | "queue_full"} (+ Retry-After)
+      503 {"error": "draining" | "no_replica"}
+  GET /healthz          200/503 JSON fleet health (per-replica rows)
+  GET /metrics          Prometheus text exposition of the obs registry
+                        (same content the textfile exporter writes)
+
+Deliberate scope: token ids in, token ids/pixel previews out. Tokenization
+(BPE assets) and full-image PNG encoding stay client-side — the gateway's
+job is scheduling and streaming, not asset management.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from ..obs import counter_add, gauge_set, metrics_snapshot, render_textfile
+from ..serve.queue import QueueFull
+from .admission import AdmissionController
+from .router import NoReplicaAvailable, ReplicaRouter
+from .sse import RowPixelDecoder, sse_event
+
+
+class Gateway:
+    """Binds the HTTP server to a router + admission controller. ``port=0``
+    picks an ephemeral port (tests/smoke run loopback). ``vae`` enables
+    per-row pixel previews for ``"pixels": true`` requests."""
+
+    def __init__(self, router: ReplicaRouter,
+                 admission: Optional[AdmissionController] = None, *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 vae=None, image_fmap_size: Optional[int] = None,
+                 image_seq_len: Optional[int] = None):
+        self.router = router
+        self.admission = (admission if admission is not None
+                          else AdmissionController())
+        self.vae = vae
+        self.image_fmap_size = image_fmap_size
+        # per-request token demand for SLO math: the full grid unless the
+        # request caps max_tokens
+        eng = router.replicas[0].engine
+        self.image_seq_len = (image_seq_len if image_seq_len is not None
+                              else eng.n_steps)
+        if self.image_fmap_size is None:
+            self.image_fmap_size = eng.row_len
+        self._inflight = 0
+        self._lock = threading.Lock()
+        handler = _make_handler(self)
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd.daemon_threads = True
+        self._serve_thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def address(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "Gateway":
+        assert self._serve_thread is None
+        self._serve_thread = threading.Thread(
+            target=self.httpd.serve_forever, name="gateway-http",
+            kwargs={"poll_interval": 0.05}, daemon=True)
+        self._serve_thread.start()
+        return self
+
+    def shutdown(self, *, drain: bool = True,
+                 timeout: Optional[float] = None) -> None:
+        """Graceful by default: refuse new work (503), finish accepted
+        work, then stop the listener."""
+        self.router.draining = True
+        if drain:
+            self.router.drain(timeout=timeout)
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._serve_thread is not None:
+            self._serve_thread.join(timeout=5)
+
+    # -- accounting --------------------------------------------------------
+    def _enter(self):
+        with self._lock:
+            self._inflight += 1
+            gauge_set("gateway.inflight", float(self._inflight))
+
+    def _exit(self):
+        with self._lock:
+            self._inflight -= 1
+            gauge_set("gateway.inflight", float(self._inflight))
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+
+def _make_handler(gw: Gateway):
+    class Handler(BaseHTTPRequestHandler):
+        # HTTP/1.0 + connection close ends the SSE stream at EOF — no
+        # chunked-encoding bookkeeping, and every stdlib/curl client
+        # handles it
+        protocol_version = "HTTP/1.0"
+
+        def log_message(self, fmt, *args):   # quiet: obs carries the signal
+            pass
+
+        # -- helpers -------------------------------------------------------
+        def _json(self, code: int, payload: dict, headers=()):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in headers:
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        # -- routes --------------------------------------------------------
+        def do_GET(self):
+            if self.path == "/healthz":
+                health = gw.router.health()
+                health["inflight"] = gw.inflight
+                code = 200 if health["status"] == "ok" else 503
+                self._json(code, health)
+            elif self.path == "/metrics":
+                gauge_set("gateway.inflight", float(gw.inflight))
+                body = render_textfile(metrics_snapshot()).encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self._json(404, {"error": "not_found", "path": self.path})
+
+        def do_POST(self):
+            if self.path != "/v1/generate":
+                self._json(404, {"error": "not_found", "path": self.path})
+                return
+            counter_add("gateway.requests_total", 1.0)
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                # validate the full request surface HERE: anything invalid
+                # must come back as a 400, never escape as an unhandled
+                # handler exception (dropped connection) — and absolutely
+                # never reach the engine thread, where a bad value (e.g.
+                # an out-of-int32 seed) would kill the replica worker and
+                # ride failover across the fleet
+                text = np.asarray(body["text"], np.int32)
+                if text.ndim != 1:
+                    raise ValueError(f"text must be a flat list of token "
+                                     f"ids, got shape {text.shape}")
+                seed = int(body["seed"])
+                if not (-2**31 <= seed < 2**31):
+                    raise ValueError(f"seed must fit int32, got {seed}")
+                max_tokens = body.get("max_tokens")
+                if max_tokens is not None:
+                    max_tokens = int(max_tokens)
+                    if max_tokens < 1:
+                        raise ValueError(
+                            f"max_tokens must be >= 1, got {max_tokens}")
+                deadline_s = body.get("deadline_s")
+                if deadline_s is not None:
+                    deadline_s = float(deadline_s)
+            except (KeyError, TypeError, ValueError, OverflowError) as exc:
+                self._json(400, {"error": "bad_request",
+                                 "detail": repr(exc)})
+                return
+            tenant = str(body.get("tenant", "default"))
+            req_tokens = (int(max_tokens) if max_tokens
+                          else gw.image_seq_len)
+
+            decision = gw.admission.decide(
+                tenant, request_tokens=req_tokens,
+                queued_tokens=gw.router.total_backlog * gw.image_seq_len,
+                deadline_s=deadline_s)
+            if not decision.admit:
+                headers = []
+                if decision.retry_after_s is not None:
+                    headers.append(("Retry-After",
+                                    f"{decision.retry_after_s:.3f}"))
+                self._json(429, {"error": decision.reason,
+                                 "tenant": tenant,
+                                 "predicted_completion_s":
+                                     decision.predicted_completion_s},
+                           headers)
+                return
+
+            gw._enter()
+            try:
+                try:
+                    routed = gw.router.submit(
+                        text, seed, max_tokens=max_tokens, tenant=tenant,
+                        priority=int(body.get("priority", 0)),
+                        deadline_s=deadline_s)
+                except QueueFull as exc:
+                    gw.admission.reject(tenant, "queue_full")
+                    self._json(429, {"error": "queue_full",
+                                     "detail": str(exc)},
+                               [("Retry-After", "0.5")])
+                    return
+                except NoReplicaAvailable as exc:
+                    self._json(503, {"error": "draining" if
+                                     gw.router.draining else "no_replica",
+                                     "detail": str(exc)})
+                    return
+                if body.get("stream", False):
+                    self._stream(routed, bool(body.get("pixels", False)))
+                else:
+                    self._blocking(routed)
+            finally:
+                gw._exit()
+
+        def _blocking(self, routed):
+            for kind, payload in routed.events():
+                if kind == "done":
+                    self._json(200, {"request_id": routed.gateway_id,
+                                     **payload})
+                    return
+                if kind == "error":
+                    code = 504 if payload["reason"] == "deadline_shed" \
+                        else 503
+                    self._json(code, payload)
+                    return
+            self._json(500, {"error": "stream_ended_without_result"})
+
+        def _stream(self, routed, pixels: bool):
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.end_headers()
+            decoder = None
+            if pixels and gw.vae is not None:
+                decoder = RowPixelDecoder(gw.vae, gw.image_fmap_size)
+            rid = routed.gateway_id
+            try:
+                for kind, payload in routed.events():
+                    data = {"request_id": rid, **payload}
+                    if kind == "row" and decoder is not None:
+                        # pixel preview decoded HERE, on the connection
+                        # thread — never the engine thread
+                        data.update(decoder.row_event(
+                            rid, payload["row"], payload["tokens"]))
+                    self.wfile.write(sse_event(kind, data))
+                    self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                counter_add("gateway.client_disconnects_total", 1.0)
+            finally:
+                if decoder is not None:
+                    decoder.finish(rid)
+
+    return Handler

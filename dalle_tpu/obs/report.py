@@ -126,6 +126,43 @@ def checkpoint_accounting(metrics: List[dict]) -> Optional[dict]:
             "fraction": total / (run_s + total) if run_s + total > 0 else 0.0}
 
 
+def gateway_accounting(metrics: List[dict],
+                       spans: List[dict]) -> Optional[dict]:
+    """Gateway admission/serving health from the obs registry snapshot the
+    smoke/CLI writes into the metrics JSONL (``gateway.inflight``, the
+    reject counters) plus per-request queue-wait spans. ``None`` when no
+    record carries a gateway key — training runs keep their report
+    unchanged. The verdict: ADMISSION-LIMITED when the gateway turned
+    traffic away (rejects/sheds — capacity, quota or SLO pressure),
+    admitting otherwise."""
+    gw_rows = [r for r in metrics
+               if any(k.startswith("gateway.") for k in r)]
+    if not gw_rows:
+        return None
+    last = gw_rows[-1]
+    by_tenant = {}
+    for key, val in last.items():
+        if (key.startswith("gateway.") and key.endswith(".rejected_total")):
+            tenant = key[len("gateway."):-len(".rejected_total")]
+            if tenant:            # "gateway.rejected_total" is the fleet sum
+                by_tenant[tenant] = int(val)
+    qwaits = sorted(float(s["dur_s"]) for s in spans
+                    if s.get("name") == "serve/request_queue_wait")
+    rejected = float(last.get("gateway.rejected_total", 0))
+    shed = float(last.get("gateway.shed_total", 0))
+    return {
+        "inflight": float(last.get("gateway.inflight", 0)),
+        "rejected": rejected,
+        "by_tenant": by_tenant,
+        "shed": shed,
+        "failovers": float(last.get("gateway.failovers_total", 0)),
+        "qwait_p50_s": percentile(qwaits, 0.5) if qwaits else None,
+        "qwait_p95_s": percentile(qwaits, 0.95) if qwaits else None,
+        "verdict": ("ADMISSION-LIMITED" if rejected + shed > 0
+                    else "admitting"),
+    }
+
+
 def format_report(rows: List[dict], *, topk: int = 10) -> str:
     spans, metrics = split_rows(rows)
     lines: List[str] = []
@@ -199,6 +236,20 @@ def format_report(rows: List[dict], *, topk: int = 10) -> str:
         if any(r.get("mfu_estimated") for r in metrics):
             lines.append("== NOTE: mfu is ESTIMATED (unknown accelerator "
                          "peak-flops — see train/metrics.py PEAK_TFLOPS)")
+        gw = gateway_accounting(metrics, spans)
+        if gw is not None:
+            lines.append(
+                f"== gateway: inflight={gw['inflight']:.0f} "
+                f"rejected={gw['rejected']:.0f}"
+                + (f" (by tenant: {gw['by_tenant']})" if gw["by_tenant"]
+                   else "")
+                + (f" shed={gw['shed']:.0f}" if gw["shed"] else "")
+                + (f" failovers={gw['failovers']:.0f}" if gw["failovers"]
+                   else "")
+                + (f"; queue wait p50={gw['qwait_p50_s']:.4g}s "
+                   f"p95={gw['qwait_p95_s']:.4g}s"
+                   if gw["qwait_p50_s"] is not None else "")
+                + f" → {gw['verdict']}")
     if spans:
         lines.append(f"== spans by total time ({len(spans)} spans)")
         lines.append(f"  {'name':<32}{'count':>7}{'total_s':>10}{'mean_s':>10}"

@@ -1,15 +1,19 @@
 """Continuous-batching serving for DALLE image generation.
 
-``RequestQueue`` (host FIFO) → ``SlotScheduler`` (slot ↔ request
-bookkeeping) → ``DecodeEngine`` (the device loop: B shared-cache decode
-slots, per-row lengths/offsets/RNG lanes, iteration-level refill). See
-docs/PERFORMANCE.md ("Serving") and scripts/serve_bench.py /
+``RequestQueue`` (host FIFO, optionally bounded) → ``SlotScheduler`` (slot ↔
+request bookkeeping) → ``DecodeEngine`` (the device loop: B shared-cache
+decode slots, per-row lengths/offsets/RNG lanes, iteration-level refill).
+``PolicyQueue`` layers priority/deadline scheduling and deadline shedding on
+top for the gateway (FIFO stays the default). See docs/PERFORMANCE.md
+("Serving"), docs/SERVING.md (gateway) and scripts/serve_bench.py /
 scripts/serve_smoke.py.
 """
 
 from .engine import DecodeEngine, EngineStats
-from .queue import CompletedRequest, Request, RequestQueue
-from .scheduler import SlotScheduler
+from .queue import CompletedRequest, QueueFull, Request, RequestQueue
+from .scheduler import (FifoPolicy, PolicyQueue, PriorityDeadlinePolicy,
+                        SchedulingPolicy, SlotScheduler)
 
-__all__ = ["DecodeEngine", "EngineStats", "CompletedRequest", "Request",
-           "RequestQueue", "SlotScheduler"]
+__all__ = ["DecodeEngine", "EngineStats", "CompletedRequest", "QueueFull",
+           "Request", "RequestQueue", "SlotScheduler", "SchedulingPolicy",
+           "FifoPolicy", "PriorityDeadlinePolicy", "PolicyQueue"]

@@ -70,6 +70,53 @@ class EngineStats:
         return self.occupancy_sum / self.occupancy_n
 
 
+# jitted program sharing across engines (the PR 5 jit_step precedent,
+# serve-side): two engines over the SAME model object with equal program
+# config compile byte-identical programs, so a replica fleet on one host —
+# and every test building engines off one module fixture — should pay
+# trace+compile ONCE, not once per engine. Keyed by id(model) + the
+# program-shaping knobs; params stay CALL arguments, so f32/bf16/int8 param
+# trees ride one cache entry via jax's own per-aval retrace. The cached
+# closures bind a lightweight STAND-IN (the program-shaping attrs + model,
+# nothing else) rather than the first engine — binding the engine would pin
+# its whole param tree for the life of the process (GBs stranded on every
+# checkpoint hot-swap). The stand-in pins the model, so id(model) keys
+# never go stale; the cache is process-lifetime by design, bounded by
+# distinct (model, config) pairs.
+_PROGRAMS: Dict[int, Dict[tuple, tuple]] = {}
+
+# every attribute the traced program bodies (_refill/_refill_row/_step/
+# _multi_step) read off self — the stand-in carries exactly these
+_PROGRAM_ATTRS = ("model", "use_kernel", "cache_dtype", "n_steps",
+                  "filter_thres", "temperature", "topk_approx",
+                  "num_text_tokens", "prefix_len", "park", "steps_per_sync")
+
+
+def _program_key(eng: "DecodeEngine") -> tuple:
+    return (eng.slots, np.dtype(eng.cache_dtype).name, eng.filter_thres,
+            eng.temperature, eng.topk_approx, eng.steps_per_sync,
+            eng.use_kernel)
+
+
+def _shared_programs(eng: "DecodeEngine") -> tuple:
+    import types
+    per_model = _PROGRAMS.setdefault(id(eng.model), {})
+    key = _program_key(eng)
+    fns = per_model.get(key)
+    if fns is None:
+        standin = types.SimpleNamespace(
+            **{a: getattr(eng, a) for a in _PROGRAM_ATTRS})
+        standin._step = DecodeEngine._step.__get__(standin)
+        fns = (jax.jit(DecodeEngine._refill.__get__(standin),
+                       donate_argnums=(1,)),
+               jax.jit(DecodeEngine._refill_row.__get__(standin),
+                       donate_argnums=(1,)),
+               jax.jit(DecodeEngine._multi_step.__get__(standin),
+                       donate_argnums=(1,)))
+        per_model[key] = fns
+    return fns
+
+
 class DecodeEngine:
     """Continuous-batching image-token decode over a DALLE model.
 
@@ -129,10 +176,33 @@ class DecodeEngine:
         assert steps_per_sync >= 1
         self.steps_per_sync = int(steps_per_sync)
 
-        self._refill_fn = jax.jit(self._refill, donate_argnums=(1,))
-        self._refill_row_fn = jax.jit(self._refill_row, donate_argnums=(1,))
-        self._step_fn = jax.jit(self._multi_step, donate_argnums=(1,))
+        # grid-row granularity for streaming (on_rows): one committed row of
+        # the image token grid = one fmap row
+        self.row_len = c.image_fmap_size
+
+        self._refill_fn, self._refill_row_fn, self._step_fn = \
+            _shared_programs(self)
+        self.aot_loaded = False
         self.stats = EngineStats()
+
+    def install_executables(self, *, step=None, refill=None,
+                            refill_row=None) -> None:
+        """Swap the engine's jitted programs for AOT-compiled executables
+        (gateway/aot.py): a cold replica then serves without retracing or
+        recompiling any device program. Executables must have been lowered
+        from THIS engine configuration — the aot module's fingerprint check
+        enforces that; calling one with mismatched shapes/dtypes fails loudly
+        at dispatch, never silently."""
+        if step is None or refill is None or refill_row is None:
+            # a partial install would leave some programs on jit while
+            # health/smoke report aot_loaded=true — the flag must mean
+            # "the WHOLE cold-start path is executable-backed"
+            raise ValueError("install_executables requires all three "
+                             "programs (step, refill, refill_row)")
+        self._step_fn = step
+        self._refill_fn = refill
+        self._refill_row_fn = refill_row
+        self.aot_loaded = True
 
     # -- device programs ---------------------------------------------------
     def _init_state(self) -> Dict:
@@ -286,7 +356,7 @@ class DecodeEngine:
 
     def run(self, queue: RequestQueue, *, max_steps: Optional[int] = None,
             poll_s: float = 0.02,
-            on_complete=None) -> List[CompletedRequest]:
+            on_complete=None, on_rows=None) -> List[CompletedRequest]:
         """Serve until the queue is drained (closed + empty + nothing in
         flight). Producers may keep submitting from other threads while
         this runs. Returns completions in completion order.
@@ -297,6 +367,17 @@ class DecodeEngine:
         then an empty list and memory stays O(slots) for the life of the
         loop. Without it, every completion (including its full token array)
         is retained until drain.
+
+        ``on_rows(request, row_idx, row_tokens)`` streams partial results:
+        called the moment a committed GRID ROW of the image token field
+        finishes (``row_len == image_fmap_size`` tokens — the slot state's
+        per-row offset crossing a row boundary), plus once for a trailing
+        partial row of a ``max_tokens`` request just before its completion.
+        Concatenating a request's row_tokens in row_idx order reproduces its
+        final token sequence exactly, so a streaming consumer (the
+        gateway's SSE writer, which dVAE-decodes committed rows into
+        preview pixels) needs no end-of-stream reconciliation. Callbacks
+        run on the engine thread — keep them O(row) and non-blocking.
 
         ``max_steps`` is a harness bound (bench/smoke), not a graceful
         drain: requests still mid-decode when it trips are abandoned —
@@ -326,6 +407,13 @@ class DecodeEngine:
                     for slot, req in pairs:
                         req.admitted_at = now
                         buffers[slot] = []
+                        # queue wait as its own span (admission SLO input:
+                        # TTFT = queue wait + prefill + first step) + gauge
+                        record_span("serve/request_queue_wait",
+                                    req.submitted_at, now - req.submitted_at,
+                                    request_id=req.request_id)
+                        gauge_set("serve.queue_wait_s",
+                                  now - req.submitted_at)
                     if 2 * len(pairs) >= B:
                         # bulk admission: one multi-row refill window
                         texts = np.zeros((B, self.text_seq_len), np.int32)
@@ -382,13 +470,23 @@ class DecodeEngine:
                     req = sched.request_at(slot)
                     if req.first_token_at is None:
                         req.first_token_at = now
-                    buffers[slot].append(int(toks[k, slot]))
+                    buf = buffers[slot]
+                    buf.append(int(toks[k, slot]))
+                    if on_rows is not None and len(buf) % self.row_len == 0:
+                        row = len(buf) // self.row_len - 1
+                        on_rows(req, row, buf[row * self.row_len:])
                 counter_add("serve.tokens_emitted_total",
                             float(len(active)))
                 for slot in active:
                     if not fins[k, slot]:
                         continue
                     req = sched.complete(slot)
+                    if on_rows is not None:
+                        tail = len(buffers[slot]) % self.row_len
+                        if tail:
+                            # trailing partial row of a max_tokens request
+                            on_rows(req, len(buffers[slot]) // self.row_len,
+                                    buffers[slot][-tail:])
                     cr = CompletedRequest(
                         request_id=req.request_id,
                         tokens=np.asarray(buffers.pop(slot), np.int32),

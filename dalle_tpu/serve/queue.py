@@ -18,6 +18,13 @@ from typing import List, Optional
 import numpy as np
 
 
+class QueueFull(RuntimeError):
+    """Raised by ``submit`` on a bounded queue at capacity. The gateway maps
+    it to HTTP 429: rejecting at admission keeps a traffic spike from
+    queueing into TTFT death — a request that would wait seconds for a slot
+    is better retried against another replica (or later) than accepted."""
+
+
 @dataclasses.dataclass
 class Request:
     """One generation request: a text prompt (token ids, 0-padded to
@@ -36,6 +43,13 @@ class Request:
     max_tokens: Optional[int] = None
     submitted_at: float = dataclasses.field(
         default_factory=time.perf_counter)
+    # gateway-layer policy fields (dalle_tpu/gateway): ignored by the FIFO
+    # queue and the engine itself, consumed by PolicyQueue ordering and the
+    # admission controller. ``deadline_at`` is in the ``submitted_at``
+    # timebase (perf_counter seconds).
+    tenant: str = "default"
+    priority: int = 0           # higher = served sooner under PolicyQueue
+    deadline_at: Optional[float] = None
     # stamped by the engine
     admitted_at: Optional[float] = None
     first_token_at: Optional[float] = None
@@ -63,9 +77,17 @@ class CompletedRequest:
 
 
 class RequestQueue:
-    """FIFO with close semantics. All methods are thread-safe."""
+    """FIFO with close semantics. All methods are thread-safe.
 
-    def __init__(self):
+    ``maxsize`` bounds the backlog: ``submit`` on a full queue raises
+    ``QueueFull`` instead of growing without bound (None = unbounded, the
+    pre-gateway behavior). The bound counts QUEUED requests only — in-flight
+    slots are the engine's capacity, the queue's job is to cap wait."""
+
+    def __init__(self, maxsize: Optional[int] = None):
+        if maxsize is not None and maxsize < 1:
+            raise ValueError(f"maxsize must be >= 1 or None, got {maxsize}")
+        self.maxsize = maxsize
         self._q: deque = deque()
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -74,7 +96,9 @@ class RequestQueue:
 
     def submit(self, text, seed: int,
                request_id: Optional[int] = None,
-               max_tokens: Optional[int] = None) -> Request:
+               max_tokens: Optional[int] = None,
+               tenant: str = "default", priority: int = 0,
+               deadline_at: Optional[float] = None) -> Request:
         """Enqueue a request; returns it (with its assigned id). An explicit
         ``request_id`` must be fresh: ids at or below the high-water mark of
         previously issued ids are rejected rather than tracked individually,
@@ -89,6 +113,9 @@ class RequestQueue:
         with self._cond:
             if self._closed:
                 raise RuntimeError("queue is closed")
+            if self.maxsize is not None and len(self._q) >= self.maxsize:
+                raise QueueFull(
+                    f"queue at capacity ({self.maxsize} requests waiting)")
             if request_id is None:
                 request_id = self._next_id
             elif request_id < self._next_id:
@@ -98,10 +125,22 @@ class RequestQueue:
                     "request_id or pass one above the high-water mark")
             self._next_id = request_id + 1
             req = Request(request_id=request_id, text=text, seed=seed,
-                          max_tokens=max_tokens)
+                          max_tokens=max_tokens, tenant=tenant,
+                          priority=priority, deadline_at=deadline_at)
             self._q.append(req)
             self._cond.notify_all()
         return req
+
+    @property
+    def next_request_id(self) -> int:
+        """The id the next auto-assigned submission will get. A consumer
+        that must index per-request state BEFORE the request becomes
+        takeable (the gateway replica registers the result stream first,
+        then submits with this explicit id) reads this and passes it to
+        ``submit(request_id=...)`` — serializing its own submitters, since
+        two concurrent reservations would collide."""
+        with self._lock:
+            return self._next_id
 
     def take(self, max_n: int) -> List[Request]:
         """Dequeue up to ``max_n`` requests in FIFO order (non-blocking)."""

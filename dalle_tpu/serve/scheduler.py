@@ -1,14 +1,23 @@
-"""Slot scheduler: host-side bookkeeping for the fixed device decode batch.
+"""Slot scheduler + scheduling policies for the decode batch.
 
-The device state is B anonymous slots; this maps slots ↔ requests and
-enforces the two scheduling invariants the engine tests pin down
-(tests/test_serve.py):
+The device state is B anonymous slots; ``SlotScheduler`` maps slots ↔
+requests and enforces the two scheduling invariants the engine tests pin
+down (tests/test_serve.py):
 
   * work-conserving — after every admission pass, either no slot is free or
     the queue is empty (no idle slot while the queue holds work);
   * FIFO fairness — requests are admitted strictly in submission order (the
     queue pops FIFO and ``admit`` pairs them with free slots in order), so
     no request can be overtaken while waiting.
+
+The POLICY layer (``PolicyQueue`` + ``SchedulingPolicy``) is the gateway's
+multi-tenant extension: it changes which queued request is taken next —
+priority tiers, earliest-deadline-first, and shedding of requests whose
+deadline has already passed (serving a guaranteed SLO miss burns slot time
+a live request could use; Orca's iteration-level scheduling makes the shed
+point every admission pass, not just enqueue). FIFO stays the DEFAULT and
+its fairness/work-conservation invariants stay pinned — a bare
+``RequestQueue`` never reorders or sheds.
 
 Pure Python, no jax: the engine owns the device arrays, this owns the
 mapping.
@@ -17,9 +26,10 @@ mapping.
 from __future__ import annotations
 
 import collections
-from typing import Deque, Dict, List, Optional, Sequence, Tuple
+import time
+from typing import (Callable, Deque, Dict, List, Optional, Sequence, Tuple)
 
-from .queue import Request
+from .queue import Request, RequestQueue
 
 
 class SlotScheduler:
@@ -82,3 +92,91 @@ class SlotScheduler:
         self._slots[slot] = None
         self.completed_total += 1
         return req
+
+
+# ---------------------------------------------------------------------------
+# scheduling policies (the gateway's admission-order layer)
+# ---------------------------------------------------------------------------
+
+class SchedulingPolicy:
+    """Decides which queued requests are taken next. ``order_key`` sorts the
+    backlog ascending (ties broken by submission order — the queue passes
+    the arrival index); ``should_shed`` drops a request at take time."""
+
+    name = "fifo"
+
+    def order_key(self, req: Request, arrival_idx: int):
+        return arrival_idx
+
+    def should_shed(self, req: Request, now: float) -> bool:
+        return False
+
+
+class FifoPolicy(SchedulingPolicy):
+    """Strict submission order, never sheds — the pinned default."""
+
+
+class PriorityDeadlinePolicy(SchedulingPolicy):
+    """Priority tiers, then earliest deadline, then FIFO — and requests
+    whose deadline already passed are shed at take time instead of occupying
+    a slot for a guaranteed SLO miss. ``shed_slack_s`` keeps a just-expired
+    request servable when the miss is marginal (default 0: any passed
+    deadline sheds)."""
+
+    name = "priority_deadline"
+
+    def __init__(self, shed_slack_s: float = 0.0):
+        self.shed_slack_s = float(shed_slack_s)
+
+    def order_key(self, req: Request, arrival_idx: int):
+        deadline = (req.deadline_at if req.deadline_at is not None
+                    else float("inf"))
+        return (-req.priority, deadline, arrival_idx)
+
+    def should_shed(self, req: Request, now: float) -> bool:
+        return (req.deadline_at is not None
+                and now > req.deadline_at + self.shed_slack_s)
+
+
+class PolicyQueue(RequestQueue):
+    """A ``RequestQueue`` whose ``take`` follows a ``SchedulingPolicy``.
+
+    Drop-in for the engine (same submit/take/close surface), so policy
+    scheduling needs no engine change: the engine still takes up to its
+    free-slot count per iteration; the policy only changes WHICH requests
+    those are. Shed requests are handed to ``on_shed`` (called outside the
+    lock — the gateway completes their streams with a deadline error) and
+    counted in ``shed_total``. With the default ``FifoPolicy`` behavior is
+    bit-identical to the base queue."""
+
+    def __init__(self, maxsize: Optional[int] = None,
+                 policy: Optional[SchedulingPolicy] = None,
+                 on_shed: Optional[Callable[[Request], None]] = None):
+        super().__init__(maxsize=maxsize)
+        self.policy = policy if policy is not None else FifoPolicy()
+        self.on_shed = on_shed
+        self.shed_total = 0
+
+    def take(self, max_n: int) -> List[Request]:
+        now = time.perf_counter()
+        shed: List[Request] = []
+        out: List[Request] = []
+        with self._lock:
+            keep = []
+            for req in self._q:
+                if self.policy.should_shed(req, now):
+                    shed.append(req)
+                else:
+                    keep.append(req)
+            # FIFO tie-break via request_id: ids are issued monotonically
+            # under the queue lock (the high-water-mark rule), so they ARE
+            # the arrival order — no side table to race with submit or leak
+            keep.sort(key=lambda r: self.policy.order_key(r, r.request_id))
+            out = keep[:max_n]
+            self._q.clear()
+            self._q.extend(keep[max_n:])
+            self.shed_total += len(shed)
+        if self.on_shed is not None:
+            for req in shed:
+                self.on_shed(req)
+        return out

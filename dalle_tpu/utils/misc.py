@@ -96,3 +96,23 @@ def instantiate_from_config(config: dict):
     # those configs go through models.pretrained.vqgan_config_from_yaml, which
     # owns the schema translation — this helper is the generic DI mechanism
     return get_obj_from_str(config["target"])(**config.get("params", {}))
+
+
+def enable_compilation_cache(cache_dir: str) -> str:
+    """Point jax's persistent compilation cache at ``cache_dir`` so every
+    compile in this process is written through to disk and every later
+    process (a rejoining trainer, a scaled-up serving replica) reads it
+    back instead of recompiling. The min-time/min-size thresholds are
+    dropped to zero: cold-start cares about the long tail of small
+    programs too, and the cache is content-addressed so over-writing is
+    idempotent. Provider-neutral jax plumbing — shared by every train and
+    serve CLI (scripts/_common.add_compile_cache_args) and re-exported by
+    dalle_tpu.gateway.aot for the serving cold-start story
+    (docs/SERVING.md)."""
+    import os
+    cache_dir = os.path.expanduser(cache_dir)
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    return cache_dir

@@ -143,6 +143,35 @@ def save_image_grid(images, path):
         Image.fromarray(im).save(path.format(i))
 
 
+def add_compile_cache_args(parser):
+    """Persistent XLA compilation cache flags, shared by every CLI (train
+    AND serve): a rejoining worker or a scaled-up serving replica reads
+    compiled programs back from disk instead of repaying XLA (the
+    trace is still paid — gateway AOT bundles skip that too, see
+    docs/SERVING.md)."""
+    grp = parser.add_argument_group("compilation cache (docs/SERVING.md)")
+    grp.add_argument("--compile_cache_dir", type=str,
+                     default="~/.cache/dalle_tpu/xla_cache",
+                     help="persistent XLA compilation cache directory "
+                          "(content-addressed; safe to share across "
+                          "processes and runs)")
+    grp.add_argument("--no_compile_cache", action="store_true",
+                     help="disable the persistent compilation cache "
+                          "(every process recompiles from scratch)")
+    return parser
+
+
+def enable_compile_cache(args) -> bool:
+    """Apply add_compile_cache_args flags. Call BEFORE the first jit
+    dispatch — programs compiled earlier in the process are not
+    retro-cached. Returns True when the cache was enabled."""
+    if getattr(args, "no_compile_cache", False):
+        return False
+    from dalle_tpu.utils.misc import enable_compilation_cache
+    enable_compilation_cache(args.compile_cache_dir)
+    return True
+
+
 def add_overlap_args(parser):
     """Host-overlap flags shared by every train CLI (docs/PERFORMANCE.md):
     async checkpointing, device prefetch depth, deferred metrics, and the

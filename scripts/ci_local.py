@@ -103,6 +103,21 @@ def run_serve_smoke_stage() -> int:
     return subprocess.run(cmd, cwd=ROOT, env=env).returncode
 
 
+def run_gateway_smoke_stage() -> int:
+    """The serving-gateway stage: a loopback HTTP/SSE gateway over two tiny
+    replicas — one streamed request end-to-end (SSE grid rows, bitwise
+    token-exact vs single-request generation), concurrent multi-tenant
+    traffic, quota exhaustion → 429, and the AOT cold-start path serving
+    with zero backend compiles (scripts/gateway_smoke.py; the workflow's
+    matching step is skipped below). Artifacts land in ./gateway_artifacts
+    — the dir ci.yml uploads alongside serve_artifacts."""
+    cmd = [sys.executable, os.path.join(ROOT, "scripts", "gateway_smoke.py"),
+           "--outdir", os.path.join(ROOT, "gateway_artifacts")]
+    print(f"== [gateway] {' '.join(cmd[1:])}")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(cmd, cwd=ROOT, env=env).returncode
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--changed-only", action="store_true",
@@ -138,6 +153,10 @@ def main():
         print("ci_local: FAILED (serve smoke) — test tiers not run")
         return 1
 
+    if run_gateway_smoke_stage() != 0:
+        print("ci_local: FAILED (gateway smoke) — test tiers not run")
+        return 1
+
     wf = yaml.safe_load(open(os.path.join(ROOT, ".github/workflows/ci.yml")))
     job = wf["jobs"]["test"]
     failures = 0
@@ -161,6 +180,10 @@ def main():
             continue
         if "scripts/serve_smoke.py" in cmd:
             print(f"-- [skip] {name}: already run in the serve smoke stage")
+            continue
+        if "scripts/gateway_smoke.py" in cmd:
+            print(f"-- [skip] {name}: already run in the gateway smoke "
+                  "stage")
             continue
         if any(m in cmd for m in NETWORK_MARKERS):
             # the editable-install smoke is half network, half local: keep

@@ -1,0 +1,235 @@
+#!/usr/bin/env python
+"""Gateway smoke — the CI gate for dalle_tpu/gateway (docs/SERVING.md).
+
+A loopback HTTP/SSE gateway over two tiny replicas, asserting the serving
+contracts end-to-end over a real socket:
+
+  * streaming — one SSE request streams every committed grid row in order
+    (fmap rows × fmap tokens) and the concatenated rows equal the ``done``
+    tokens equal single-request ``generate_images_tokens`` BITWISE;
+  * concurrency/multi-tenancy — parallel streamed + blocking requests from
+    two tenants all complete token-exact;
+  * admission — a burst-1 tenant's second immediate request gets 429 with
+    Retry-After (quota), and /metrics exposes the reject counters;
+  * AOT cold start — a replica whose engine loaded the serialized
+    executables serves its FIRST requests with ZERO backend compiles
+    (asserted via the compile counter; phase A warms every eager op in the
+    process through a jit replica first, so the zero is exactly "no
+    retrace, no program compile on the cold replica" — a fresh jit engine
+    in the same position pays its step/refill compiles).
+
+Artifacts (smoke.json, gateway_spans.jsonl, metrics.jsonl) land in
+``--outdir`` — the dir ci.yml uploads alongside serve_artifacts.
+Run: JAX_PLATFORMS=cpu python scripts/gateway_smoke.py
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _post(address: str, payload: dict, timeout: float = 120.0):
+    import http.client
+    host, port = address.split("//")[1].rsplit(":", 1)
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    conn.request("POST", "/v1/generate", json.dumps(payload),
+                 {"Content-Type": "application/json"})
+    return conn, conn.getresponse()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--outdir", type=str, default="gateway_artifacts")
+    ap.add_argument("--slots", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    os.makedirs(args.outdir, exist_ok=True)
+
+    import jax
+    import numpy as np
+
+    from dalle_tpu import obs
+    from dalle_tpu.config import DalleConfig
+    from dalle_tpu.gateway import (AdmissionController, Gateway, Replica,
+                                   ReplicaRouter, TenantQuotas, iter_sse,
+                                   save_engine_aot)
+    from dalle_tpu.models.dalle import DALLE, init_dalle
+
+    cfg = DalleConfig(num_text_tokens=32, text_seq_len=6, dim=64, depth=2,
+                      heads=2, dim_head=32, image_size=16,
+                      image_vocab_size=24, image_fmap_size=4)
+    model, params = init_dalle(cfg, jax.random.PRNGKey(args.seed), batch=2)
+    rng = np.random.RandomState(args.seed)
+    n_req = 6
+    texts = [rng.randint(1, 20, (cfg.text_seq_len,)).astype(np.int32)
+             for _ in range(n_req)]
+    refs = {i: np.asarray(model.apply(
+        params, np.asarray(t[None]), jax.random.PRNGKey(1000 + i),
+        method=DALLE.generate_images_tokens)[0]).tolist()
+        for i, t in enumerate(texts)}
+
+    tracer = obs.configure()
+    counter = obs.install_compile_counter()
+    failures = []
+
+    def check(ok, msg):
+        print(("PASS " if ok else "FAIL ") + msg)
+        if not ok:
+            failures.append(msg)
+
+    def make_engine():
+        from dalle_tpu.serve import DecodeEngine
+        return DecodeEngine(model, params, slots=args.slots)
+
+    # AOT export first (the exporter pays these compiles, not the replicas)
+    aot_dir = os.path.join(tempfile.mkdtemp(prefix="gateway_smoke_"), "aot")
+    manifest = save_engine_aot(make_engine(), aot_dir)
+    check(all(manifest["payload_bytes"][p] > 0
+              for p in ("step", "refill", "refill_row")),
+          "AOT export serialized all three engine programs")
+
+    # phase A: a jit replica serves the SSE + quota checks (and warms every
+    # eager op in the process, so phase B's zero is the cold-start claim)
+    jit_rep = Replica(make_engine(), replica_id="jit-0", maxsize=16).start()
+    admission = AdmissionController(TenantQuotas(
+        rate_per_s=200.0, burst=200.0, overrides={"capped": (0.02, 1)}))
+    gw = Gateway(ReplicaRouter([jit_rep]), admission).start()
+
+    conn, resp = _post(gw.address, {"text": texts[0].tolist(), "seed": 1000,
+                                    "stream": True})
+    check(resp.status == 200
+          and resp.getheader("Content-Type") == "text/event-stream",
+          "streamed request answers 200 text/event-stream")
+    rows, done = [], None
+    for event, data in iter_sse(resp):
+        if event == "row":
+            rows.append(data)
+        elif event == "done":
+            done = data
+    conn.close()
+    fmap = cfg.image_fmap_size
+    check([d["row"] for d in rows] == list(range(fmap)),
+          f"SSE framing: {fmap} grid rows streamed in order")
+    check(all(len(d["tokens"]) == fmap for d in rows),
+          "SSE framing: one fmap-width token row per event")
+    streamed = [t for d in rows for t in d["tokens"]]
+    check(done is not None and streamed == done["tokens"] == refs[0],
+          "streamed rows ≡ done tokens ≡ single-request generation (bitwise)")
+
+    # concurrent multi-tenant traffic: blocking + streamed, two tenants
+    results = {}
+
+    def client(i):
+        stream = i % 2 == 1
+        conn, resp = _post(gw.address, {
+            "text": texts[i].tolist(), "seed": 1000 + i, "stream": stream,
+            "tenant": "teamA" if i % 2 else "teamB"})
+        if stream:
+            toks = None
+            for event, data in iter_sse(resp):
+                if event == "done":
+                    toks = data["tokens"]
+        else:
+            toks = json.loads(resp.read())["tokens"]
+        results[i] = toks
+        conn.close()
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(1, n_req)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    check(all(results.get(i) == refs[i] for i in range(1, n_req)),
+          f"{n_req - 1} concurrent multi-tenant requests all token-exact")
+
+    # quota: burst-1 tenant's second immediate request is rejected
+    conn1, r1 = _post(gw.address, {"text": texts[0].tolist(), "seed": 2000,
+                                   "tenant": "capped"})
+    r1.read()
+    conn2, r2 = _post(gw.address, {"text": texts[1].tolist(), "seed": 2001,
+                                   "tenant": "capped"})
+    body = json.loads(r2.read())
+    check(r1.status == 200 and r2.status == 429
+          and body["error"] == "quota"
+          and r2.getheader("Retry-After") is not None,
+          "quota exhaustion → 429 + Retry-After (first request served)")
+    conn1.close(), conn2.close()
+
+    import http.client
+    host, port = gw.address.split("//")[1].rsplit(":", 1)
+    mc = http.client.HTTPConnection(host, int(port), timeout=10)
+    mc.request("GET", "/metrics")
+    metrics_text = mc.getresponse().read().decode()
+    mc.close()
+    check("dalle_gateway_rejected_total" in metrics_text
+          and "dalle_gateway_inflight" in metrics_text,
+          "/metrics exposes gateway reject counter + inflight gauge")
+    gw.shutdown(drain=True, timeout=60)
+
+    # phase B: AOT cold start — a NEVER-run replica loads the serialized
+    # executables and serves with zero backend compiles. Built over a
+    # FRESH model instance (same config/params, new object) so the
+    # engine-level program sharing (serve/engine.py _shared_programs)
+    # cannot hand it phase A's compiled programs: the zero below is the
+    # AOT bundle's doing, nothing else's.
+    model2, params2 = init_dalle(cfg, jax.random.PRNGKey(args.seed),
+                                 batch=2)
+    from dalle_tpu.serve import DecodeEngine
+    aot_engine = DecodeEngine(model2, params2, slots=args.slots)
+    aot_rep = Replica(aot_engine, replica_id="aot-0", maxsize=16,
+                      aot_dir=aot_dir)
+    check(aot_rep.aot_loaded and aot_engine.aot_loaded,
+          "AOT bundle fingerprint-matched and loaded")
+    gw2 = Gateway(ReplicaRouter([aot_rep.start()]),
+                  AdmissionController()).start()
+    before = counter.count
+    cold = {}
+    for i in range(2):
+        conn, resp = _post(gw2.address, {"text": texts[i].tolist(),
+                                         "seed": 1000 + i})
+        cold[i] = json.loads(resp.read())["tokens"]
+        conn.close()
+    compiles = counter.count - before
+    check(compiles == 0,
+          f"AOT cold-start served first requests with {compiles} backend "
+          "compiles (retrace-free)")
+    check(all(cold[i] == refs[i] for i in range(2)),
+          "AOT-served tokens bit-exact vs jit reference")
+    gw2.shutdown(drain=True, timeout=60)
+
+    spans = tracer.snapshot_spans()
+    qwaits = [s for s in spans if s[0] == "serve/request_queue_wait"]
+    check(len(qwaits) >= n_req,
+          "per-request serve/request_queue_wait spans recorded")
+
+    n_spans = obs.export_spans_jsonl(
+        os.path.join(args.outdir, "gateway_spans.jsonl"))
+    snapshot = obs.metrics_snapshot()
+    with open(os.path.join(args.outdir, "metrics.jsonl"), "w") as fh:
+        fh.write(json.dumps({"step": 0, **snapshot}) + "\n")
+    summary = {
+        "requests": n_req, "slots": args.slots,
+        "aot_payload_bytes": manifest["payload_bytes"],
+        "aot_cold_start_compiles": compiles,
+        "rejected_total": snapshot.get("gateway.rejected_total", 0),
+        "spans_exported": n_spans, "failures": failures,
+    }
+    with open(os.path.join(args.outdir, "smoke.json"), "w") as fh:
+        json.dump(summary, fh, indent=2)
+    obs.disable()
+    print(json.dumps({"metric": "gateway_smoke", **summary}), flush=True)
+    if failures:
+        print(f"gateway_smoke: FAILED ({len(failures)} checks)")
+        return 1
+    print("gateway_smoke: GREEN")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,7 +17,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from _common import (add_vae_args, build_vae_from_args,  # noqa: E402
+from _common import (add_compile_cache_args, add_vae_args,  # noqa: E402
+                     build_vae_from_args, enable_compile_cache,
                      load_model_checkpoint, load_vae_sidecar, save_image_grid)
 
 
@@ -72,6 +73,7 @@ def build_parser():
     ap.add_argument("--image_size", type=int, default=128)
     ap.add_argument("--seed", type=int, default=0)
     add_vae_args(ap)
+    add_compile_cache_args(ap)
     from dalle_tpu.parallel import wrap_arg_parser
     return wrap_arg_parser(ap)
 
@@ -87,6 +89,7 @@ def load_dalle(ckpt_dir: str, backend):
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
+    enable_compile_cache(args)
     import jax
     import numpy as np
     from dalle_tpu.models.wrapper import DalleWithVae

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Serving gateway CLI: HTTP/SSE front end over a replica fleet.
+
+Runs N continuous-batching replicas (dalle_tpu/serve) behind the gateway
+(dalle_tpu/gateway): per-tenant token-bucket quotas, SLO-aware admission,
+priority/deadline scheduling, queue-depth-aware dispatch with mid-stream
+failover, graceful drain on SIGINT/SIGTERM. See docs/SERVING.md.
+
+A trained checkpoint serves real traffic:
+  python scripts/serve_gateway.py --dalle_path ./checkpoints/dalle \
+      --replicas 2 --slots 8 --port 8080
+
+AOT cold-start workflow (replica up in seconds, no retrace):
+  python scripts/serve_gateway.py --dalle_path ... --aot_export ./aot  # once
+  python scripts/serve_gateway.py --dalle_path ... --aot_dir ./aot     # cold
+
+--untrained runs a tiny random model on loopback (smoke/demo, no assets).
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _common import (add_compile_cache_args, enable_compile_cache,  # noqa: E402,E501
+                     load_model_checkpoint, load_vae_sidecar)
+
+
+def build_parser():
+    ap = argparse.ArgumentParser(description=__doc__)
+    src = ap.add_argument_group("model")
+    src.add_argument("--dalle_path", type=str, default=None,
+                     help="DALLE checkpoint dir (scripts/train_dalle.py)")
+    src.add_argument("--untrained", action="store_true",
+                     help="tiny random model (loopback smoke/demo)")
+    src.add_argument("--precision", type=str, default="int8w",
+                     choices=["float32", "bfloat16", "bf16_int8kv", "int8w"],
+                     help="serve-engine precision (int8w = the audited "
+                          "minimum-HBM default)")
+    fleet = ap.add_argument_group("fleet")
+    fleet.add_argument("--replicas", type=int, default=1)
+    fleet.add_argument("--slots", type=int, default=4,
+                       help="decode slots (device batch) per replica")
+    fleet.add_argument("--steps_per_sync", type=int, default=4,
+                       help="device steps per host sync (amortizes "
+                            "dispatch; a freed slot waits up to K-1 steps)")
+    fleet.add_argument("--queue_maxsize", type=int, default=64,
+                       help="bounded per-replica backlog; overflow → 429")
+    fleet.add_argument("--policy", type=str, default="fifo",
+                       choices=["fifo", "priority_deadline"],
+                       help="take-order policy (fifo = pinned default; "
+                            "priority_deadline adds tiers + EDF + shedding)")
+    aot = ap.add_argument_group("AOT cold start (docs/SERVING.md)")
+    aot.add_argument("--aot_dir", type=str, default=None,
+                     help="load serialized engine executables (cold-start "
+                          "without retrace/recompile; fingerprint-checked)")
+    aot.add_argument("--aot_export", type=str, default=None,
+                     help="compile + serialize the engine programs to this "
+                          "dir and exit (run once per config/topology)")
+    net = ap.add_argument_group("network / quotas")
+    net.add_argument("--host", type=str, default="127.0.0.1")
+    net.add_argument("--port", type=int, default=8080)
+    net.add_argument("--tenant_rate", type=float, default=10.0,
+                     help="default per-tenant requests/s")
+    net.add_argument("--tenant_burst", type=float, default=20.0)
+    net.add_argument("--tenant_override", action="append", default=[],
+                     metavar="TENANT=RATE:BURST",
+                     help="per-tenant quota override (repeatable)")
+    ap.add_argument("--prometheus_path", type=str, default="",
+                    help="node-exporter textfile target (written on drain; "
+                         "live scrape is GET /metrics)")
+    add_compile_cache_args(ap)
+    return ap
+
+
+def build_wrapper(args):
+    import jax
+    from dalle_tpu.models.wrapper import DalleWithVae
+    if args.untrained:
+        from dalle_tpu.config import DalleConfig
+        from dalle_tpu.models.dalle import init_dalle
+        cfg = DalleConfig(num_text_tokens=32, text_seq_len=6, dim=64,
+                          depth=2, heads=2, dim_head=32, image_size=16,
+                          image_vocab_size=24, image_fmap_size=4)
+        model, params = init_dalle(cfg, jax.random.PRNGKey(0), batch=2)
+        return DalleWithVae(model, params, None)
+    if not args.dalle_path:
+        raise SystemExit("provide --dalle_path or --untrained")
+    from dalle_tpu.config import DalleConfig
+    from dalle_tpu.models.dalle import init_dalle
+    model, params, _ = load_model_checkpoint(args.dalle_path, "DALLE",
+                                             DalleConfig, init_dalle)
+    vae = load_vae_sidecar(args.dalle_path)
+    return DalleWithVae(model, params, vae)
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    enable_compile_cache(args)
+
+    from dalle_tpu import obs
+    from dalle_tpu.gateway import (AdmissionController, Gateway, Replica,
+                                   ReplicaRouter, TenantQuotas,
+                                   save_engine_aot)
+    from dalle_tpu.serve import PriorityDeadlinePolicy
+
+    obs.configure()
+    dv = build_wrapper(args)
+
+    def make_engine():
+        return dv.serve_engine(slots=args.slots, precision=args.precision,
+                               steps_per_sync=args.steps_per_sync)
+
+    if args.aot_export:
+        manifest = save_engine_aot(make_engine(), args.aot_export)
+        print(json.dumps({"aot_export": args.aot_export,
+                          "payload_bytes": manifest["payload_bytes"]}))
+        return 0
+
+    policy_cls = (PriorityDeadlinePolicy if args.policy ==
+                  "priority_deadline" else None)
+    overrides = {}
+    for spec in args.tenant_override:
+        tenant, _, rb = spec.partition("=")
+        rate, _, burst = rb.partition(":")
+        overrides[tenant] = (float(rate), float(burst or rate))
+    from dalle_tpu.gateway import SloEstimator
+    admission = AdmissionController(
+        TenantQuotas(args.tenant_rate, args.tenant_burst, overrides),
+        # completions observe per-request rate; backlog drains at ~rate ×
+        # total slots, so the predictor needs the fleet parallelism
+        SloEstimator(parallelism=args.slots * args.replicas))
+
+    replicas = []
+    for i in range(args.replicas):
+        rep = Replica(make_engine(), replica_id=f"replica-{i}",
+                      maxsize=args.queue_maxsize,
+                      policy=policy_cls() if policy_cls else None,
+                      aot_dir=args.aot_dir,
+                      on_served=lambda cr: admission.slo.observe(
+                          int(cr.tokens.shape[0]),
+                          cr.completed_at - cr.admitted_at))
+        replicas.append(rep.start())
+        print(f"{rep.replica_id}: serving (aot_loaded={rep.aot_loaded})")
+
+    gw = Gateway(ReplicaRouter(replicas), admission,
+                 host=args.host, port=args.port, vae=dv.vae)
+    gw.start()
+    print(f"gateway listening on {gw.address} "
+          f"({args.replicas} replica(s) × {args.slots} slots, "
+          f"policy={args.policy}, precision={args.precision})", flush=True)
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    print("draining…", flush=True)
+    gw.shutdown(drain=True)
+    if args.prometheus_path:
+        obs.write_textfile(args.prometheus_path, obs.metrics_snapshot())
+    print("drained; bye")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,7 +18,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from _common import add_overlap_args, overlap_train_kwargs  # noqa: E402
+from _common import (add_compile_cache_args, add_overlap_args,  # noqa: E402
+                     enable_compile_cache, overlap_train_kwargs)
 
 
 def build_parser():
@@ -59,6 +60,7 @@ def build_parser():
     train.add_argument("--no_preflight", action="store_true")
 
     add_overlap_args(ap)
+    add_compile_cache_args(ap)
     from dalle_tpu.parallel import wrap_arg_parser
     wrap_arg_parser(ap)
     return ap
@@ -71,6 +73,7 @@ def main(argv=None):
               file=sys.stderr)
         return 2
 
+    enable_compile_cache(args)
     import numpy as np
     from dalle_tpu.config import ClipConfig, OptimConfig, TrainConfig
     from dalle_tpu.parallel import set_backend_from_args

@@ -19,7 +19,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from _common import (add_overlap_args, add_vae_args,  # noqa: E402
+from _common import (add_compile_cache_args, add_overlap_args,  # noqa: E402
+                     add_vae_args, enable_compile_cache,
                      build_vae_from_args, overlap_train_kwargs,
                      save_image_grid, save_vae_sidecar)
 
@@ -95,6 +96,7 @@ def build_parser():
                        help="profile at step 200 then exit (ref :492-499)")
 
     add_overlap_args(ap)
+    add_compile_cache_args(ap)
 
     tel = ap.add_argument_group("telemetry (grafttrace, docs/OBSERVABILITY.md)")
     tel.add_argument("--trace", action="store_true",
@@ -119,6 +121,7 @@ def main(argv=None):
               file=sys.stderr)
         return 2
 
+    enable_compile_cache(args)
     import numpy as np
     from dalle_tpu.config import DalleConfig, ObsConfig, OptimConfig, TrainConfig
     from dalle_tpu.models.wrapper import DalleWithVae, dalle_config_for_vae

@@ -17,7 +17,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from _common import add_overlap_args, overlap_train_kwargs  # noqa: E402
+from _common import (add_compile_cache_args, add_overlap_args,  # noqa: E402
+                     enable_compile_cache, overlap_train_kwargs)
 
 
 def build_parser():
@@ -68,6 +69,7 @@ def build_parser():
     train.add_argument("--log_artifacts", action="store_true")
 
     add_overlap_args(ap)
+    add_compile_cache_args(ap)
     from dalle_tpu.parallel import wrap_arg_parser
     wrap_arg_parser(ap)
     return ap
@@ -79,6 +81,7 @@ def main(argv=None):
         print("error: provide --image_folder or --synthetic", file=sys.stderr)
         return 2
 
+    enable_compile_cache(args)
     from dalle_tpu.config import (AnnealConfig, DVAEConfig, OptimConfig, TrainConfig)
     from dalle_tpu.parallel import set_backend_from_args
     from dalle_tpu.train.trainer_vae import VAETrainer

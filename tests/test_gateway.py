@@ -1,0 +1,372 @@
+"""Serving gateway (dalle_tpu/gateway): admission control, SSE streaming of
+committed grid rows, replica failover mid-stream, and the AOT cold-start
+path — all loopback, no network deps beyond the stdlib HTTP stack.
+
+The correctness bar rides PR 4's: tokens delivered through ANY gateway path
+(SSE rows, blocking JSON, post-failover resumption, AOT executables) equal
+single-request ``generate_images_tokens`` bitwise."""
+
+import base64
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+# ceiling = measured cold full-run total (309 with the shared module model:
+# ~7 engine instances × refill/step(+row) pairs + the AOT export's three
+# .compile() calls + references) + ~15% cross-jax-version slack (the
+# test_serve convention). A gateway change that recompiles per request or
+# per replica restart would blow straight through this.
+pytestmark = pytest.mark.recompile_budget(355)
+
+CFG = dict(num_text_tokens=32, text_seq_len=6, dim=32, depth=2, heads=2,
+           dim_head=16, image_size=16, image_vocab_size=24,
+           image_fmap_size=4)
+
+TEXTS = [np.array([3, 4, 5, 0, 0, 0], np.int32),
+         np.array([7, 8, 0, 0, 0, 0], np.int32),
+         np.array([9, 1, 2, 3, 0, 0], np.int32)]
+
+
+@pytest.fixture(scope="module")
+def model_params():
+    import jax
+    from dalle_tpu.config import DalleConfig
+    from dalle_tpu.models.dalle import init_dalle
+    return init_dalle(DalleConfig(**CFG), jax.random.PRNGKey(0), batch=2)
+
+
+@pytest.fixture(scope="module")
+def refs(model_params):
+    """Single-request references, seed 100+i — the bitwise bar."""
+    import jax
+    from dalle_tpu.models.dalle import DALLE
+    model, params = model_params
+    return {i: np.asarray(model.apply(
+        params, np.asarray(t[None]), jax.random.PRNGKey(100 + i),
+        method=DALLE.generate_images_tokens)[0])
+        for i, t in enumerate(TEXTS)}
+
+
+def _engine(model_params, **kw):
+    from dalle_tpu.serve import DecodeEngine
+    model, params = model_params
+    return DecodeEngine(model, params, slots=kw.pop("slots", 2), **kw)
+
+
+# ---------------------------------------------------------------------------
+# admission control (host-only)
+# ---------------------------------------------------------------------------
+
+def test_token_bucket_rate_and_burst():
+    from dalle_tpu.gateway import TokenBucket
+    b = TokenBucket(rate_per_s=2.0, burst=3.0)
+    t0 = 1000.0
+    assert all(b.try_acquire(1, now=t0) for _ in range(3))   # burst drains
+    assert not b.try_acquire(1, now=t0)
+    assert b.try_acquire(1, now=t0 + 0.5)                    # 0.5s → 1 token
+    assert not b.try_acquire(1, now=t0 + 0.5)
+    # refill caps at burst, never beyond
+    assert all(b.try_acquire(1, now=t0 + 100.0) for _ in range(3))
+    assert not b.try_acquire(1, now=t0 + 100.0)
+
+
+def test_tenant_quotas_overrides_and_isolation():
+    from dalle_tpu.gateway import TenantQuotas
+    q = TenantQuotas(rate_per_s=100.0, burst=50.0,
+                     overrides={"capped": (0.001, 1)})
+    assert q.admit("capped")
+    assert not q.admit("capped")           # burst 1 exhausted
+    # another tenant's bucket is untouched by capped's exhaustion
+    assert all(q.admit("open") for _ in range(10))
+
+
+def test_admission_controller_quota_slo_and_accounting():
+    from dalle_tpu.gateway import (AdmissionController, SloEstimator,
+                                   TenantQuotas)
+    ctl = AdmissionController(
+        TenantQuotas(rate_per_s=0.001, burst=1,
+                     overrides={"fast": (1000.0, 1000.0)}),
+        SloEstimator())
+    # unwarmed estimator must admit (and learn), never reject on SLO
+    d = ctl.decide("fast", request_tokens=16, queued_tokens=1000,
+                   deadline_s=0.001)
+    assert d.admit
+    ctl.slo.observe(tokens=100, seconds=1.0)        # 100 tok/s
+    d = ctl.decide("fast", request_tokens=16, queued_tokens=984,
+                   deadline_s=1.0)                  # predicted 10s > 1s
+    assert not d.admit and d.reason == "slo"
+    assert d.predicted_completion_s == pytest.approx(10.0)
+    assert d.retry_after_s == pytest.approx(9.0)
+    d = ctl.decide("fast", request_tokens=16, queued_tokens=0,
+                   deadline_s=1.0)                  # 0.16s < 1s
+    assert d.admit
+    # quota tenant: first passes (burst 1), second rejected with the reason
+    assert ctl.decide("slow", request_tokens=16, queued_tokens=0).admit
+    d = ctl.decide("slow", request_tokens=16, queued_tokens=0)
+    assert not d.admit and d.reason == "quota" and d.retry_after_s > 0
+    assert ctl.rejected == {"fast": 1, "slow": 1}
+    # out-of-band rejects (the gateway's queue_full path) land in the same
+    # per-tenant book via the public reject()
+    d = ctl.reject("slow", "queue_full")
+    assert not d.admit and ctl.rejected["slow"] == 2
+
+
+def test_slo_estimator_fleet_parallelism():
+    """Completions observe PER-REQUEST rate; with B slots the backlog
+    drains ~B× faster, so the predictor scales by the configured fleet
+    parallelism — otherwise it overestimates waits by ~B and sheds
+    traffic the fleet would serve comfortably."""
+    from dalle_tpu.gateway import SloEstimator
+    solo = SloEstimator()
+    fleet = SloEstimator(parallelism=4)
+    for est in (solo, fleet):
+        est.observe(tokens=100, seconds=1.0)
+    assert solo.predict_completion_s(900, 100) == pytest.approx(10.0)
+    assert fleet.predict_completion_s(900, 100) == pytest.approx(2.5)
+
+
+# ---------------------------------------------------------------------------
+# SSE framing (host-only)
+# ---------------------------------------------------------------------------
+
+def test_sse_event_roundtrip():
+    import io
+    from dalle_tpu.gateway import iter_sse, sse_event
+    frames = (sse_event("row", {"request_id": 1, "row": 0,
+                                "tokens": [5, 6, 7]})
+              + b": keepalive comment\n\n"
+              + sse_event("done", {"request_id": 1, "tokens": [5, 6, 7]}))
+    parsed = list(iter_sse(io.BytesIO(frames)))
+    assert parsed == [("row", {"request_id": 1, "row": 0,
+                               "tokens": [5, 6, 7]}),
+                      ("done", {"request_id": 1, "tokens": [5, 6, 7]})]
+
+
+def test_row_pixel_decoder_bands():
+    """Committed-prefix preview: the decoder is handed rows 0..r and crops
+    row r's pixel band — shapes and dtype pinned with a stub vae (the real
+    dVAE path is exercised by the gateway smoke)."""
+    from dalle_tpu.gateway import RowPixelDecoder
+
+    class StubVae:
+        def decode(self, ids):           # (1, 16) ids → (1, 8, 8, 3) image
+            assert ids.shape == (1, 16)
+            # encode how many tokens were committed into the pixel value
+            frac = float((ids != 0).sum()) / 16.0
+            return np.full((1, 8, 8, 3), frac, np.float32)
+
+    dec = RowPixelDecoder(StubVae(), image_fmap_size=4)
+    out0 = dec.row_event(7, 0, [1, 2, 3, 4])
+    band0 = np.frombuffer(base64.b64decode(out0["pixels_b64"]),
+                          np.uint8).reshape(out0["pixels_shape"])
+    assert band0.shape == (2, 8, 3) and band0.dtype == np.uint8
+    out1 = dec.row_event(7, 1, [5, 6, 7, 8])
+    band1 = np.frombuffer(base64.b64decode(out1["pixels_b64"]),
+                          np.uint8).reshape(out1["pixels_shape"])
+    # second row's decode saw 8 committed tokens, first saw 4
+    assert band1[0, 0, 0] > band0[0, 0, 0]
+    dec.finish(7)
+    assert 7 not in dec._rows
+
+
+def test_result_stream_timeout_is_replica_failure():
+    from dalle_tpu.gateway import ResultStream
+    s = ResultStream(request=None)
+    events = list(s.events(timeout=0.05))
+    assert events == [("replica_failed", "event timeout")]
+
+
+# ---------------------------------------------------------------------------
+# engine streaming + replica fleet (jax)
+# ---------------------------------------------------------------------------
+
+def test_engine_on_rows_streams_committed_rows(model_params, refs):
+    """on_rows fires per committed fmap row, in order, and the concatenated
+    rows equal the final tokens — incl. the trailing partial row of a
+    max_tokens request."""
+    from dalle_tpu.serve import RequestQueue
+    model, params = model_params
+    q = RequestQueue()
+    q.submit(TEXTS[0], seed=100, request_id=0)
+    q.submit(TEXTS[1], seed=101, request_id=1, max_tokens=6)
+    q.close()
+    rows = {0: [], 1: []}
+    eng = _engine(model_params)
+    done = eng.run(q, on_rows=lambda req, row, toks:
+                   rows[req.request_id].append((row, list(toks))))
+    assert sorted(c.request_id for c in done) == [0, 1]
+    fmap = CFG["image_fmap_size"]
+    assert [r for r, _ in rows[0]] == list(range(fmap))
+    assert all(len(t) == fmap for _, t in rows[0])
+    assert [t for _, ts in rows[0] for t in ts] == refs[0].tolist()
+    # 6 tokens = one full row + a 2-token trailing partial row
+    assert [(r, len(t)) for r, t in rows[1]] == [(0, 4), (1, 2)]
+    assert [t for _, ts in rows[1] for t in ts] == refs[1][:6].tolist()
+
+
+def test_replica_failover_midstream_exact(model_params, refs):
+    """Replica A dies after 2 streamed rows; the router resubmits to B and
+    the spliced stream delivers every row exactly once — final tokens
+    bitwise-equal the single-request reference, B serving."""
+    from dalle_tpu.gateway import Replica, ReplicaRouter
+    ra = Replica(_engine(model_params), replica_id="ga").start()
+    rb = Replica(_engine(model_params), replica_id="gb").start()
+    router = ReplicaRouter([ra, rb])
+    ra.fail_after_rows(2)
+    routed = router.submit(TEXTS[2], 102)
+    assert routed.replica_id == "ga"        # both idle → list order
+    rows, done = [], None
+    for kind, payload in routed.events(timeout=60):
+        if kind == "row":
+            rows.append(payload)
+        elif kind == "done":
+            done = payload
+    assert [r["row"] for r in rows] == list(range(CFG["image_fmap_size"]))
+    assert [t for r in rows for t in r["tokens"]] == refs[2].tolist()
+    assert done["tokens"] == refs[2].tolist()
+    assert done["replica"] == "gb" and done["failovers"] == 1
+    assert not ra.healthy and rb.healthy
+    router.drain(timeout=30)
+
+
+def test_replica_deadline_shed_event(model_params):
+    """PriorityDeadlinePolicy sheds an already-expired request at take time
+    and its stream terminates with the shed event (gateway → 504), while
+    the live request completes."""
+    from dalle_tpu.gateway import Replica
+    from dalle_tpu.serve import PriorityDeadlinePolicy
+    rep = Replica(_engine(model_params),    # slots=2: shares programs with
+                  policy=PriorityDeadlinePolicy()).start()   # the module's
+    live = [rep.submit(TEXTS[i], 100 + i) for i in range(2)]  # other engines
+    dead = rep.submit(TEXTS[2], 102,
+                      deadline_at=time.perf_counter() - 1.0)
+    kinds = [k for k, _ in dead.events(timeout=60)]
+    assert kinds == ["shed"]
+    for s in live:
+        assert [k for k, _ in s.events(timeout=60)][-1] == "done"
+    assert rep.queue.shed_total == 1
+    rep.drain(timeout=30)
+
+
+def test_gateway_loopback_stream_quota_health(model_params, refs):
+    """One real socket round-trip: SSE stream bit-exact, second request of
+    a burst-1 tenant → 429 + Retry-After, /healthz and /metrics live, 404
+    for unknown paths, drain flips to 503."""
+    import http.client
+    from dalle_tpu import obs
+    from dalle_tpu.gateway import (AdmissionController, Gateway, Replica,
+                                   ReplicaRouter, TenantQuotas, iter_sse)
+    obs.configure()
+    try:
+        rep = Replica(_engine(model_params), maxsize=8).start()
+        gw = Gateway(ReplicaRouter([rep]), AdmissionController(TenantQuotas(
+            rate_per_s=100.0, burst=100.0,
+            overrides={"capped": (0.001, 1)}))).start()
+        host, port = gw.httpd.server_address[:2]
+
+        def post(payload):
+            conn = http.client.HTTPConnection(host, port, timeout=60)
+            conn.request("POST", "/v1/generate", json.dumps(payload))
+            return conn, conn.getresponse()
+
+        conn, resp = post({"text": TEXTS[0].tolist(), "seed": 100,
+                           "stream": True})
+        assert resp.status == 200
+        assert resp.getheader("Content-Type") == "text/event-stream"
+        events = list(iter_sse(resp))
+        conn.close()
+        rows = [d for e, d in events if e == "row"]
+        done = [d for e, d in events if e == "done"]
+        assert [t for r in rows for t in r["tokens"]] == refs[0].tolist()
+        assert done and done[0]["tokens"] == refs[0].tolist()
+
+        conn, resp = post({"text": TEXTS[1].tolist(), "seed": 101,
+                           "tenant": "capped"})
+        assert resp.status == 200
+        assert json.loads(resp.read())["tokens"] == refs[1].tolist()
+        conn.close()
+        conn, resp = post({"text": TEXTS[2].tolist(), "seed": 102,
+                           "tenant": "capped"})
+        body = json.loads(resp.read())
+        assert resp.status == 429 and body["error"] == "quota"
+        assert float(resp.getheader("Retry-After")) > 0
+        conn.close()
+
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        conn.request("GET", "/healthz")
+        health = json.loads(conn.getresponse().read())
+        assert health["status"] == "ok"
+        assert health["replicas"][0]["healthy"]
+        conn.close()
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        conn.request("GET", "/metrics")
+        metrics = conn.getresponse().read().decode()
+        assert "dalle_gateway_rejected_total" in metrics
+        assert "dalle_gateway_inflight 0" in metrics
+        conn.close()
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        conn.request("GET", "/nope")
+        assert conn.getresponse().status == 404
+        conn.close()
+
+        gw.shutdown(drain=True, timeout=30)
+        assert not rep.healthy          # worker exited at drain
+    finally:
+        obs.disable()
+
+
+# ---------------------------------------------------------------------------
+# AOT cold start (jax)
+# ---------------------------------------------------------------------------
+
+def test_aot_roundtrip_equality_and_fingerprint(model_params, refs,
+                                                tmp_path):
+    """Serialized executables round-trip: an AOT-loaded engine's tokens are
+    bitwise-equal the jit-traced execution (and the reference); a
+    config-mismatched engine refuses the bundle (False, or raises under
+    strict). The zero-backend-compile cold-start assertion lives in
+    scripts/gateway_smoke.py, which builds the cold engine over a fresh
+    model instance so engine-level program sharing can't make the zero
+    vacuous."""
+    from dalle_tpu.gateway import (engine_fingerprint, load_engine_aot,
+                                   save_engine_aot)
+    from dalle_tpu.serve import RequestQueue
+    aot_dir = str(tmp_path / "aot")
+    exporter = _engine(model_params)
+    manifest = save_engine_aot(exporter, aot_dir)
+    assert manifest["fingerprint"] == engine_fingerprint(exporter)
+    assert set(manifest["payload_bytes"]) == {"step", "refill",
+                                              "refill_row"}
+
+    # jit-traced execution of the SAME programs, for the equality bar
+    q = RequestQueue()
+    for i, t in enumerate(TEXTS):
+        q.submit(t, seed=100 + i, request_id=i)
+    q.close()
+    jit_done = {c.request_id: c.tokens for c in exporter.run(q)}
+
+    cold = _engine(model_params)
+    assert load_engine_aot(cold, aot_dir, strict=True)
+    assert cold.aot_loaded
+    q = RequestQueue()
+    for i, t in enumerate(TEXTS):
+        q.submit(t, seed=100 + i, request_id=i)
+    q.close()
+    cold_done = {c.request_id: c.tokens for c in cold.run(q)}
+    for i in range(len(TEXTS)):
+        np.testing.assert_array_equal(cold_done[i], jit_done[i])
+        np.testing.assert_array_equal(cold_done[i], refs[i])
+
+    # an AOT-loaded engine can't be re-exported (nothing left to lower)
+    with pytest.raises(ValueError, match="AOT-loaded"):
+        save_engine_aot(cold, str(tmp_path / "aot2"))
+
+    # mismatched config (different slot count → different programs)
+    other = _engine(model_params, slots=3)
+    assert load_engine_aot(other, aot_dir) is False
+    assert not other.aot_loaded
+    with pytest.raises(ValueError, match="fingerprint mismatch on 'slots'"):
+        load_engine_aot(other, aot_dir, strict=True)

@@ -18,9 +18,12 @@ from dalle_tpu.serve import DecodeEngine, RequestQueue, SlotScheduler
 
 # ceiling = the module's cold full-run total (measured 722 with the int8w
 # default-path matrix) + ~15% slack for cross-jax-version compile-count
-# variance (the test_speculative convention). Each engine instance compiles
-# its own refill+step pair; an engine change that recompiles per admission
-# or per slot count would blow straight through this.
+# variance (the test_speculative convention). Since PR 7 engines over the
+# same model object share compiled programs per config key
+# (serve/engine.py _shared_programs), so same-config tests stopped paying
+# repeat compiles; the ceiling is kept at the pre-sharing calibration — an
+# engine change that recompiles per admission, per slot count or per
+# engine INSTANCE would blow straight through it.
 pytestmark = pytest.mark.recompile_budget(830)
 
 CFG = dict(num_text_tokens=32, text_seq_len=6, dim=32, depth=2, heads=2,
@@ -81,6 +84,61 @@ def test_queue_rejects_stale_explicit_ids():
     assert nxt.request_id == 8
     with pytest.raises(ValueError):
         q.submit(np.zeros(6, np.int32), seed=6, max_tokens=0)
+
+
+def test_queue_bounded_rejects_on_full():
+    """maxsize bounds the BACKLOG: submit on a full queue raises QueueFull
+    (the gateway's 429) instead of growing without bound, FIFO order is
+    untouched, and taking frees capacity."""
+    from dalle_tpu.serve import QueueFull
+    q = RequestQueue(maxsize=2)
+    r1 = q.submit(np.zeros(6, np.int32), seed=1)
+    q.submit(np.zeros(6, np.int32), seed=2)
+    with pytest.raises(QueueFull):
+        q.submit(np.zeros(6, np.int32), seed=3)
+    assert q.qsize() == 2                      # rejected, not enqueued
+    assert q.take(1) == [r1]                   # FIFO across the rejection
+    r4 = q.submit(np.zeros(6, np.int32), seed=4)   # take freed capacity
+    assert [r.request_id for r in q.take(5)][-1] == r4.request_id
+    with pytest.raises(ValueError):
+        RequestQueue(maxsize=0)
+
+
+def test_policy_queue_fifo_default_matches_base():
+    """A PolicyQueue without an explicit policy is bit-identical to the
+    FIFO base: same take order, nothing shed — the pinned default."""
+    from dalle_tpu.serve import PolicyQueue
+    pq = PolicyQueue(maxsize=3)
+    ids = [pq.submit(np.zeros(6, np.int32), seed=i,
+                     priority=i, deadline_at=None).request_id
+           for i in range(3)]
+    assert [r.request_id for r in pq.take(2)] == ids[:2]
+    assert [r.request_id for r in pq.take(2)] == ids[2:]
+    assert pq.shed_total == 0
+
+
+def test_policy_queue_priority_deadline_order_and_shed():
+    """PriorityDeadlinePolicy: priority tiers first, then earliest
+    deadline, then FIFO; an already-expired request is shed at take time
+    and handed to on_shed, never to a slot."""
+    from dalle_tpu.serve import PolicyQueue, PriorityDeadlinePolicy
+    shed = []
+    pq = PolicyQueue(policy=PriorityDeadlinePolicy(),
+                     on_shed=shed.append)
+    now = time.perf_counter()
+    lo = pq.submit(np.zeros(6, np.int32), seed=1)               # prio 0
+    hi_late = pq.submit(np.zeros(6, np.int32), seed=2, priority=5)
+    hi_soon = pq.submit(np.zeros(6, np.int32), seed=3, priority=5,
+                        deadline_at=now + 100)
+    expired = pq.submit(np.zeros(6, np.int32), seed=4,
+                        deadline_at=now - 0.1)
+    got = pq.take(2)
+    # same tier: the deadlined request precedes the open-ended one
+    assert [r.request_id for r in got] == [hi_soon.request_id,
+                                           hi_late.request_id]
+    assert [r.request_id for r in shed] == [expired.request_id]
+    assert pq.shed_total == 1
+    assert [r.request_id for r in pq.take(5)] == [lo.request_id]
 
 
 def test_scheduler_invariants():
@@ -364,17 +422,25 @@ def test_engine_spans_and_gauges(model_params):
         by_name = {}
         for name, rel, dur, tid, depth, args in spans:
             by_name.setdefault(name, []).append((dur, args))
-        for want in ("serve/request", "serve/request_ttft"):
+        for want in ("serve/request", "serve/request_ttft",
+                     "serve/request_queue_wait"):
             got = by_name.get(want, [])
             assert len(got) == 3, f"missing {want} spans: {by_name.keys()}"
             ids = sorted(a["request_id"] for _, a in got)
             assert ids == [0, 1, 2]
             assert all(d >= 0 for d, _ in got)
+        # queue wait ≤ TTFT per request: the wait span measures exactly the
+        # submission→admission segment of the TTFT span
+        qw = {a["request_id"]: d
+              for d, a in by_name["serve/request_queue_wait"]}
+        tt = {a["request_id"]: d for d, a in by_name["serve/request_ttft"]}
+        assert all(qw[i] <= tt[i] for i in qw)
         m = obs.metrics_snapshot()
         assert m["serve.requests_completed_total"] == 3
         assert m["serve.tokens_emitted_total"] == sum(
             c.tokens.shape[0] for c in done)
         assert m["serve.slot_occupancy"] >= 0
         assert m["serve.queue_depth"] == 0
+        assert m["serve.queue_wait_s"] >= 0
     finally:
         obs.disable()
