@@ -134,9 +134,10 @@ class Decision:
 
 class AdmissionController:
     """Quota gate then SLO gate, with per-tenant reject accounting. The
-    obs counters it maintains (``gateway.rejected_total`` +
-    ``gateway.<tenant>.rejected_total``) feed the Prometheus textfile and
-    obs_report's gateway verdict line."""
+    obs counters it maintains — the stable unlabeled fleet sum
+    ``gateway.rejected_total`` plus the labeled
+    ``gateway.rejected_by_total{tenant=...,reason=...}`` series — feed the
+    Prometheus textfile/endpoint and obs_report's gateway verdict line."""
 
     def __init__(self, quotas: Optional[TenantQuotas] = None,
                  slo: Optional[SloEstimator] = None):
@@ -153,8 +154,10 @@ class AdmissionController:
         with self._lock:
             self.rejected[tenant] = self.rejected.get(tenant, 0) + 1
         counter_add("gateway.rejected_total", 1.0)
-        counter_add(f"gateway.{tenant}.rejected_total", 1.0)
-        counter_add(f"gateway.rejected_{reason}_total", 1.0)
+        # dimensions as REAL labels (one family, PromQL `sum by (tenant)`),
+        # not mangled into per-tenant/per-reason metric names
+        counter_add("gateway.rejected_by_total", 1.0,
+                    labels={"tenant": tenant, "reason": reason})
         return Decision(admit=False, reason=reason, **kw)
 
     def decide(self, tenant: str, *, request_tokens: int,

@@ -25,7 +25,7 @@ import queue as _queue
 import threading
 from typing import Callable, List, Optional, Tuple
 
-from ..obs import counter_add, gauge_set
+from ..obs import counter_add, dump_recorder, record_event
 from ..serve.queue import Request
 from ..serve.scheduler import PolicyQueue, SchedulingPolicy
 
@@ -125,6 +125,17 @@ class Replica:
             with self._lock:
                 streams = list(self._streams.values())
                 self._streams.clear()
+            # black box first, THEN fail the streams: the bundle freezes
+            # the dying worker's last spans and in-flight ids before the
+            # router starts resubmitting (obs/recorder.py; no-op unless a
+            # recorder is configured)
+            record_event("replica_failed", replica_id=self.replica_id,
+                         error=repr(exc),
+                         inflight=[s.request.trace_id if s.request else None
+                                   for s in streams])
+            dump_recorder("replica_death",
+                          extra={"replica_id": self.replica_id,
+                                 "error": repr(exc)})
             for s in streams:
                 s.put("replica_failed", repr(exc))
 
@@ -165,7 +176,8 @@ class Replica:
     # -- submission --------------------------------------------------------
     def submit(self, text, seed: int, *, max_tokens: Optional[int] = None,
                tenant: str = "default", priority: int = 0,
-               deadline_at: Optional[float] = None) -> ResultStream:
+               deadline_at: Optional[float] = None,
+               trace_id: Optional[str] = None) -> ResultStream:
         if not self.healthy:
             raise ReplicaFailure(f"{self.replica_id} is not serving")
         # register the stream BEFORE the request becomes takeable: the
@@ -180,7 +192,8 @@ class Replica:
             req = self.queue.submit(text, seed, request_id=rid,
                                     max_tokens=max_tokens, tenant=tenant,
                                     priority=priority,
-                                    deadline_at=deadline_at)
+                                    deadline_at=deadline_at,
+                                    trace_id=trace_id)
         except BaseException:  # noqa: BLE001 - re-raised; the pre-registered
             # stream must be unwound for ANY submit failure (incl.
             # KeyboardInterrupt) or the id leaks a dead stream entry
@@ -217,6 +230,10 @@ class Replica:
 
     def _on_shed(self, req: Request) -> None:
         counter_add("gateway.shed_total", 1.0)
+        counter_add("gateway.shed_by_total", 1.0,
+                    labels={"tenant": req.tenant})
+        record_event("request_shed", request_id=req.request_id,
+                     trace_id=req.trace_id, tenant=req.tenant)
         s = self._stream_for(req.request_id, pop=True)
         if s is not None:
             s.put("shed", req)

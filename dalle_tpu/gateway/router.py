@@ -21,7 +21,8 @@ import itertools
 import time
 from typing import List, Optional
 
-from ..obs import counter_add, gauge_set
+from ..obs import counter_add, dump_recorder, gauge_set, record_event
+from ..obs.context import new_trace_id
 from ..serve.queue import QueueFull
 from .replica import Replica, ReplicaFailure, ResultStream
 
@@ -58,6 +59,10 @@ class RoutedStream:
     def replica_id(self) -> str:
         return self._replica.replica_id
 
+    @property
+    def trace_id(self) -> str:
+        return self._kw["trace_id"]
+
     def events(self, timeout: Optional[float] = 30.0):
         next_row = 0
         while True:
@@ -89,6 +94,13 @@ class RoutedStream:
                 else:                      # replica_failed
                     counter_add("gateway.failovers_total", 1.0)
                     self.failovers += 1
+                    # lifecycle event BEFORE the resubmission attempt, then
+                    # a post-mortem bundle: the bundle's event ring holds
+                    # this failover next to the replica_failed event, and
+                    # its trace still holds the dead worker's last spans
+                    record_event("failover", trace_id=self._kw["trace_id"],
+                                 from_replica=self._replica.replica_id,
+                                 failovers=self.failovers, detail=payload)
                     if self.failovers > len(self.router.replicas):
                         # failover budget: a request that has killed (or
                         # been failed by) more replicas than the fleet has
@@ -99,6 +111,10 @@ class RoutedStream:
                                                    "exhausted"})
                         return
                     try:
+                        # resubmission reuses self._kw VERBATIM — same
+                        # text, same seed, same trace_id — so the resumed
+                        # stream is bit-identical AND the request keeps one
+                        # timeline identity across both replicas
                         self._replica, self._stream = \
                             self.router._dispatch(**self._kw)
                     except (NoReplicaAvailable, QueueFull) as exc:
@@ -106,6 +122,9 @@ class RoutedStream:
                                          "detail": f"no failover target: "
                                                    f"{exc}"})
                         return
+                    dump_recorder("failover", extra={
+                        "trace_id": self._kw["trace_id"],
+                        "resubmitted_to": self._replica.replica_id})
                     break                  # re-enter on the new stream
             else:
                 return
@@ -155,15 +174,22 @@ class ReplicaRouter:
 
     def submit(self, text, seed: int, *, max_tokens: Optional[int] = None,
                tenant: str = "default", priority: int = 0,
-               deadline_s: Optional[float] = None) -> RoutedStream:
+               deadline_s: Optional[float] = None,
+               trace_id: Optional[str] = None) -> RoutedStream:
         """Dispatch one request; raises QueueFull / NoReplicaAvailable when
-        nothing can take it (the gateway maps those to 429/503)."""
+        nothing can take it (the gateway maps those to 429/503).
+        ``trace_id`` is the propagated graftscope identity (minted here for
+        direct callers); it rides the resubmission kwargs, so a failover
+        keeps the request on one timeline."""
         if self.draining:
             raise NoReplicaAvailable("gateway is draining")
+        if trace_id is None:
+            trace_id = new_trace_id()
         deadline_at = (time.perf_counter() + deadline_s
                        if deadline_s is not None else None)
         kw = dict(text=text, seed=seed, max_tokens=max_tokens,
-                  tenant=tenant, priority=priority, deadline_at=deadline_at)
+                  tenant=tenant, priority=priority, deadline_at=deadline_at,
+                  trace_id=trace_id)
         replica, stream = self._dispatch(**kw)
         return RoutedStream(self, stream, replica, kw, next(_gids))
 

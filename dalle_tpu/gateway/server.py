@@ -36,26 +36,48 @@ from typing import Optional
 
 import numpy as np
 
-from ..obs import counter_add, gauge_set, metrics_snapshot, render_textfile
+from ..obs import (BurnRateSentry, counter_add, dump_recorder, gauge_set,
+                   metrics_snapshot, record_event, render_textfile, span,
+                   trace_context)
+from ..obs.context import new_trace_id
 from ..serve.queue import QueueFull
 from .admission import AdmissionController
 from .router import NoReplicaAvailable, ReplicaRouter
 from .sse import RowPixelDecoder, sse_event
 
 
+def _default_sentry() -> BurnRateSentry:
+    def on_breach(verdict):
+        counter_add("slo.breaches_total", 1.0)
+        dump_recorder("slo_breach", extra={
+            "dominating": verdict["dominating"],
+            "windows": verdict["windows"]})
+    return BurnRateSentry(on_breach=on_breach)
+
+
 class Gateway:
     """Binds the HTTP server to a router + admission controller. ``port=0``
     picks an ephemeral port (tests/smoke run loopback). ``vae`` enables
-    per-row pixel previews for ``"pixels": true`` requests."""
+    per-row pixel previews for ``"pixels": true`` requests.
+
+    ``slo_sentry`` (obs/slo.py) watches the admission/completion/shed
+    stream: every request outcome at this door is one burn-rate
+    observation. The default sentry publishes the ``dalle_slo_*`` gauges
+    and dumps a flight-recorder bundle on the ok→BURNING transition; pass
+    an explicitly configured one to share windows across gateways or wire
+    a different breach sink."""
 
     def __init__(self, router: ReplicaRouter,
                  admission: Optional[AdmissionController] = None, *,
                  host: str = "127.0.0.1", port: int = 0,
                  vae=None, image_fmap_size: Optional[int] = None,
-                 image_seq_len: Optional[int] = None):
+                 image_seq_len: Optional[int] = None,
+                 slo_sentry: Optional[BurnRateSentry] = None):
         self.router = router
         self.admission = (admission if admission is not None
                           else AdmissionController())
+        self.slo_sentry = (slo_sentry if slo_sentry is not None
+                           else _default_sentry())
         self.vae = vae
         self.image_fmap_size = image_fmap_size
         # per-request token demand for SLO math: the full grid unless the
@@ -126,11 +148,18 @@ def _make_handler(gw: Gateway):
             pass
 
         # -- helpers -------------------------------------------------------
+        _trace_id: Optional[str] = None
+
         def _json(self, code: int, payload: dict, headers=()):
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if self._trace_id is not None:
+                # the graftscope identity echoes on EVERY response —
+                # including 4xx/5xx — so a client log line always joins
+                # against the server timeline
+                self.send_header("X-Request-Id", self._trace_id)
             for k, v in headers:
                 self.send_header(k, v)
             self.end_headers()
@@ -160,6 +189,15 @@ def _make_handler(gw: Gateway):
                 self._json(404, {"error": "not_found", "path": self.path})
                 return
             counter_add("gateway.requests_total", 1.0)
+            # the HTTP door mints the request's one identity; binding it as
+            # the thread's ambient trace context tags every span this
+            # connection thread records (gateway/request, SSE flushes) with
+            # the same id the engine threads tag via Request.trace_id
+            tid = self._trace_id = new_trace_id()
+            with trace_context(tid), span("gateway/request"):
+                self._generate(tid)
+
+        def _generate(self, tid: str):
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(n) or b"{}")
@@ -198,6 +236,9 @@ def _make_handler(gw: Gateway):
                 queued_tokens=gw.router.total_backlog * gw.image_seq_len,
                 deadline_s=deadline_s)
             if not decision.admit:
+                gw.slo_sentry.record(False, decision.reason)
+                record_event("request_rejected", trace_id=tid,
+                             tenant=tenant, reason=decision.reason)
                 headers = []
                 if decision.retry_after_s is not None:
                     headers.append(("Retry-After",
@@ -215,42 +256,66 @@ def _make_handler(gw: Gateway):
                     routed = gw.router.submit(
                         text, seed, max_tokens=max_tokens, tenant=tenant,
                         priority=int(body.get("priority", 0)),
-                        deadline_s=deadline_s)
+                        deadline_s=deadline_s, trace_id=tid)
                 except QueueFull as exc:
                     gw.admission.reject(tenant, "queue_full")
+                    gw.slo_sentry.record(False, "queue_full")
                     self._json(429, {"error": "queue_full",
                                      "detail": str(exc)},
                                [("Retry-After", "0.5")])
                     return
                 except NoReplicaAvailable as exc:
-                    self._json(503, {"error": "draining" if
-                                     gw.router.draining else "no_replica",
-                                     "detail": str(exc)})
+                    reason = ("draining" if gw.router.draining
+                              else "no_replica")
+                    gw.slo_sentry.record(False, reason)
+                    self._json(503, {"error": reason, "detail": str(exc)})
                     return
+                record_event("request_submitted", trace_id=tid,
+                             tenant=tenant,
+                             replica=routed.replica_id)
                 if body.get("stream", False):
-                    self._stream(routed, bool(body.get("pixels", False)))
+                    self._stream(routed, bool(body.get("pixels", False)),
+                                 deadline_s)
                 else:
-                    self._blocking(routed)
+                    self._blocking(routed, deadline_s)
             finally:
                 gw._exit()
 
-        def _blocking(self, routed):
+        def _record_outcome(self, kind: str, payload: dict,
+                            deadline_s) -> None:
+            """One burn-rate observation per finished request: a
+            completion that beat its deadline is good; a shed, failover
+            exhaustion or deadline overrun is budget burned."""
+            if kind == "done":
+                late = (deadline_s is not None
+                        and payload.get("latency_s", 0.0) > deadline_s)
+                gw.slo_sentry.record(not late,
+                                     "deadline_miss" if late else "")
+            else:
+                gw.slo_sentry.record(False, payload.get("reason", "error"))
+
+        def _blocking(self, routed, deadline_s):
             for kind, payload in routed.events():
                 if kind == "done":
+                    self._record_outcome(kind, payload, deadline_s)
                     self._json(200, {"request_id": routed.gateway_id,
+                                     "trace_id": routed.trace_id,
                                      **payload})
                     return
                 if kind == "error":
+                    self._record_outcome(kind, payload, deadline_s)
                     code = 504 if payload["reason"] == "deadline_shed" \
                         else 503
                     self._json(code, payload)
                     return
             self._json(500, {"error": "stream_ended_without_result"})
 
-        def _stream(self, routed, pixels: bool):
+        def _stream(self, routed, pixels: bool, deadline_s):
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
+            if self._trace_id is not None:
+                self.send_header("X-Request-Id", self._trace_id)
             self.end_headers()
             decoder = None
             if pixels and gw.vae is not None:
@@ -258,14 +323,21 @@ def _make_handler(gw: Gateway):
             rid = routed.gateway_id
             try:
                 for kind, payload in routed.events():
-                    data = {"request_id": rid, **payload}
+                    data = {"request_id": rid,
+                            "trace_id": routed.trace_id, **payload}
                     if kind == "row" and decoder is not None:
                         # pixel preview decoded HERE, on the connection
                         # thread — never the engine thread
                         data.update(decoder.row_event(
                             rid, payload["row"], payload["tokens"]))
-                    self.wfile.write(sse_event(kind, data))
-                    self.wfile.flush()
+                    if kind in ("done", "error"):
+                        self._record_outcome(kind, payload, deadline_s)
+                    # the flush is the client-visible commit of a row —
+                    # the last segment of the request timeline (tagged via
+                    # the ambient trace context bound in do_POST)
+                    with span("gateway/sse_flush", event=kind):
+                        self.wfile.write(sse_event(kind, data))
+                        self.wfile.flush()
             except (BrokenPipeError, ConnectionResetError):
                 counter_add("gateway.client_disconnects_total", 1.0)
             finally:

@@ -11,23 +11,36 @@ global ``None`` check until ``configure()`` enables tracing
 (``TrainConfig.obs.trace`` / ``--obs.trace true`` from the CLIs).
 """
 
+from .context import current_trace_id, new_trace_id, trace_context
 from .prometheus import render_textfile, sanitize_metric_name, write_textfile
-from .report import span_overhead_s, summarize_run
+from .recorder import (FlightRecorder, collect_state, configure_recorder,
+                       disable_recorder, dump_recorder, get_recorder,
+                       install_signal_dump, record_event,
+                       register_state_provider, unregister_state_provider)
+from .report import (format_request_timeline, request_timeline,
+                     span_overhead_s, summarize_run)
+from .slo import BurnRateSentry
 from .trace import (Tracer, configure, counter_add, disable, enabled,
                     export_chrome_trace, export_spans_jsonl, gauge_set,
-                    get_tracer, metrics_snapshot, open_spans, record_span,
-                    span)
+                    get_tracer, labeled_name, metrics_snapshot, open_spans,
+                    record_span, span)
 from .watchdog import StallReport, StallWatchdog
 
 _DEVICE_NAMES = ("CompileCounter", "DeviceTelemetry", "device_memory_stats",
                  "device_memory_headroom", "install_compile_counter")
 
 __all__ = [
-    *_DEVICE_NAMES, "render_textfile", "sanitize_metric_name",
-    "write_textfile", "span_overhead_s", "summarize_run", "Tracer",
-    "configure", "counter_add", "disable", "enabled", "export_chrome_trace",
-    "export_spans_jsonl", "gauge_set", "get_tracer", "metrics_snapshot",
-    "open_spans", "record_span", "span", "StallReport", "StallWatchdog",
+    *_DEVICE_NAMES, "current_trace_id", "new_trace_id", "trace_context",
+    "render_textfile", "sanitize_metric_name", "write_textfile",
+    "FlightRecorder", "collect_state", "configure_recorder",
+    "disable_recorder", "dump_recorder", "get_recorder",
+    "install_signal_dump", "record_event", "register_state_provider",
+    "unregister_state_provider", "format_request_timeline",
+    "request_timeline", "span_overhead_s", "summarize_run",
+    "BurnRateSentry", "Tracer", "configure", "counter_add", "disable",
+    "enabled", "export_chrome_trace", "export_spans_jsonl", "gauge_set",
+    "get_tracer", "labeled_name", "metrics_snapshot", "open_spans",
+    "record_span", "span", "StallReport", "StallWatchdog",
 ]
 
 

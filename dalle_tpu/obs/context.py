@@ -1,0 +1,58 @@
+"""graftscope trace context: one identity per request, across every hop.
+
+PR 7 turned serving into a distributed system — a request crosses the
+gateway connection thread, the router, a replica worker thread (possibly
+TWO, after a mid-stream failover), the engine loop, and back out through an
+SSE writer. Thread-scoped spans can time each hop but cannot answer "where
+did request X spend its 2.1 s" because nothing ties the hops together.
+
+This module is the Dapper-style propagated context (Sigelman et al., 2010)
+that does: a ``trace_id`` minted once at the system's edge (the HTTP door
+in gateway/server.py, or ``RequestQueue.submit`` for CLI/bench producers)
+and carried BY VALUE on the ``Request`` object through queue → scheduler →
+engine slot, and by THREAD-LOCAL AMBIENT CONTEXT (``trace_context``) on
+connection threads, so every span recorded while handling the request —
+stack-based or retrospective — is tagged with the same id. The id is echoed
+back as the ``X-Request-Id`` response header and in SSE events, so a client
+log line can be joined against the server's Perfetto timeline.
+
+Pure stdlib, no jax: importable from host-side data paths and the
+flight recorder without dragging in a backend.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import uuid
+from typing import Iterator, Optional
+
+_LOCAL = threading.local()
+
+
+def new_trace_id() -> str:
+    """Mint a fresh trace id (16 hex chars — unique per request, short
+    enough to grep and to echo in an HTTP header)."""
+    return uuid.uuid4().hex[:16]
+
+
+def current_trace_id() -> Optional[str]:
+    """The ambient trace id bound to THIS thread (None outside any
+    ``trace_context``). Spans recorded while one is bound are tagged with
+    it automatically (obs/trace.py)."""
+    return getattr(_LOCAL, "trace_id", None)
+
+
+@contextlib.contextmanager
+def trace_context(trace_id: Optional[str]) -> Iterator[Optional[str]]:
+    """Bind ``trace_id`` as this thread's ambient trace context for the
+    duration of the block (nestable; the previous binding is restored on
+    exit, even on exceptions). Binding ``None`` clears the context — a
+    worker that multiplexes requests can open a fresh scope per unit of
+    work without inheriting a stale id."""
+    prev = getattr(_LOCAL, "trace_id", None)
+    _LOCAL.trace_id = trace_id
+    try:
+        yield trace_id
+    finally:
+        _LOCAL.trace_id = prev

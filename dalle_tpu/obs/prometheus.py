@@ -10,6 +10,15 @@ Metric naming: dots/slashes become underscores and everything gets a
 ``dalle_`` prefix; names ending in ``_total`` are typed ``counter``,
 everything else ``gauge``. Writes go to ``<path>.tmp`` + ``os.replace`` so a
 scrape never reads a torn file.
+
+Labeled series: registry keys carry their dimensions in the Prometheus
+sample spelling itself — ``gateway.rejected_by_total{reason="quota",
+tenant="capped"}`` (``obs.counter_add(..., labels={...})`` builds them).
+The renderer splits the label block off before sanitizing the name, groups
+every series of a family under ONE ``# TYPE`` line, and emits real
+``{k="v"}`` samples — so PromQL can ``sum by (tenant)`` instead of
+regex-scraping dimensions mangled into metric names. Unlabeled names render
+exactly as before.
 """
 
 from __future__ import annotations
@@ -23,12 +32,18 @@ _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
 
 def sanitize_metric_name(name: str, prefix: str = "dalle_") -> str:
+    """Sanitize a registry key into a Prometheus name, preserving a
+    trailing ``{...}`` label block verbatim."""
+    labels = ""
+    if name.endswith("}") and "{" in name:
+        name, _, rest = name.partition("{")
+        labels = "{" + rest
     out = _NAME_RE.sub("_", name)
     if not out.startswith(prefix):
         out = prefix + out
     if out[0].isdigit():
         out = "_" + out
-    return out
+    return out + labels
 
 
 def render_textfile(metrics: dict, *, prefix: str = "dalle_",
@@ -38,6 +53,7 @@ def render_textfile(metrics: dict, *, prefix: str = "dalle_",
     lines = []
     ts = time.time() if timestamp is None else timestamp
     lines.append(f"# grafttrace export, unix_time={ts:.3f}")
+    typed = set()
     for name in sorted(metrics):
         v = metrics[name]
         if isinstance(v, bool):
@@ -45,8 +61,14 @@ def render_textfile(metrics: dict, *, prefix: str = "dalle_",
         if not isinstance(v, (int, float)):
             continue
         pname = sanitize_metric_name(name, prefix)
-        mtype = "counter" if pname.endswith("_total") else "gauge"
-        lines.append(f"# TYPE {pname} {mtype}")
+        family = pname.partition("{")[0]
+        if family not in typed:
+            # one TYPE line per family: labeled series of one metric sort
+            # adjacently (the label block follows the shared name), so the
+            # header lands before the family's first sample
+            typed.add(family)
+            mtype = "counter" if family.endswith("_total") else "gauge"
+            lines.append(f"# TYPE {family} {mtype}")
         lines.append(f"{pname} {v}")
     return "\n".join(lines) + "\n"
 

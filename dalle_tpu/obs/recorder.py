@@ -1,0 +1,284 @@
+"""graftscope flight recorder: an always-on black box for the serving path.
+
+A distributed serving failure (replica worker death, watchdog stall, SLO
+breach) is only debuggable from state captured BEFORE the failure — by the
+time an operator attaches, the queue depths, in-flight requests and last
+spans that explain it are gone. The flight recorder keeps a bounded record
+of exactly that, at near-zero steady-state cost, and dumps an atomic
+post-mortem bundle the moment something goes wrong:
+
+  * **lifecycle events** — a bounded ring of request/fleet events
+    (``record_event``: submit/admit/complete/shed/replica_failed/failover/
+    slo_breach), each a wall-clock-stamped dict. One deque append under a
+    lock per event — cheap enough for every request at serving rate.
+  * **state snapshots** — ``register_state_provider`` lets live subsystems
+    (the decode engine registers queue depth, slot occupancy and in-flight
+    request ids while ``run`` is active) expose a snapshot callable; the
+    recorder (and the stall watchdog, obs/watchdog.py) collect them at dump
+    time, and an optional low-rate sampler thread keeps a short history.
+  * **counter deltas** — the obs counter/gauge registry is snapshotted at
+    each dump with deltas vs the previous dump, so a bundle says what
+    happened RECENTLY, not just cumulatively.
+  * **recent spans** — the grafttrace ring (with per-request trace_id tags,
+    obs/context.py) exported into the bundle as a Perfetto trace with
+    request tracks: the dying worker's last spans, reassembled per request.
+
+Bundles are directories written atomically (staged under a dot-tmp name in
+the same parent, then ``os.replace``d into place) so an artifact uploader
+or operator never sees a torn bundle. Dump triggers: watchdog stall
+(obs/watchdog.py notifies automatically), replica worker death and router
+failover (gateway/replica.py, gateway/router.py), SLO breach (obs/slo.py),
+and SIGQUIT (``install_signal_dump``). Per-reason rate limiting keeps a
+crash loop from flooding the disk.
+
+Pure stdlib, no jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+# ---------------------------------------------------------------------------
+# state providers: live subsystems expose "what do you look like right now"
+# callables. Process-wide (not per-recorder) so the stall watchdog can use
+# them even when no flight recorder is configured.
+# ---------------------------------------------------------------------------
+
+_providers: Dict[str, Callable[[], dict]] = {}
+_providers_lock = threading.Lock()
+
+
+def register_state_provider(name: str, fn: Callable[[], dict]) -> str:
+    """Register a snapshot callable under ``name`` (last registration
+    wins). Returns the name, for ``unregister_state_provider``. Providers
+    must be cheap and thread-safe — they are called from the watchdog and
+    recorder threads while the subsystem is live."""
+    with _providers_lock:
+        _providers[name] = fn
+    return name
+
+
+def unregister_state_provider(name: str) -> None:
+    with _providers_lock:
+        _providers.pop(name, None)
+
+
+def collect_state() -> dict:
+    """Every registered provider's snapshot; a provider that raises yields
+    an error string instead of killing the collector (the watchdog/recorder
+    threads must survive a racing shutdown)."""
+    with _providers_lock:
+        items = list(_providers.items())
+    out = {}
+    for name, fn in items:
+        try:
+            out[name] = fn()
+        except Exception as exc:  # noqa: BLE001 - a dying subsystem's
+            # provider racing its own teardown must not kill the dump
+            out[name] = f"<provider error: {exc!r}>"
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the recorder
+# ---------------------------------------------------------------------------
+
+class FlightRecorder:
+    """Bounded black box + atomic bundle dumper. See the module docstring.
+
+    ``outdir`` is where bundles land (one directory per dump). ``capacity``
+    bounds the lifecycle-event ring (overflow is counted, never silent —
+    the grafttrace ring discipline). ``min_dump_interval_s`` rate-limits
+    dumps PER REASON; a suppressed dump is counted. ``sample_interval_s``
+    (None = off) starts a daemon thread sampling state providers + key
+    serve gauges into a short bounded history included in bundles."""
+
+    def __init__(self, outdir: str, *, capacity: int = 4096,
+                 min_dump_interval_s: float = 5.0,
+                 sample_interval_s: Optional[float] = None,
+                 sample_keep: int = 256):
+        self.outdir = outdir
+        self.capacity = int(capacity)
+        self.min_dump_interval_s = float(min_dump_interval_s)
+        self.events: deque = deque(maxlen=self.capacity)
+        self.events_dropped = 0
+        self.dumps: List[str] = []
+        self.dumps_suppressed = 0
+        self.samples: deque = deque(maxlen=int(sample_keep))
+        self._lock = threading.Lock()
+        self._last_dump_at: Dict[str, float] = {}
+        self._last_metrics: dict = {}
+        self._seq = 0
+        self._stop = threading.Event()
+        self._sampler: Optional[threading.Thread] = None
+        if sample_interval_s is not None:
+            self._sampler = threading.Thread(
+                target=self._sample_loop, args=(float(sample_interval_s),),
+                name="graftscope-sampler", daemon=True)
+            self._sampler.start()
+
+    # -- steady state ------------------------------------------------------
+    def event(self, kind: str, **fields) -> None:
+        """Append one lifecycle event (wall-clock stamped). O(1), one lock."""
+        rec = {"t": time.time(), "kind": kind, **fields}
+        with self._lock:
+            if len(self.events) == self.events.maxlen:
+                self.events_dropped += 1
+            self.events.append(rec)
+
+    def _sample_loop(self, interval_s: float) -> None:
+        while not self._stop.wait(interval_s):
+            sample = {"t": time.time(), "state": collect_state()}
+            # appended under the lock: dump() snapshots this deque, and a
+            # deque mutated mid-iteration raises RuntimeError — which in the
+            # replica-death path would escape before the streams are failed
+            with self._lock:
+                self.samples.append(sample)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._sampler is not None and self._sampler.is_alive():
+            self._sampler.join(timeout=1.0)
+
+    # -- the dump ----------------------------------------------------------
+    def dump(self, reason: str, extra: Optional[dict] = None,
+             force: bool = False) -> Optional[str]:
+        """Write an atomic post-mortem bundle; returns its path, or None
+        when rate-limited (same reason within ``min_dump_interval_s``,
+        unless ``force``). Bundle contents:
+
+          postmortem.json — reason, wall time, lifecycle events, state
+            provider snapshots (+ sampled history), counters/gauges with
+            deltas vs the previous dump, open span stacks per thread,
+            thread names, and any ``extra`` the trigger attached.
+          trace.json — the current span ring as a Perfetto trace with
+            per-request tracks (``export_chrome_trace(request_tracks=True)``).
+        """
+        now = time.monotonic()
+        with self._lock:
+            last = self._last_dump_at.get(reason)
+            if not force and last is not None and \
+                    now - last < self.min_dump_interval_s:
+                self.dumps_suppressed += 1
+                return None
+            self._last_dump_at[reason] = now
+            self._seq += 1
+            seq = self._seq
+            events = list(self.events)
+            samples = list(self.samples)
+        from . import trace as _trace
+        snapshot = _trace.metrics_snapshot()
+        with self._lock:
+            prev = self._last_metrics
+            self._last_metrics = dict(snapshot)
+        deltas = {k: v - prev.get(k, 0) for k, v in snapshot.items()
+                  if isinstance(v, (int, float))
+                  and v != prev.get(k, 0)}
+        doc = {
+            "reason": reason,
+            "wall_time": time.time(),
+            "pid": os.getpid(),
+            "events": events,
+            "events_dropped": self.events_dropped,
+            "state": collect_state(),
+            "state_samples": samples,
+            "metrics": snapshot,
+            "metrics_delta_since_last_dump": deltas,
+            "open_spans": _trace.open_spans(),
+            "threads": sorted(t.name for t in threading.enumerate()),
+        }
+        if extra:
+            doc["extra"] = extra
+
+        name = f"postmortem_{reason}_{seq:03d}_{int(time.time() * 1000)}"
+        final = os.path.join(self.outdir, name)
+        staging = os.path.join(self.outdir, f".tmp-{name}")
+        os.makedirs(staging, exist_ok=True)
+        with open(os.path.join(staging, "postmortem.json"), "w") as fh:
+            json.dump(doc, fh, indent=1, default=repr)
+        _trace.export_chrome_trace(os.path.join(staging, "trace.json"),
+                                   request_tracks=True)
+        os.replace(staging, final)
+        self.dumps.append(final)
+        return final
+
+
+# ---------------------------------------------------------------------------
+# process-wide singleton + trigger hooks
+# ---------------------------------------------------------------------------
+
+_recorder: Optional[FlightRecorder] = None
+
+
+def configure_recorder(outdir: str, **kw) -> FlightRecorder:
+    """Install the process-wide flight recorder (replacing any previous
+    one). The serving layers (engine, replica, router, watchdog, SLO
+    sentry) feed and trigger it through the module-level hooks below, which
+    are single-``None``-check no-ops until this is called."""
+    global _recorder
+    if _recorder is not None:
+        _recorder.close()
+    _recorder = FlightRecorder(outdir, **kw)
+    return _recorder
+
+
+def get_recorder() -> Optional[FlightRecorder]:
+    return _recorder
+
+
+def disable_recorder() -> None:
+    global _recorder
+    if _recorder is not None:
+        _recorder.close()
+    _recorder = None
+
+
+def record_event(kind: str, **fields) -> None:
+    """Module-level event hook: no-op without a configured recorder (the
+    grafttrace off-by-default discipline)."""
+    rec = _recorder
+    if rec is not None:
+        rec.event(kind, **fields)
+
+
+def dump_recorder(reason: str, extra: Optional[dict] = None,
+                  force: bool = False) -> Optional[str]:
+    """Module-level dump trigger: no-op without a configured recorder. A
+    failing dump (full disk, teardown race) is logged and swallowed — the
+    triggers sit on failure paths (replica death, failover) where an
+    escaping exception would block the recovery the bundle documents."""
+    rec = _recorder
+    if rec is None:
+        return None
+    try:
+        return rec.dump(reason, extra=extra, force=force)
+    except Exception as exc:  # noqa: BLE001 - see docstring
+        print(f"[graftscope] {reason} bundle dump failed: {exc!r}")
+        return None
+
+
+def install_signal_dump(signum: Optional[int] = None) -> bool:
+    """SIGQUIT (default) → dump a bundle with reason ``sigquit`` — the
+    operator's "show me what you were doing" kick, without killing the
+    process. Main-thread only (CPython signal rule); returns False where
+    that (or the platform) makes installation impossible."""
+    import signal
+    if signum is None:
+        signum = getattr(signal, "SIGQUIT", None)
+        if signum is None:        # windows
+            return False
+
+    def _handler(_sig, _frame):
+        path = dump_recorder("sigquit", force=True)
+        print(f"[graftscope] SIGQUIT bundle: {path}", flush=True)
+
+    try:
+        signal.signal(signum, _handler)
+    except ValueError:            # not the main thread
+        return False
+    return True

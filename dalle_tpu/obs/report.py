@@ -17,6 +17,7 @@ from __future__ import annotations
 import json
 import math
 import os
+import re
 from typing import List, Optional, Tuple
 
 
@@ -126,6 +127,96 @@ def checkpoint_accounting(metrics: List[dict]) -> Optional[dict]:
             "fraction": total / (run_s + total) if run_s + total > 0 else 0.0}
 
 
+def request_timeline(rows: List[dict], request: str) -> List[dict]:
+    """Every span belonging to one request, reassembled into a single
+    wall-clock-ordered timeline — the graftscope answer to "where did
+    request X spend its 2.1 s". ``request`` matches a span's ``trace_id``
+    arg (the propagated identity, obs/context.py) or, for engine-only runs,
+    its integer ``request_id``. Spans come from every thread the request
+    crossed (gateway connection thread, engine worker, the post-failover
+    replica); each entry carries start (absolute + relative to the
+    request's first span), duration, name, thread and args."""
+    sel = []
+    for s in rows:
+        args = s.get("args") or {}
+        if args.get("trace_id") == request or \
+                str(args.get("request_id")) == request:
+            sel.append(s)
+    sel.sort(key=lambda s: s.get("ts", s.get("rel_s", 0.0)))
+    if not sel:
+        return []
+    t0 = sel[0].get("ts", sel[0].get("rel_s", 0.0))
+    out = []
+    for s in sel:
+        ts = s.get("ts", s.get("rel_s", 0.0))
+        out.append({"name": s["name"], "t_rel_s": ts - t0,
+                    "dur_s": float(s["dur_s"]), "ts": ts,
+                    "tid": s.get("tid"), "args": s.get("args")})
+    return out
+
+
+def format_request_timeline(rows: List[dict], request: str) -> str:
+    """Human-readable single-track timeline for ``--request``: one line per
+    span, time-ordered, with the start offset, duration, thread and name —
+    queue-wait → prefill → per-row decode → SSE flush read top to bottom."""
+    tl = request_timeline(rows, request)
+    if not tl:
+        return f"(no spans found for request {request!r})"
+    span_total = sum(e["dur_s"] for e in tl)
+    end = max(e["t_rel_s"] + e["dur_s"] for e in tl)
+    threads = sorted({str(e["tid"]) for e in tl})
+    lines = [f"== request {request}: {len(tl)} spans across "
+             f"{len(threads)} thread(s), wall {end:.4g}s "
+             f"(span time {span_total:.4g}s)"]
+    lines.append(f"  {'t+ (s)':>10} {'dur (s)':>10} {'tid':>16}  name")
+    for e in tl:
+        extra = {k: v for k, v in (e["args"] or {}).items()
+                 if k not in ("trace_id", "request_id")}
+        lines.append(f"  {e['t_rel_s']:>10.4f} {e['dur_s']:>10.4f} "
+                     f"{str(e['tid']):>16}  {e['name']}"
+                     + (f" {extra}" if extra else ""))
+    return "\n".join(lines)
+
+
+_LABELED_REJECT_RE = re.compile(
+    r'^gateway\.rejected_by_total\{(?P<labels>.*)\}$')
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+_SLO_BURN_RE = re.compile(r'^slo\.burn_rate\{window="([^"]+)"\}$')
+
+
+def slo_accounting(metrics: List[dict]) -> Optional[dict]:
+    """Burn-rate verdict from the ``slo.*`` gauges the sentry (obs/slo.py)
+    publishes into metrics records (the window is a ``{window="5m"}``
+    label, not a name fragment). BURNING mirrors the sentry's multi-window
+    AND; the dominating window is the highest burn/threshold ratio — the
+    one to look at first."""
+    slo_rows = [r for r in metrics
+                if any(k.startswith("slo.burn_rate") for k in r)]
+    if not slo_rows:
+        return None
+    last = slo_rows[-1]
+    windows = []
+    for key, val in sorted(last.items()):
+        m = _SLO_BURN_RE.match(key)
+        if not m:
+            continue
+        label = m.group(1)
+        thresh = float(last.get(
+            f'slo.burn_threshold{{window="{label}"}}', 1.0))
+        windows.append({"window": label, "burn": float(val),
+                        "threshold": thresh,
+                        "ratio": float(val) / thresh if thresh else 0.0})
+    if not windows:
+        return None
+    dominating = max(windows, key=lambda w: w["ratio"])
+    burning = bool(last.get("slo.burning", 0.0))
+    return {"windows": windows, "burning": burning,
+            "dominating": dominating["window"],
+            "budget": last.get("slo.error_budget")}
+
+
 def gateway_accounting(metrics: List[dict],
                        spans: List[dict]) -> Optional[dict]:
     """Gateway admission/serving health from the obs registry snapshot the
@@ -140,9 +231,16 @@ def gateway_accounting(metrics: List[dict],
     if not gw_rows:
         return None
     last = gw_rows[-1]
-    by_tenant = {}
+    by_tenant: dict = {}
     for key, val in last.items():
-        if (key.startswith("gateway.") and key.endswith(".rejected_total")):
+        m = _LABELED_REJECT_RE.match(key)
+        if m:
+            labels = dict(_LABEL_RE.findall(m.group("labels")))
+            tenant = labels.get("tenant")
+            if tenant:
+                by_tenant[tenant] = by_tenant.get(tenant, 0) + int(val)
+        elif key.startswith("gateway.") and key.endswith(".rejected_total"):
+            # pre-graftscope artifacts mangled the tenant into the name
             tenant = key[len("gateway."):-len(".rejected_total")]
             if tenant:            # "gateway.rejected_total" is the fleet sum
                 by_tenant[tenant] = int(val)
@@ -250,6 +348,15 @@ def format_report(rows: List[dict], *, topk: int = 10) -> str:
                    f"p95={gw['qwait_p95_s']:.4g}s"
                    if gw["qwait_p50_s"] is not None else "")
                 + f" → {gw['verdict']}")
+        slo = slo_accounting(metrics)
+        if slo is not None:
+            wtxt = " ".join(f"{w['window']}={w['burn']:.3g}x"
+                            f"(thr {w['threshold']:.3g}x)"
+                            for w in slo["windows"])
+            lines.append(
+                f"== slo burn rate: {wtxt} → "
+                + (f"BURNING (dominating window {slo['dominating']})"
+                   if slo["burning"] else "ok"))
     if spans:
         lines.append(f"== spans by total time ({len(spans)} spans)")
         lines.append(f"  {'name':<32}{'count':>7}{'total_s':>10}{'mean_s':>10}"

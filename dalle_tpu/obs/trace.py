@@ -33,6 +33,8 @@ import time
 from collections import deque
 from typing import Optional
 
+from .context import current_trace_id
+
 # ---------------------------------------------------------------------------
 # global state: one process-wide tracer (None = tracing disabled) plus the
 # per-thread open-span stacks. The stacks registry is keyed by thread ident
@@ -135,6 +137,16 @@ class span:
         self.duration = t1 - self._t0
         tr = _tracer
         if tr is not None:
+            # ambient trace context (obs/context.py): a span recorded while
+            # a request's trace_context is bound on this thread inherits its
+            # trace_id, so cross-layer request timelines need no explicit
+            # plumbing on every span site. An explicit trace_id arg wins.
+            tid = current_trace_id()
+            if tid is not None:
+                if self.args is None:
+                    self.args = {"trace_id": tid}
+                else:
+                    self.args.setdefault("trace_id", tid)
             tr._record(self.name, self._t0, self.duration, len(s), self.args)
         return False
 
@@ -183,18 +195,40 @@ def get_tracer() -> Optional[Tracer]:
     return _tracer
 
 
-def counter_add(name: str, value: float = 1.0) -> None:
+def _label_escape(value) -> str:
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def labeled_name(name: str, labels: Optional[dict]) -> str:
+    """Canonical registry key for a labeled series: the Prometheus sample
+    spelling ``name{k="v",...}`` with sorted keys and escaped values. Two
+    calls with equal labels in any order land on ONE series — dimensions
+    stay labels (obs/prometheus.py renders them as such), never mangled
+    into the metric name."""
+    if not labels:
+        return name
+    items = ",".join(f'{k}="{_label_escape(v)}"'
+                     for k, v in sorted(labels.items()))
+    return f"{name}{{{items}}}"
+
+
+def counter_add(name: str, value: float = 1.0,
+                labels: Optional[dict] = None) -> None:
     tr = _tracer
     if tr is None:
         return
+    name = labeled_name(name, labels)
     with tr._lock:
         tr.counters[name] = tr.counters.get(name, 0) + value
 
 
-def gauge_set(name: str, value: float) -> None:
+def gauge_set(name: str, value: float,
+              labels: Optional[dict] = None) -> None:
     tr = _tracer
     if tr is None:
         return
+    name = labeled_name(name, labels)
     with tr._lock:
         tr.gauges[name] = float(value)
 
@@ -213,10 +247,15 @@ def record_span(name: str, start_perf_s: float, duration_s: float,
     thread, so entering N ``span`` contexts would corrupt the stack the
     watchdog reads). ``start_perf_s`` is a ``time.perf_counter()`` timestamp
     captured at region start; the record lands in the same ring as regular
-    spans (depth 0) and exports identically. No-op when tracing is off."""
+    spans (depth 0) and exports identically. No-op when tracing is off.
+    Like ``span``, inherits the thread's ambient trace_id (obs/context.py)
+    unless one is passed explicitly."""
     tr = _tracer
     if tr is None:
         return
+    tid = current_trace_id()
+    if tid is not None and "trace_id" not in args:
+        args["trace_id"] = tid
     tr._record(name, start_perf_s, duration_s, 0, args or None)
 
 
@@ -254,21 +293,50 @@ def export_spans_jsonl(path: str, tracer: Optional[Tracer] = None) -> int:
     return len(rows)
 
 
-def export_chrome_trace(path: str, tracer: Optional[Tracer] = None) -> int:
+def export_chrome_trace(path: str, tracer: Optional[Tracer] = None, *,
+                        request_tracks: bool = False) -> int:
     """Write the ring as Chrome ``trace_event`` JSON (complete "X" events,
     microsecond timestamps) — open in Perfetto or chrome://tracing. Returns
-    the number of events written."""
+    the number of events written.
+
+    ``request_tracks=True`` additionally reassembles every trace_id-tagged
+    span onto a per-request timeline track under a synthetic "requests"
+    process: one row per trace_id holding that request's spans from EVERY
+    thread it crossed (gateway connection thread, engine worker, a failover
+    replica), in wall-clock order — queue-wait → prefill → per-row decode →
+    SSE flush read left to right on one row. The real per-thread tracks are
+    kept alongside; the request rows are a second view of the same spans."""
     tr = tracer or _tracer
     if tr is None:
         return 0
     pid = os.getpid()
     events = []
-    for name, rel, dur, tid, depth, args in tr.snapshot_spans():
+    rows = tr.snapshot_spans()
+    for name, rel, dur, tid, depth, args in rows:
         ev = {"name": name, "ph": "X", "pid": pid, "tid": tid,
               "ts": rel * 1e6, "dur": dur * 1e6}
         if args:
             ev["args"] = dict(args)
         events.append(ev)
+    if request_tracks:
+        # synthetic process 1: one virtual tid per trace_id, named after it
+        track_ids: dict = {}
+        events.append({"ph": "M", "pid": 1, "tid": 0,
+                       "name": "process_name",
+                       "args": {"name": "requests (graftscope)"}})
+        for name, rel, dur, tid, depth, args in rows:
+            trace_id = (args or {}).get("trace_id")
+            if trace_id is None:
+                continue
+            vtid = track_ids.get(trace_id)
+            if vtid is None:
+                vtid = track_ids[trace_id] = len(track_ids) + 1
+                events.append({"ph": "M", "pid": 1, "tid": vtid,
+                               "name": "thread_name",
+                               "args": {"name": f"request {trace_id}"}})
+            events.append({"name": name, "ph": "X", "pid": 1, "tid": vtid,
+                           "ts": rel * 1e6, "dur": dur * 1e6,
+                           "args": dict(args, source_tid=tid)})
     doc = {"traceEvents": events, "displayTimeUnit": "ms",
            "metadata": {"epoch_origin": tr.epoch_origin,
                         "spans_dropped": tr.dropped}}

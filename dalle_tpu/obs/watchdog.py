@@ -23,6 +23,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from .recorder import collect_state, dump_recorder
 from .trace import open_spans
 
 
@@ -33,6 +34,11 @@ class StallReport:
     wall_time: float
     open_spans: dict = field(default_factory=dict)
     stack_dump: str = ""
+    # live-subsystem snapshots (obs/recorder.py state providers): a serving
+    # stall report carries the engine's queue depth, slot occupancy and
+    # in-flight request ids — "stuck with 14 queued and slot 3 on request
+    # 8f2a… for 40 s" instead of just a span name
+    state: dict = field(default_factory=dict)
 
     def format(self) -> str:
         lines = [f"[watchdog] STALL: no step completed for {self.idle_s:.1f}s "
@@ -44,6 +50,8 @@ class StallReport:
         else:
             lines.append("[watchdog]   no open spans (tracing off or idle "
                          "between spans)")
+        for name, snap in self.state.items():
+            lines.append(f"[watchdog]   state [{name}]: {snap}")
         if self.stack_dump:
             lines.append("[watchdog]   thread stacks:")
             lines.extend("[watchdog]     " + ln
@@ -111,13 +119,20 @@ class StallWatchdog:
             report = StallReport(
                 step=self._step, idle_s=idle, wall_time=time.time(),
                 open_spans=open_spans(),
-                stack_dump=_dump_all_stacks() if self.dump_stacks else "")
+                stack_dump=_dump_all_stacks() if self.dump_stacks else "",
+                state=collect_state())
             self.stall_count += 1
             self.last_report = report
             try:
                 self.log(report.format())
                 if self.on_stall is not None:
                     self.on_stall(report)
+                # flight recorder (no-op unless configured): a stall is a
+                # post-mortem trigger — the bundle freezes the spans and
+                # serve state the report only summarizes
+                dump_recorder("watchdog_stall", extra={
+                    "step": report.step, "idle_s": report.idle_s,
+                    "open_spans": report.open_spans, "state": report.state})
             except Exception as e:  # noqa: BLE001 - a crashing log sink must
                 # not kill the watchdog thread (it would die silently and the
                 # run would lose its only stall detector)

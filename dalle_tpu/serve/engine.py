@@ -32,6 +32,7 @@ max_seq == total_seq_len).
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -40,7 +41,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.dalle import DALLE
-from ..obs import counter_add, gauge_set, record_span
+from ..obs import (counter_add, gauge_set, record_event, record_span,
+                   register_state_provider, unregister_state_provider)
 from ..ops.sampling import gumbel_sample_rows
 from .queue import CompletedRequest, Request, RequestQueue
 from .scheduler import SlotScheduler
@@ -387,9 +389,41 @@ class DecodeEngine:
         sched = SlotScheduler(B)
         state = self._init_state()
         buffers: Dict[int, List[int]] = {}
+        row_t0: Dict[int, float] = {}      # per-slot start of the open row
         completed: List[CompletedRequest] = []
         self.stats = EngineStats()
 
+        # flight-recorder / watchdog state provider: while this loop is
+        # live, a stall report or post-mortem bundle carries the queue
+        # depth, slot occupancy and in-flight request ids — the serve-side
+        # "where was everyone" snapshot. Read from other threads; every
+        # value is a point-in-time copy and the collector tolerates races.
+        def _engine_state() -> dict:
+            inflight = []
+            for s in sched.active_slots():
+                r = sched.request_at(s)
+                if r is not None:
+                    inflight.append({
+                        "slot": s, "request_id": r.request_id,
+                        "trace_id": r.trace_id,
+                        "tokens_done": len(buffers.get(s, ()))})
+            return {"queue_depth": queue.qsize(),
+                    "slot_occupancy": sched.occupancy,
+                    "steps": self.stats.steps, "inflight": inflight}
+
+        provider = register_state_provider(
+            f"serve.engine[{threading.current_thread().name}]",
+            _engine_state)
+        try:
+            return self._run(queue, sched, state, buffers, row_t0,
+                             completed, max_steps=max_steps, poll_s=poll_s,
+                             on_complete=on_complete, on_rows=on_rows)
+        finally:
+            unregister_state_provider(provider)
+
+    def _run(self, queue, sched, state, buffers, row_t0, completed, *,
+             max_steps, poll_s, on_complete, on_rows):
+        B = self.slots
         while not (queue.drained and not sched.any_active):
             if max_steps is not None and self.stats.steps >= max_steps:
                 break
@@ -411,9 +445,13 @@ class DecodeEngine:
                         # TTFT = queue wait + prefill + first step) + gauge
                         record_span("serve/request_queue_wait",
                                     req.submitted_at, now - req.submitted_at,
-                                    request_id=req.request_id)
+                                    request_id=req.request_id,
+                                    trace_id=req.trace_id)
                         gauge_set("serve.queue_wait_s",
                                   now - req.submitted_at)
+                        record_event("request_admitted", slot=slot,
+                                     request_id=req.request_id,
+                                     trace_id=req.trace_id)
                     if 2 * len(pairs) >= B:
                         # bulk admission: one multi-row refill window
                         texts = np.zeros((B, self.text_seq_len), np.int32)
@@ -425,20 +463,37 @@ class DecodeEngine:
                             seeds[slot] = req.seed
                             n_rows[slot] = self._n_tokens(req)
                             mask[slot] = True
+                        t0 = time.perf_counter()
                         state = self._refill_fn(self.params, state, texts,
                                                 seeds, n_rows, mask)
+                        t1 = time.perf_counter()
                         self.stats.refills += 1
+                        # one shared prefill window, one span per admitted
+                        # request (each request's timeline owns its prefill
+                        # segment; dur is the host dispatch wall)
+                        for slot, req in pairs:
+                            record_span("serve/prefill", t0, t1 - t0,
+                                        request_id=req.request_id,
+                                        trace_id=req.trace_id,
+                                        mode="window")
+                            row_t0[slot] = t1
                     else:
                         # trickle admission (staggered completions): per-row
                         # scatter-prefill, 1/B the window's compute
                         for slot, req in pairs:
+                            t0 = time.perf_counter()
                             state = self._refill_row_fn(
                                 self.params, state,
                                 self._pad_text(req.text)[None],
                                 np.int32(req.seed),
                                 np.int32(self._n_tokens(req)),
                                 np.int32(slot))
+                            t1 = time.perf_counter()
                             self.stats.refills += 1
+                            record_span("serve/prefill", t0, t1 - t0,
+                                        request_id=req.request_id,
+                                        trace_id=req.trace_id, mode="row")
+                            row_t0[slot] = t1
             # work-conservation sample: requests that were already queued
             # at the take instant and still went unplaced must leave every
             # slot busy, so occupancy is sampled exactly then (an idle slot
@@ -472,21 +527,37 @@ class DecodeEngine:
                         req.first_token_at = now
                     buf = buffers[slot]
                     buf.append(int(toks[k, slot]))
-                    if on_rows is not None and len(buf) % self.row_len == 0:
+                    if len(buf) % self.row_len == 0:
                         row = len(buf) // self.row_len - 1
-                        on_rows(req, row, buf[row * self.row_len:])
+                        # one committed grid row = one timeline segment
+                        # (host-sync granularity: rows finishing inside one
+                        # multi-step dispatch share its sync timestamp)
+                        t0r = row_t0.get(slot, now)
+                        record_span("serve/decode_row", t0r, now - t0r,
+                                    request_id=req.request_id,
+                                    trace_id=req.trace_id, row=row)
+                        row_t0[slot] = now
+                        if on_rows is not None:
+                            on_rows(req, row, buf[row * self.row_len:])
                 counter_add("serve.tokens_emitted_total",
                             float(len(active)))
                 for slot in active:
                     if not fins[k, slot]:
                         continue
                     req = sched.complete(slot)
-                    if on_rows is not None:
-                        tail = len(buffers[slot]) % self.row_len
-                        if tail:
-                            # trailing partial row of a max_tokens request
+                    tail = len(buffers[slot]) % self.row_len
+                    if tail:
+                        # trailing partial row of a max_tokens request
+                        t0r = row_t0.get(slot, now)
+                        record_span("serve/decode_row", t0r, now - t0r,
+                                    request_id=req.request_id,
+                                    trace_id=req.trace_id,
+                                    row=len(buffers[slot]) // self.row_len,
+                                    partial=True)
+                        if on_rows is not None:
                             on_rows(req, len(buffers[slot]) // self.row_len,
                                     buffers[slot][-tail:])
+                    row_t0.pop(slot, None)
                     cr = CompletedRequest(
                         request_id=req.request_id,
                         tokens=np.asarray(buffers.pop(slot), np.int32),
@@ -505,9 +576,15 @@ class DecodeEngine:
                     record_span("serve/request", req.admitted_at,
                                 now - req.admitted_at,
                                 request_id=req.request_id,
+                                trace_id=req.trace_id,
                                 tokens=int(cr.tokens.shape[0]))
                     record_span("serve/request_ttft", req.submitted_at,
-                                cr.ttft_s, request_id=req.request_id)
+                                cr.ttft_s, request_id=req.request_id,
+                                trace_id=req.trace_id)
+                    record_event("request_completed",
+                                 request_id=req.request_id,
+                                 trace_id=req.trace_id,
+                                 latency_s=cr.latency_s)
                     counter_add("serve.requests_completed_total", 1.0)
                     gauge_set("serve.request_latency_s", cr.latency_s)
                 self.stats.steps += 1

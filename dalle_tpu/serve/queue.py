@@ -50,6 +50,12 @@ class Request:
     tenant: str = "default"
     priority: int = 0           # higher = served sooner under PolicyQueue
     deadline_at: Optional[float] = None
+    # graftscope trace context (obs/context.py): the request's one identity
+    # across gateway → router → replica → engine slot — and across a
+    # failover resubmission, which reuses the original id. Minted at the
+    # HTTP door (gateway/server.py) or by ``submit`` for CLI/bench
+    # producers; every span the request touches is tagged with it.
+    trace_id: Optional[str] = None
     # stamped by the engine
     admitted_at: Optional[float] = None
     first_token_at: Optional[float] = None
@@ -98,7 +104,8 @@ class RequestQueue:
                request_id: Optional[int] = None,
                max_tokens: Optional[int] = None,
                tenant: str = "default", priority: int = 0,
-               deadline_at: Optional[float] = None) -> Request:
+               deadline_at: Optional[float] = None,
+               trace_id: Optional[str] = None) -> Request:
         """Enqueue a request; returns it (with its assigned id). An explicit
         ``request_id`` must be fresh: ids at or below the high-water mark of
         previously issued ids are rejected rather than tracked individually,
@@ -110,6 +117,12 @@ class RequestQueue:
             # the engine clamps to [1, image_seq_len]; 0/negative would
             # silently come back as a 1-token generation
             raise ValueError(f"max_tokens must be >= 1, got {max_tokens}")
+        if trace_id is None:
+            # the queue is the CLI/bench edge of the system: a producer
+            # that didn't propagate a trace context still gets one identity
+            # per request (the gateway mints at the HTTP door and passes it)
+            from ..obs.context import new_trace_id
+            trace_id = new_trace_id()
         with self._cond:
             if self._closed:
                 raise RuntimeError("queue is closed")
@@ -126,7 +139,8 @@ class RequestQueue:
             self._next_id = request_id + 1
             req = Request(request_id=request_id, text=text, seed=seed,
                           max_tokens=max_tokens, tenant=tenant,
-                          priority=priority, deadline_at=deadline_at)
+                          priority=priority, deadline_at=deadline_at,
+                          trace_id=trace_id)
             self._q.append(req)
             self._cond.notify_all()
         return req

@@ -172,6 +172,77 @@ def enable_compile_cache(args) -> bool:
     return True
 
 
+def add_profiler_args(parser):
+    """On-demand ``jax.profiler`` capture, shared by train AND serve CLIs:
+    ``kill -USR2 <pid>`` records a bounded trace into the artifacts dir —
+    the "the p99 is weird RIGHT NOW" tool, with zero cost until the signal
+    arrives and a hard stop after ``--profiler_capture_s`` so a forgotten
+    capture can't fill the disk."""
+    grp = parser.add_argument_group("on-demand profiler "
+                                    "(docs/OBSERVABILITY.md)")
+    grp.add_argument("--profiler_dir", type=str, default=None,
+                     help="SIGUSR2 target dir for bounded jax.profiler "
+                          "traces (default: <output/artifacts dir>/profile;"
+                          " 'off' disables the handler)")
+    grp.add_argument("--profiler_capture_s", type=float, default=5.0,
+                     help="seconds per capture (the bound)")
+    return parser
+
+
+def install_sigusr2_profiler(default_dir: str, args=None) -> bool:
+    """Install the SIGUSR2 handler (main thread only — call from the CLI's
+    main). Each signal starts one ``jax.profiler`` trace into a timestamped
+    subdir and a daemon timer stops it after the bound; a signal landing
+    mid-capture is ignored (one capture at a time). Returns False when
+    disabled or uninstallable."""
+    import signal
+    import threading
+    import time
+
+    outdir = default_dir
+    capture_s = 5.0
+    if args is not None:
+        if getattr(args, "profiler_dir", None) == "off":
+            return False
+        outdir = getattr(args, "profiler_dir", None) or default_dir
+        capture_s = float(getattr(args, "profiler_capture_s", 5.0))
+    state = {"active": False}
+
+    def _stop():
+        import jax
+        try:
+            jax.profiler.stop_trace()
+        except Exception as exc:  # noqa: BLE001 - a failed stop must not
+            # kill the timer thread; the next capture starts a fresh trace
+            print(f"[graftscope] profiler stop failed: {exc!r}")
+        state["active"] = False
+
+    def _handler(_sig, _frame):
+        if state["active"]:
+            return
+        state["active"] = True
+        import jax
+        path = os.path.join(outdir, time.strftime("profile_%Y%m%d_%H%M%S"))
+        os.makedirs(path, exist_ok=True)
+        try:
+            jax.profiler.start_trace(path)
+        except Exception as exc:  # noqa: BLE001 - an already-running or
+            # unsupported profiler must not kill the training/serving loop
+            # the signal interrupted
+            print(f"[graftscope] profiler start failed: {exc!r}")
+            state["active"] = False
+            return
+        print(f"[graftscope] SIGUSR2: profiling {capture_s:.1f}s → {path}",
+              flush=True)
+        threading.Timer(capture_s, _stop).start()
+
+    try:
+        signal.signal(signal.SIGUSR2, _handler)
+    except (ValueError, AttributeError):   # non-main thread / platform
+        return False
+    return True
+
+
 def add_overlap_args(parser):
     """Host-overlap flags shared by every train CLI (docs/PERFORMANCE.md):
     async checkpointing, device prefetch depth, deferred metrics, and the

@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Perf-regression sentry: diff the newest bench round against the prior one.
+
+The repo accumulates one ``BENCH_r*.json`` / ``MULTICHIP_r*.json`` pair per
+round (bench.py output + the multichip dryrun capture). Perf history only
+helps if someone actually reads it — this script is that someone: it parses
+the metric records out of the two newest rounds of each family, compares
+every metric shared between them against a tolerance band, and prints a
+verdict per metric plus one overall line:
+
+    bench_check: OK         — every shared metric within the band
+    bench_check: REGRESSED  — at least one metric moved the BAD way by
+                              more than the tolerance
+    (IMPROVED / NEW / MISSING are annotated per metric, never fatal)
+
+Direction is inferred from the metric name: ``*time*``/``*latency*``/
+``*ratio*``/``*_ms``/``*_s`` are lower-is-better, everything else (tok/s,
+req/s, MFU) higher-is-better.
+
+Wired as an ADVISORY ci_local stage: it always exits 0 unless ``--strict``
+— this sandbox's CPU-mesh numbers jitter with box load, so a regression
+here is a prompt to look, not a build failure. On real hardware, run with
+``--strict --tolerance 0.05``.
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_LOWER_BETTER = re.compile(r"time|latency|ratio|_ms\b|_s\b")
+
+
+def _round_of(path: str) -> int:
+    m = re.search(r"_r(\d+)\.json$", path)
+    return int(m.group(1)) if m else -1
+
+
+def extract_metrics(path: str) -> dict:
+    """{metric_name: value} from a round capture: the ``parsed`` record
+    when present, plus every JSON metric line in the captured ``tail``."""
+    try:
+        doc = json.load(open(path))
+    except (OSError, json.JSONDecodeError) as exc:
+        print(f"bench_check: unreadable {path}: {exc!r}", file=sys.stderr)
+        return {}
+    out = {}
+    recs = []
+    if isinstance(doc.get("parsed"), dict):
+        recs.append(doc["parsed"])
+    for line in (doc.get("tail") or "").splitlines():
+        line = line.strip()
+        if line.startswith("{") and '"metric"' in line:
+            try:
+                recs.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    for rec in recs:
+        name, value = rec.get("metric"), rec.get("value")
+        if isinstance(name, str) and isinstance(value, (int, float)):
+            out[name] = float(value)
+    return out
+
+
+def newest_pair(family_glob: str):
+    """(newest_path, prior_path) by round number; (path, None) when only
+    one round exists, (None, None) when none do."""
+    paths = sorted(glob.glob(os.path.join(ROOT, family_glob)),
+                   key=_round_of)
+    if not paths:
+        return None, None
+    if len(paths) == 1:
+        return paths[0], None
+    return paths[-1], paths[-2]
+
+
+def compare(new: dict, old: dict, tolerance: float):
+    """Per-metric verdict rows: (name, old, new, rel_delta, verdict)."""
+    rows = []
+    for name in sorted(set(new) | set(old)):
+        nv, ov = new.get(name), old.get(name)
+        if ov is None:
+            rows.append((name, None, nv, None, "NEW"))
+            continue
+        if nv is None:
+            rows.append((name, ov, None, None, "MISSING"))
+            continue
+        if ov == 0:
+            rows.append((name, ov, nv, None, "OK" if nv == 0 else "NEW"))
+            continue
+        delta = (nv - ov) / abs(ov)
+        lower_better = bool(_LOWER_BETTER.search(name))
+        bad = delta > tolerance if lower_better else delta < -tolerance
+        good = delta < -tolerance if lower_better else delta > tolerance
+        rows.append((name, ov, nv, delta,
+                     "REGRESSED" if bad else
+                     "IMPROVED" if good else "OK"))
+    return rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--tolerance", type=float, default=0.10,
+                    help="relative band before a move counts (default 10%%)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on REGRESSED (default: advisory, exit 0)")
+    args = ap.parse_args(argv)
+
+    regressed = 0
+    compared = 0
+    for family in ("BENCH_r*.json", "MULTICHIP_r*.json"):
+        newest, prior = newest_pair(family)
+        label = family.split("_")[0]
+        if newest is None:
+            print(f"-- {label}: no rounds found")
+            continue
+        if prior is None:
+            print(f"-- {label}: only one round "
+                  f"({os.path.basename(newest)}) — nothing to diff")
+            continue
+        new_m = extract_metrics(newest)
+        old_m = extract_metrics(prior)
+        print(f"== {label}: {os.path.basename(prior)} → "
+              f"{os.path.basename(newest)} (tolerance "
+              f"±{args.tolerance:.0%})")
+        if not new_m and not old_m:
+            print("   (no metric records in either round)")
+            continue
+        for name, ov, nv, delta, verdict in compare(new_m, old_m,
+                                                    args.tolerance):
+            compared += verdict in ("OK", "IMPROVED", "REGRESSED")
+            regressed += verdict == "REGRESSED"
+            dtxt = f"{delta:+.2%}" if delta is not None else "  —  "
+            ovt = f"{ov:.6g}" if ov is not None else "—"
+            nvt = f"{nv:.6g}" if nv is not None else "—"
+            print(f"   {verdict:<10}{name}: {ovt} → {nvt} ({dtxt})")
+    verdict = "REGRESSED" if regressed else "OK"
+    print(f"bench_check: {verdict} ({compared} metrics compared, "
+          f"{regressed} regressed)")
+    return 1 if (regressed and args.strict) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

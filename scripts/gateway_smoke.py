@@ -7,10 +7,23 @@ contracts end-to-end over a real socket:
   * streaming — one SSE request streams every committed grid row in order
     (fmap rows × fmap tokens) and the concatenated rows equal the ``done``
     tokens equal single-request ``generate_images_tokens`` BITWISE;
+  * graftscope tracing — the streamed request's spans across the gateway
+    connection thread, router, replica worker and engine loop all share
+    ONE trace_id (echoed as the X-Request-Id header and in every SSE
+    event), and ``obs_report --request <id>`` reassembles them into a
+    single ordered timeline: queue-wait → prefill → per-row decode → SSE
+    flush;
   * concurrency/multi-tenancy — parallel streamed + blocking requests from
     two tenants all complete token-exact;
   * admission — a burst-1 tenant's second immediate request gets 429 with
-    Retry-After (quota), and /metrics exposes the reject counters;
+    Retry-After (quota), /metrics exposes the reject counters with REAL
+    {tenant,reason} labels, and the SLO burn-rate sentry flips to BURNING
+    on the reject stream (the ``dalle_slo_*`` gauge family);
+  * replica kill — a replica dies mid-stream after 2 rows; the failover
+    completes the stream bitwise-exact under the SAME trace_id, and the
+    flight recorder dumps a post-mortem bundle (a CI artifact, under
+    ``<outdir>/flight/``) holding the replica_failed + failover lifecycle
+    events and the dying worker's last decode-row spans;
   * AOT cold start — a replica whose engine loaded the serialized
     executables serves its FIRST requests with ZERO backend compiles
     (asserted via the compile counter; phase A warms every eager op in the
@@ -18,14 +31,17 @@ contracts end-to-end over a real socket:
     retrace, no program compile on the cold replica" — a fresh jit engine
     in the same position pays its step/refill compiles).
 
-Artifacts (smoke.json, gateway_spans.jsonl, metrics.jsonl) land in
-``--outdir`` — the dir ci.yml uploads alongside serve_artifacts.
+Artifacts (smoke.json, gateway_spans.jsonl, gateway_trace.json,
+metrics.jsonl, flight/) land in ``--outdir`` — the dir ci.yml uploads
+alongside serve_artifacts.
 Run: JAX_PLATFORMS=cpu python scripts/gateway_smoke.py
 """
 
 import argparse
+import glob
 import json
 import os
+import subprocess
 import sys
 import tempfile
 import threading
@@ -75,6 +91,16 @@ def main(argv=None):
 
     tracer = obs.configure()
     counter = obs.install_compile_counter()
+    flight_dir = os.path.join(args.outdir, "flight")
+    obs.configure_recorder(flight_dir, min_dump_interval_s=0.0,
+                           sample_interval_s=0.2)
+    # one burn-rate sentry across every gateway phase (the fleet's error
+    # budget is one budget); min_events=5 so this short smoke can reach a
+    # verdict — production keeps the default 10
+    sentry = obs.BurnRateSentry(
+        min_events=5,
+        on_breach=lambda v: obs.dump_recorder(
+            "slo_breach", extra={"dominating": v["dominating"]}))
     failures = []
 
     def check(ok, msg):
@@ -98,13 +124,16 @@ def main(argv=None):
     jit_rep = Replica(make_engine(), replica_id="jit-0", maxsize=16).start()
     admission = AdmissionController(TenantQuotas(
         rate_per_s=200.0, burst=200.0, overrides={"capped": (0.02, 1)}))
-    gw = Gateway(ReplicaRouter([jit_rep]), admission).start()
+    gw = Gateway(ReplicaRouter([jit_rep]), admission,
+                 slo_sentry=sentry).start()
 
     conn, resp = _post(gw.address, {"text": texts[0].tolist(), "seed": 1000,
                                     "stream": True})
     check(resp.status == 200
           and resp.getheader("Content-Type") == "text/event-stream",
           "streamed request answers 200 text/event-stream")
+    sse_tid = resp.getheader("X-Request-Id")
+    check(bool(sse_tid), "X-Request-Id header echoes the minted trace_id")
     rows, done = [], None
     for event, data in iter_sse(resp):
         if event == "row":
@@ -120,6 +149,31 @@ def main(argv=None):
     streamed = [t for d in rows for t in d["tokens"]]
     check(done is not None and streamed == done["tokens"] == refs[0],
           "streamed rows ≡ done tokens ≡ single-request generation (bitwise)")
+    check(all(d.get("trace_id") == sse_tid for d in rows)
+          and done.get("trace_id") == sse_tid,
+          "every SSE event carries the request's trace_id")
+
+    # graftscope: the request's spans across gateway / replica / engine
+    # threads all share the one trace_id minted at the HTTP door. The
+    # engine/handler record their last spans a beat after the client sees
+    # `done`, so poll briefly instead of racing them.
+    import time as _time
+    expect = {"gateway/request", "serve/request_queue_wait",
+              "serve/prefill", "serve/decode_row", "serve/request",
+              "gateway/sse_flush"}
+    deadline = _time.time() + 5.0
+    req_spans, names = [], set()
+    while _time.time() < deadline:
+        req_spans = [s for s in tracer.snapshot_spans()
+                     if (s[5] or {}).get("trace_id") == sse_tid]
+        names = {s[0] for s in req_spans}
+        if expect <= names and len({s[3] for s in req_spans}) >= 2:
+            break
+        _time.sleep(0.05)
+    check(expect <= names,
+          f"one trace_id spans every layer (have {sorted(names)})")
+    check(len({s[3] for s in req_spans}) >= 2,
+          "request timeline crosses threads (connection + engine worker)")
 
     # concurrent multi-tenant traffic: blocking + streamed, two tenants
     results = {}
@@ -170,7 +224,64 @@ def main(argv=None):
     check("dalle_gateway_rejected_total" in metrics_text
           and "dalle_gateway_inflight" in metrics_text,
           "/metrics exposes gateway reject counter + inflight gauge")
+    check('dalle_gateway_rejected_by_total{reason="quota",tenant="capped"}'
+          in metrics_text,
+          "/metrics renders real {tenant,reason} labels on the reject "
+          "counter")
+    check('dalle_slo_burn_rate{window="5m"}' in metrics_text,
+          "/metrics exposes the dalle_slo_* burn-rate gauge family")
     gw.shutdown(drain=True, timeout=60)
+
+    # mid-stream replica kill: the victim dies after 2 committed rows; the
+    # router resubmits the SAME text/seed/trace_id to the standby, the
+    # spliced stream stays bitwise-exact, and the flight recorder leaves a
+    # post-mortem bundle behind
+    victim = Replica(make_engine(), replica_id="victim", maxsize=16).start()
+    standby = Replica(make_engine(), replica_id="standby",
+                      maxsize=16).start()
+    gwk = Gateway(ReplicaRouter([victim, standby]), AdmissionController(),
+                  slo_sentry=sentry).start()
+    victim.fail_after_rows(2)
+    conn, resp = _post(gwk.address, {"text": texts[0].tolist(),
+                                     "seed": 1000, "stream": True})
+    kill_tid = resp.getheader("X-Request-Id")
+    krows, kdone = [], None
+    for event, data in iter_sse(resp):
+        if event == "row":
+            krows.append(data)
+        elif event == "done":
+            kdone = data
+    conn.close()
+    check(kdone is not None and kdone["tokens"] == refs[0]
+          and kdone["failovers"] == 1 and kdone["replica"] == "standby"
+          and [d["row"] for d in krows] == list(range(fmap)),
+          "mid-stream replica kill: failover stream bitwise-exact, every "
+          "row exactly once")
+    kill_spans = [s for s in tracer.snapshot_spans()
+                  if (s[5] or {}).get("trace_id") == kill_tid]
+    qwait_n = sum(1 for s in kill_spans
+                  if s[0] == "serve/request_queue_wait")
+    check(qwait_n == 2 and all(d.get("trace_id") == kill_tid
+                               for d in krows + [kdone]),
+          "trace_id survives the failover resubmission (one identity, "
+          "two admissions)")
+    gwk.shutdown(drain=True, timeout=60)
+
+    fo_bundles = sorted(glob.glob(
+        os.path.join(flight_dir, "postmortem_failover_*")))
+    check(bool(fo_bundles), "failover dumped a flight-recorder bundle")
+    if fo_bundles:
+        pm = json.load(open(os.path.join(fo_bundles[-1],
+                                         "postmortem.json")))
+        kinds = [e["kind"] for e in pm["events"]]
+        check("replica_failed" in kinds and "failover" in kinds,
+              "bundle event ring holds the replica death AND the failover")
+        ktrace = json.load(open(os.path.join(fo_bundles[-1], "trace.json")))
+        victim_rows = [e for e in ktrace["traceEvents"]
+                       if e.get("args", {}).get("trace_id") == kill_tid
+                       and e["name"] == "serve/decode_row"]
+        check(bool(victim_rows),
+              "bundle trace holds the dying worker's last decode-row spans")
 
     # phase B: AOT cold start — a NEVER-run replica loads the serialized
     # executables and serves with zero backend compiles. Built over a
@@ -187,7 +298,7 @@ def main(argv=None):
     check(aot_rep.aot_loaded and aot_engine.aot_loaded,
           "AOT bundle fingerprint-matched and loaded")
     gw2 = Gateway(ReplicaRouter([aot_rep.start()]),
-                  AdmissionController()).start()
+                  AdmissionController(), slo_sentry=sentry).start()
     before = counter.count
     cold = {}
     for i in range(2):
@@ -208,21 +319,68 @@ def main(argv=None):
     check(len(qwaits) >= n_req,
           "per-request serve/request_queue_wait spans recorded")
 
+    # SLO sentry: the reject stream burned through the error budget — the
+    # gauges are live and the verdict is BURNING (dominated by a window)
+    verdict = sentry.evaluate()
+    snapshot = obs.metrics_snapshot()
+    check(verdict["burning"] and snapshot.get("slo.burning") == 1.0
+          and 'slo.burn_rate{window="5m"}' in snapshot,
+          f"burn-rate sentry BURNING (dominating {verdict['dominating']}; "
+          f"{sentry.bad_total}/{sentry.bad_total + sentry.good_total} bad)")
+    slo_bundles = glob.glob(os.path.join(flight_dir,
+                                         "postmortem_slo_breach_*"))
+    check(bool(slo_bundles), "SLO breach dumped a flight-recorder bundle")
+
     n_spans = obs.export_spans_jsonl(
         os.path.join(args.outdir, "gateway_spans.jsonl"))
-    snapshot = obs.metrics_snapshot()
+    obs.export_chrome_trace(os.path.join(args.outdir, "gateway_trace.json"),
+                            request_tracks=True)
     with open(os.path.join(args.outdir, "metrics.jsonl"), "w") as fh:
         fh.write(json.dumps({"step": 0, **snapshot}) + "\n")
+
+    # obs_report --request: the CLI reassembles the streamed request's
+    # cross-thread spans into one ordered timeline
+    rep = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__),
+                                      "obs_report.py"),
+         os.path.join(args.outdir, "gateway_spans.jsonl"),
+         "--request", sse_tid],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    tl = rep.stdout
+    order = [tl.find(n) for n in ("serve/request_queue_wait",
+                                  "serve/prefill", "serve/decode_row",
+                                  "gateway/sse_flush")]
+    check(rep.returncode == 0 and all(i >= 0 for i in order)
+          and order == sorted(order),
+          "obs_report --request: one ordered timeline "
+          "(queue-wait → prefill → decode rows → SSE flush)")
+
+    # obs_report summary prints the burn-rate verdict line off the gauges
+    rep2 = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__),
+                                      "obs_report.py"), args.outdir],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    check("slo burn rate" in rep2.stdout and "BURNING" in rep2.stdout,
+          "obs_report prints the slo burn-rate verdict (BURNING)")
+
     summary = {
         "requests": n_req, "slots": args.slots,
         "aot_payload_bytes": manifest["payload_bytes"],
         "aot_cold_start_compiles": compiles,
         "rejected_total": snapshot.get("gateway.rejected_total", 0),
+        "slo_burning": bool(verdict["burning"]),
+        "slo_dominating_window": verdict["dominating"],
+        "failover_trace_id": kill_tid,
+        "flight_bundles": sorted(os.path.basename(p) for p in glob.glob(
+            os.path.join(flight_dir, "postmortem_*"))),
         "spans_exported": n_spans, "failures": failures,
     }
     with open(os.path.join(args.outdir, "smoke.json"), "w") as fh:
         json.dump(summary, fh, indent=2)
     obs.disable()
+    obs.disable_recorder()
     print(json.dumps({"metric": "gateway_smoke", **summary}), flush=True)
     if failures:
         print(f"gateway_smoke: FAILED ({len(failures)} checks)")

@@ -9,9 +9,17 @@ step-time histogram, the input-bound/compute-bound verdict from the
 data-starvation ratio, and HBM/recompile callouts. See docs/OBSERVABILITY.md
 for reading the output.
 
+``--request <id>`` switches to graftscope's per-request view: every span
+tagged with that trace_id (or engine request_id), from every thread the
+request crossed — gateway connection thread, engine worker, a post-failover
+replica — reassembled into one wall-clock-ordered timeline
+(queue-wait → prefill → per-row decode → SSE flush). The id is the
+``X-Request-Id`` response header / the ``trace_id`` in SSE events.
+
 Examples:
   python scripts/obs_report.py ./checkpoints/obs
   python scripts/obs_report.py ./metrics.jsonl --top 20
+  python scripts/obs_report.py gateway_artifacts --request 8f2a9c0d1e2f3a4b
 """
 
 import argparse
@@ -26,12 +34,29 @@ def main(argv=None):
     ap.add_argument("path", help="run directory or .jsonl file")
     ap.add_argument("--top", type=int, default=10,
                     help="rows in the top-k span tables")
+    ap.add_argument("--request", type=str, default=None, metavar="ID",
+                    help="reassemble one request's cross-thread timeline "
+                         "(trace_id from X-Request-Id / SSE events, or an "
+                         "engine request_id)")
     args = ap.parse_args(argv)
 
-    from dalle_tpu.obs.report import summarize_run
+    from dalle_tpu.obs.report import (format_request_timeline, load_jsonl,
+                                      summarize_run)
     if not os.path.exists(args.path):
         print(f"error: {args.path} does not exist", file=sys.stderr)
         return 2
+    if args.request is not None:
+        paths = [args.path]
+        if os.path.isdir(args.path):
+            paths = [os.path.join(args.path, n)
+                     for n in sorted(os.listdir(args.path))
+                     if n.endswith(".jsonl")]
+        rows = []
+        for p in paths:
+            rows.extend(load_jsonl(p))
+        text = format_request_timeline(rows, args.request)
+        print(text)
+        return 0 if not text.startswith("(no spans") else 1
     print(summarize_run(args.path, topk=args.top))
     return 0
 

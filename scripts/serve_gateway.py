@@ -26,7 +26,8 @@ import threading
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from _common import (add_compile_cache_args, enable_compile_cache,  # noqa: E402,E501
+from _common import (add_compile_cache_args, add_profiler_args,  # noqa: E402,E501
+                     enable_compile_cache, install_sigusr2_profiler,
                      load_model_checkpoint, load_vae_sidecar)
 
 
@@ -73,7 +74,16 @@ def build_parser():
     ap.add_argument("--prometheus_path", type=str, default="",
                     help="node-exporter textfile target (written on drain; "
                          "live scrape is GET /metrics)")
+    scope = ap.add_argument_group("graftscope (docs/OBSERVABILITY.md)")
+    scope.add_argument("--flight_dir", type=str, default="flight_bundles",
+                       help="flight-recorder bundle dir ('off' disables); "
+                            "bundles dump on replica death, failover, SLO "
+                            "breach, watchdog stall and SIGQUIT")
+    scope.add_argument("--slo_objective", type=float, default=0.999,
+                       help="availability objective for the burn-rate "
+                            "sentry (error budget = 1 - objective)")
     add_compile_cache_args(ap)
+    add_profiler_args(ap)
     return ap
 
 
@@ -101,6 +111,7 @@ def build_wrapper(args):
 def main(argv=None):
     args = build_parser().parse_args(argv)
     enable_compile_cache(args)
+    install_sigusr2_profiler("profile_artifacts", args)
 
     from dalle_tpu import obs
     from dalle_tpu.gateway import (AdmissionController, Gateway, Replica,
@@ -109,6 +120,12 @@ def main(argv=None):
     from dalle_tpu.serve import PriorityDeadlinePolicy
 
     obs.configure()
+    if args.flight_dir != "off":
+        # the serving black box: low-rate state sampling in steady state,
+        # an atomic post-mortem bundle on replica death / failover / SLO
+        # breach / watchdog stall / SIGQUIT (docs/OBSERVABILITY.md)
+        obs.configure_recorder(args.flight_dir, sample_interval_s=1.0)
+        obs.install_signal_dump()
     dv = build_wrapper(args)
 
     def make_engine():
@@ -147,8 +164,18 @@ def main(argv=None):
         replicas.append(rep.start())
         print(f"{rep.replica_id}: serving (aot_loaded={rep.aot_loaded})")
 
+    def on_breach(verdict):
+        obs.counter_add("slo.breaches_total", 1.0)
+        path = obs.dump_recorder("slo_breach", extra={
+            "dominating": verdict["dominating"],
+            "windows": verdict["windows"]})
+        print(f"SLO BURNING (dominating window {verdict['dominating']})"
+              + (f"; bundle {path}" if path else ""), flush=True)
+
     gw = Gateway(ReplicaRouter(replicas), admission,
-                 host=args.host, port=args.port, vae=dv.vae)
+                 host=args.host, port=args.port, vae=dv.vae,
+                 slo_sentry=obs.BurnRateSentry(
+                     objective=args.slo_objective, on_breach=on_breach))
     gw.start()
     print(f"gateway listening on {gw.address} "
           f"({args.replicas} replica(s) × {args.slots} slots, "

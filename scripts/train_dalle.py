@@ -20,9 +20,10 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from _common import (add_compile_cache_args, add_overlap_args,  # noqa: E402
-                     add_vae_args, enable_compile_cache,
-                     build_vae_from_args, overlap_train_kwargs,
-                     save_image_grid, save_vae_sidecar)
+                     add_profiler_args, add_vae_args, enable_compile_cache,
+                     build_vae_from_args, install_sigusr2_profiler,
+                     overlap_train_kwargs, save_image_grid,
+                     save_vae_sidecar)
 
 
 def build_parser():
@@ -97,6 +98,7 @@ def build_parser():
 
     add_overlap_args(ap)
     add_compile_cache_args(ap)
+    add_profiler_args(ap)
 
     tel = ap.add_argument_group("telemetry (grafttrace, docs/OBSERVABILITY.md)")
     tel.add_argument("--trace", action="store_true",
@@ -122,6 +124,8 @@ def main(argv=None):
         return 2
 
     enable_compile_cache(args)
+    install_sigusr2_profiler(os.path.join(args.output_dir, "profile"),
+                             args)
     import numpy as np
     from dalle_tpu.config import DalleConfig, ObsConfig, OptimConfig, TrainConfig
     from dalle_tpu.models.wrapper import DalleWithVae, dalle_config_for_vae

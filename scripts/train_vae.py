@@ -18,6 +18,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from _common import (add_compile_cache_args, add_overlap_args,  # noqa: E402
+                     add_profiler_args, install_sigusr2_profiler,
                      enable_compile_cache, overlap_train_kwargs)
 
 
@@ -70,6 +71,7 @@ def build_parser():
 
     add_overlap_args(ap)
     add_compile_cache_args(ap)
+    add_profiler_args(ap)
     from dalle_tpu.parallel import wrap_arg_parser
     wrap_arg_parser(ap)
     return ap
@@ -82,6 +84,8 @@ def main(argv=None):
         return 2
 
     enable_compile_cache(args)
+    install_sigusr2_profiler(os.path.join(args.output_dir, "profile"),
+                             args)
     from dalle_tpu.config import (AnnealConfig, DVAEConfig, OptimConfig, TrainConfig)
     from dalle_tpu.parallel import set_backend_from_args
     from dalle_tpu.train.trainer_vae import VAETrainer

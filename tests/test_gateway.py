@@ -8,6 +8,7 @@ single-request ``generate_images_tokens`` bitwise."""
 
 import base64
 import json
+import os
 import threading
 import time
 
@@ -232,6 +233,57 @@ def test_replica_failover_midstream_exact(model_params, refs):
     router.drain(timeout=30)
 
 
+def test_trace_id_survives_failover_and_bundles(model_params, refs,
+                                                tmp_path):
+    """graftscope: a routed request keeps ONE trace_id across the victim
+    replica's admission, the failover resubmission and the standby's
+    admission — every span it touched is tagged with it — and the failover
+    leaves a flight-recorder bundle holding the replica_failed + failover
+    lifecycle events and the dying worker's last decode-row spans."""
+    from dalle_tpu import obs
+    from dalle_tpu.gateway import Replica, ReplicaRouter
+    obs.disable()
+    tr = obs.configure()
+    obs.configure_recorder(str(tmp_path), min_dump_interval_s=0.0)
+    try:
+        ra = Replica(_engine(model_params), replica_id="fa").start()
+        rb = Replica(_engine(model_params), replica_id="fb").start()
+        router = ReplicaRouter([ra, rb])
+        ra.fail_after_rows(2)
+        routed = router.submit(TEXTS[2], 102)
+        tid = routed.trace_id
+        assert tid                        # minted at submit for direct callers
+        done = None
+        for kind, payload in routed.events(timeout=60):
+            if kind == "done":
+                done = payload
+        assert done["tokens"] == refs[2].tolist() and done["failovers"] == 1
+        spans = [s for s in tr.snapshot_spans()
+                 if (s[5] or {}).get("trace_id") == tid]
+        qwaits = [s for s in spans if s[0] == "serve/request_queue_wait"]
+        assert len(qwaits) == 2           # one identity, two admissions
+        assert {s[0] for s in spans} >= {"serve/prefill", "serve/decode_row"}
+        assert len({s[3] for s in spans}) >= 2    # victim + standby threads
+
+        bundles = sorted(p for p in os.listdir(tmp_path)
+                         if p.startswith("postmortem_failover"))
+        assert bundles
+        pm = json.load(open(tmp_path / bundles[-1] / "postmortem.json"))
+        kinds = [e["kind"] for e in pm["events"]]
+        assert "replica_failed" in kinds and "failover" in kinds
+        fo = next(e for e in pm["events"] if e["kind"] == "failover")
+        assert fo["trace_id"] == tid and fo["from_replica"] == "fa"
+        trace = json.load(open(tmp_path / bundles[-1] / "trace.json"))
+        dying_rows = [e for e in trace["traceEvents"]
+                      if (e.get("args") or {}).get("trace_id") == tid
+                      and e["name"] == "serve/decode_row"]
+        assert dying_rows                 # the victim's last committed rows
+        router.drain(timeout=30)
+    finally:
+        obs.disable()
+        obs.disable_recorder()
+
+
 def test_replica_deadline_shed_event(model_params):
     """PriorityDeadlinePolicy sheds an already-expired request at take time
     and its stream terminates with the shed event (gateway → 504), while
@@ -276,12 +328,15 @@ def test_gateway_loopback_stream_quota_health(model_params, refs):
                            "stream": True})
         assert resp.status == 200
         assert resp.getheader("Content-Type") == "text/event-stream"
+        tid = resp.getheader("X-Request-Id")
+        assert tid                        # the door-minted graftscope id
         events = list(iter_sse(resp))
         conn.close()
         rows = [d for e, d in events if e == "row"]
         done = [d for e, d in events if e == "done"]
         assert [t for r in rows for t in r["tokens"]] == refs[0].tolist()
         assert done and done[0]["tokens"] == refs[0].tolist()
+        assert all(d.get("trace_id") == tid for _, d in events)
 
         conn, resp = post({"text": TEXTS[1].tolist(), "seed": 101,
                            "tenant": "capped"})
@@ -293,6 +348,7 @@ def test_gateway_loopback_stream_quota_health(model_params, refs):
         body = json.loads(resp.read())
         assert resp.status == 429 and body["error"] == "quota"
         assert float(resp.getheader("Retry-After")) > 0
+        assert resp.getheader("X-Request-Id")  # errors join the timeline too
         conn.close()
 
         conn = http.client.HTTPConnection(host, port, timeout=10)

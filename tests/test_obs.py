@@ -348,3 +348,336 @@ def test_throughput_meter_known_chip_untagged(monkeypatch):
     time.sleep(0.01)
     rep = meter.step(2)
     assert "mfu_estimated" not in rep
+
+
+# -- graftscope: trace context (obs/context.py) ------------------------------
+
+def test_trace_context_tags_spans_and_record_span(tracer):
+    with obs.trace_context("t1"):
+        with obs.span("a"):
+            pass
+        obs.record_span("b", time.perf_counter(), 0.01)
+    with obs.span("c"):
+        pass
+    by = {r[0]: (r[5] or {}) for r in tracer.spans}
+    assert by["a"]["trace_id"] == "t1"
+    assert by["b"]["trace_id"] == "t1"
+    assert "trace_id" not in by["c"]
+
+
+def test_trace_context_nesting_restores_previous():
+    assert obs.current_trace_id() is None
+    with obs.trace_context("outer"):
+        assert obs.current_trace_id() == "outer"
+        with obs.trace_context("inner"):
+            assert obs.current_trace_id() == "inner"
+        assert obs.current_trace_id() == "outer"
+    assert obs.current_trace_id() is None
+
+
+def test_explicit_trace_id_wins_over_ambient(tracer):
+    with obs.trace_context("ambient"):
+        obs.record_span("x", time.perf_counter(), 0.0, trace_id="explicit")
+        with obs.span("y", trace_id="mine"):
+            pass
+    by = {r[0]: r[5] for r in tracer.spans}
+    assert by["x"]["trace_id"] == "explicit"
+    assert by["y"]["trace_id"] == "mine"
+
+
+def test_new_trace_ids_unique():
+    ids = {obs.new_trace_id() for _ in range(256)}
+    assert len(ids) == 256
+
+
+# -- ring overflow accounting under concurrent writers -----------------------
+
+def test_ring_overflow_accounting_concurrent_writers():
+    """N writer threads hammer a tiny ring: the kept-span count equals the
+    capacity and EVERY eviction is counted — dropped + kept == recorded
+    exactly, even under contention (the accounting rides the record lock)."""
+    obs.disable()
+    tr = obs.configure(capacity=32)
+    n_threads, per = 8, 200
+    try:
+        def worker(k):
+            for i in range(per):
+                with obs.span(f"w{k}"):
+                    pass
+
+        ts = [threading.Thread(target=worker, args=(k,))
+              for k in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len(tr.spans) == 32
+        assert tr.dropped == n_threads * per - 32
+        assert obs.metrics_snapshot()["obs.spans_dropped"] == tr.dropped
+    finally:
+        obs.disable()
+
+
+# -- labeled counters/gauges + Prometheus rendering --------------------------
+
+def test_labeled_counters_canonical_series_and_render(tracer):
+    obs.counter_add("gw.rej_total", 1, labels={"tenant": "a", "reason": "q"})
+    obs.counter_add("gw.rej_total", 2, labels={"reason": "q", "tenant": "a"})
+    obs.counter_add("gw.rej_total", 1, labels={"tenant": "b", "reason": "q"})
+    obs.counter_add("gw.rej_total", 5)          # unlabeled stays its own
+    snap = obs.metrics_snapshot()
+    assert snap['gw.rej_total{reason="q",tenant="a"}'] == 3
+    assert snap['gw.rej_total{reason="q",tenant="b"}'] == 1
+    assert snap["gw.rej_total"] == 5
+    text = prom.render_textfile(snap)
+    assert 'dalle_gw_rej_total{reason="q",tenant="a"} 3' in text
+    assert 'dalle_gw_rej_total{reason="q",tenant="b"} 1' in text
+    # ONE type line for the whole family (bare + labeled series share it),
+    # labels never mangled into names
+    assert text.count("# TYPE dalle_gw_rej_total counter") == 1
+    assert "dalle_gw_rej_total_a" not in text
+
+
+def test_label_values_escaped(tracer):
+    obs.gauge_set("g", 1.0, labels={"k": 'a"b\\c'})
+    (key,) = obs.metrics_snapshot().keys()
+    assert key == 'g{k="a\\"b\\\\c"}'
+    assert prom.sanitize_metric_name(key) == 'dalle_g{k="a\\"b\\\\c"}'
+
+
+# -- per-request Perfetto tracks ---------------------------------------------
+
+def test_chrome_trace_request_tracks(tmp_path, tracer):
+    with obs.trace_context("req1"):
+        with obs.span("s1"):
+            pass
+    with obs.span("untagged"):
+        pass
+    path = str(tmp_path / "t.json")
+    obs.export_chrome_trace(path, request_tracks=True)
+    doc = json.load(open(path))
+    evs = doc["traceEvents"]
+    req = [e for e in evs if e["pid"] == 1 and e.get("ph") == "X"]
+    assert [e["name"] for e in req] == ["s1"]
+    assert "source_tid" in req[0]["args"]
+    meta = [e for e in evs if e.get("ph") == "M"]
+    assert any(e["args"]["name"] == "request req1" for e in meta)
+    # the real per-thread view keeps both spans
+    real = [e for e in evs if e["pid"] != 1 and e.get("ph") == "X"]
+    assert {e["name"] for e in real} == {"s1", "untagged"}
+
+
+# -- flight recorder ---------------------------------------------------------
+
+def test_flight_recorder_bundle_contents_and_delta(tmp_path, tracer):
+    import os
+    rec = obs.configure_recorder(str(tmp_path), min_dump_interval_s=0.0)
+    try:
+        obs.counter_add("x_total", 3)
+        obs.record_event("failover", trace_id="t9")
+        with obs.trace_context("t9"):
+            with obs.span("serve/decode_row"):
+                pass
+        path = rec.dump("replica_death", extra={"replica_id": "r0"})
+        assert os.path.basename(path).startswith("postmortem_replica_death")
+        assert not [p for p in os.listdir(tmp_path)
+                    if p.startswith(".tmp")]          # atomic: no staging left
+        pm = json.load(open(os.path.join(path, "postmortem.json")))
+        assert pm["reason"] == "replica_death"
+        assert [e["kind"] for e in pm["events"]] == ["failover"]
+        assert pm["events"][0]["trace_id"] == "t9"
+        assert pm["extra"]["replica_id"] == "r0"
+        assert pm["metrics_delta_since_last_dump"]["x_total"] == 3
+        tr_doc = json.load(open(os.path.join(path, "trace.json")))
+        assert any((e.get("args") or {}).get("trace_id") == "t9"
+                   for e in tr_doc["traceEvents"])
+        # deltas reset between dumps
+        obs.counter_add("x_total", 2)
+        pm2 = json.load(open(os.path.join(
+            rec.dump("replica_death"), "postmortem.json")))
+        assert pm2["metrics_delta_since_last_dump"]["x_total"] == 2
+    finally:
+        obs.disable_recorder()
+
+
+def test_flight_recorder_rate_limit_and_event_bound(tmp_path):
+    rec = obs.FlightRecorder(str(tmp_path), capacity=4,
+                             min_dump_interval_s=60.0)
+    for i in range(10):
+        rec.event("e", i=i)
+    assert len(rec.events) == 4 and rec.events_dropped == 6
+    assert [e["i"] for e in rec.events] == [6, 7, 8, 9]   # newest kept
+    assert rec.dump("stall") is not None
+    assert rec.dump("stall") is None                      # rate-limited
+    assert rec.dumps_suppressed == 1
+    assert rec.dump("other") is not None                  # per-reason limit
+    assert rec.dump("stall", force=True) is not None
+
+
+def test_recorder_hooks_noop_without_recorder():
+    obs.disable_recorder()
+    obs.record_event("e")                 # must not raise
+    assert obs.dump_recorder("r") is None
+
+
+# -- state providers + watchdog snapshot -------------------------------------
+
+def test_state_providers_collect_and_survive_errors():
+    obs.register_state_provider("unit", lambda: {"q": 3})
+    obs.register_state_provider("boom", lambda: 1 / 0)
+    try:
+        st = obs.collect_state()
+        assert st["unit"] == {"q": 3}
+        assert "provider error" in st["boom"]
+    finally:
+        obs.unregister_state_provider("unit")
+        obs.unregister_state_provider("boom")
+    assert "unit" not in obs.collect_state()
+
+
+def test_watchdog_report_includes_serve_state():
+    obs.register_state_provider("serve.engine[t]",
+                                lambda: {"queue_depth": 7, "inflight": []})
+    logs = []
+    wd = obs.StallWatchdog(0.05, log=logs.append, poll_s=0.01,
+                           dump_stacks=False).start()
+    try:
+        time.sleep(0.25)
+    finally:
+        wd.stop()
+        obs.unregister_state_provider("serve.engine[t]")
+    assert wd.stall_count >= 1
+    assert wd.last_report.state["serve.engine[t]"]["queue_depth"] == 7
+    assert "queue_depth" in logs[0]
+
+
+def test_watchdog_stall_dumps_flight_bundle(tmp_path):
+    import os
+    obs.configure_recorder(str(tmp_path), min_dump_interval_s=0.0)
+    try:
+        wd = obs.StallWatchdog(0.05, log=lambda *_: None, poll_s=0.01,
+                               dump_stacks=False).start()
+        try:
+            time.sleep(0.25)
+        finally:
+            wd.stop()
+        assert [p for p in os.listdir(tmp_path)
+                if p.startswith("postmortem_watchdog_stall")]
+    finally:
+        obs.disable_recorder()
+
+
+# -- SLO burn-rate sentry ----------------------------------------------------
+
+def test_burn_rate_sentry_multiwindow_breach_and_recovery(tracer):
+    t = [0.0]
+    breaches = []
+    s = obs.BurnRateSentry(objective=0.99,
+                           windows=((10.0, 2.0), (100.0, 2.0)),
+                           min_events=4, on_breach=breaches.append,
+                           clock=lambda: t[0])
+    for _ in range(8):                    # healthy traffic: no burn
+        t[0] += 0.5
+        s.record(True)
+    assert not s.burning and breaches == []
+    for _ in range(4):                    # outage: 4/12 bad, burn 33x >= 2x
+        t[0] += 0.5
+        s.record(False, reason="quota")
+    assert s.burning
+    assert len(breaches) == 1             # exactly one ok->burning edge
+    assert breaches[0]["burning"] and breaches[0]["dominating"] in ("10s",
+                                                                    "100s")
+    snap = obs.metrics_snapshot()
+    assert snap["slo.burning"] == 1.0
+    assert snap['slo.burn_rate{window="10s"}'] >= 2.0
+    assert snap['slo.bad_events_total{reason="quota"}'] == 4
+    # recovery: the short window drains of bad events -> multi-window AND
+    # stops paging even though the long window still remembers the outage
+    for _ in range(12):
+        t[0] += 1.0
+        s.record(True)
+    assert not s.burning
+    v = s.evaluate()
+    w = {r["window"]: r for r in v["windows"]}
+    assert w["10s"]["bad"] == 0 and w["100s"]["bad"] == 4
+    assert not w["10s"]["burning"] and w["100s"]["burning"]
+    assert len(breaches) == 1             # no re-fire without a new edge
+
+
+def test_burn_rate_sentry_cold_start_never_pages(tracer):
+    s = obs.BurnRateSentry(min_events=10, clock=lambda: 0.0)
+    for _ in range(5):
+        s.record(False, reason="quota")   # 100% errors but < min_events
+    assert not s.burning
+
+
+def test_window_label():
+    from dalle_tpu.obs.slo import window_label
+    assert window_label(300) == "5m"
+    assert window_label(3600) == "1h"
+    assert window_label(45) == "45s"
+
+
+# -- request timeline reassembly ---------------------------------------------
+
+def test_request_timeline_cross_thread_order():
+    rows = [
+        {"name": "gateway/sse_flush", "ts": 3.0, "dur_s": 0.1, "tid": 2,
+         "args": {"trace_id": "rq"}},
+        {"name": "serve/request_queue_wait", "ts": 1.0, "dur_s": 0.5,
+         "tid": 1, "args": {"trace_id": "rq"}},
+        {"name": "other", "ts": 1.5, "dur_s": 0.1, "tid": 1,
+         "args": {"trace_id": "zz"}},
+        {"name": "serve/prefill", "ts": 2.0, "dur_s": 0.3, "tid": 1,
+         "args": {"trace_id": "rq", "mode": "window"}},
+    ]
+    tl = obs.request_timeline(rows, "rq")
+    assert [e["name"] for e in tl] == ["serve/request_queue_wait",
+                                      "serve/prefill", "gateway/sse_flush"]
+    assert tl[0]["t_rel_s"] == 0.0
+    assert tl[1]["t_rel_s"] == 1.0 and tl[2]["tid"] == 2
+    text = obs.format_request_timeline(rows, "rq")
+    assert "2 thread(s)" in text and "serve/prefill" in text
+    assert obs.format_request_timeline(rows, "nope").startswith("(no spans")
+    # engine-only runs match by integer request_id
+    rows_id = [{"name": "serve/request", "ts": 1.0, "dur_s": 0.1, "tid": 1,
+                "args": {"request_id": 7}}]
+    assert [e["name"] for e in obs.request_timeline(rows_id, "7")] \
+        == ["serve/request"]
+
+
+def test_report_slo_verdict_line(tmp_path):
+    path = str(tmp_path / "metrics.jsonl")
+    with open(path, "w") as fh:
+        fh.write(json.dumps({
+            "step": 0, "gateway.inflight": 0.0,
+            'slo.burn_rate{window="5m"}': 120.0,
+            'slo.burn_threshold{window="5m"}': 14.4,
+            'slo.burn_rate{window="1h"}': 20.0,
+            'slo.burn_threshold{window="1h"}': 14.4,
+            "slo.burning": 1.0}) + "\n")
+    text = obs_report.summarize_run(path)
+    assert "slo burn rate" in text
+    assert "BURNING (dominating window 5m)" in text
+    with open(path, "w") as fh:
+        fh.write(json.dumps({
+            "step": 0, "gateway.inflight": 0.0,
+            'slo.burn_rate{window="5m"}': 0.0,
+            'slo.burn_threshold{window="5m"}': 14.4,
+            "slo.burning": 0.0}) + "\n")
+    assert "→ ok" in obs_report.summarize_run(path)
+
+
+def test_report_gateway_by_tenant_parses_labeled_counters(tmp_path):
+    path = str(tmp_path / "metrics.jsonl")
+    with open(path, "w") as fh:
+        fh.write(json.dumps({
+            "step": 0, "gateway.inflight": 1.0,
+            "gateway.rejected_total": 3.0,
+            'gateway.rejected_by_total{reason="quota",tenant="capped"}': 2.0,
+            'gateway.rejected_by_total{reason="slo",tenant="best"}': 1.0,
+        }) + "\n")
+    gw = obs_report.gateway_accounting(
+        obs_report.load_jsonl(path), [])
+    assert gw["by_tenant"] == {"capped": 2, "best": 1}
+    assert gw["verdict"] == "ADMISSION-LIMITED"
