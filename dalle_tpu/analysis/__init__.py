@@ -37,4 +37,5 @@ from . import rules_vmem  # noqa: F401,E402
 from . import rules_scatter  # noqa: F401,E402
 from . import rules_weaktype  # noqa: F401,E402
 from . import rules_precision  # noqa: F401,E402
+from . import rules_obs  # noqa: F401,E402
 from . import rules_coverage  # noqa: F401,E402

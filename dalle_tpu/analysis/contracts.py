@@ -78,11 +78,23 @@ def _ckpt_dir() -> str:
 
 
 def _train_cfg(mesh_cfg, **kw):
-    from ..config import OptimConfig, PrecisionConfig, TrainConfig
+    from ..config import ObsConfig, OptimConfig, PrecisionConfig, TrainConfig
+    # health=True: the trainer goldens pin the graftpulse-tapped step
+    # programs (obs/health.py) — the contract is that the taps add in-graph
+    # reductions ONLY: no host-transfer primitives, no new collectives, and
+    # donation stays fully aliased (obs_smoke re-asserts the transfer
+    # invariant from the goldens; drift here fails the graftir CI stage).
+    # The health=False default programs are NOT separately pinned —
+    # duplicating all four compiled trainer entries would nearly double the
+    # audit's wall time; instead obs_smoke live-builds the vae step BOTH
+    # ways each CI run and diffs the two contracts (transfers, donation,
+    # collective delta), guarding the off-variant structure through the
+    # representative trainer.
     return TrainConfig(batch_size=8, preflight_checkpoint=False,
                        checkpoint_dir=_ckpt_dir(), mesh=mesh_cfg,
                        precision=PrecisionConfig(compute="float32"),
-                       optim=OptimConfig(learning_rate=1e-2), **kw)
+                       optim=OptimConfig(learning_rate=1e-2),
+                       obs=ObsConfig(health=True), **kw)
 
 
 @functools.lru_cache(maxsize=None)
@@ -269,6 +281,25 @@ def _build_serve_decode_aot() -> BuiltEntry:
     args = _program_args(eng)["step"]
     return BuiltEntry(fn=eng._step_fn, args=args,
                       donated=_tree_leaves(args[1]), compile=True)
+
+
+@register_entry("serve_decode_health", "dalle_tpu/serve/engine.py")
+def _build_serve_decode_health() -> BuiltEntry:
+    # the graftpulse-instrumented decode step (decode_health=True): the
+    # per-row entropy/top-k taps computed from the logits already on
+    # device. The golden pins that the taps are free of host transfers and
+    # change nothing about the collectives — and, vs ``serve_decode``, that
+    # the sampling path itself is untouched (the bit-exactness contract's
+    # static half).
+    import jax.numpy as jnp
+    from ..ops.quantize_weights import quantize_params_int8
+    from ..serve.engine import DecodeEngine
+    model, params = _dalle_model()
+    eng = DecodeEngine(model, quantize_params_int8(params), slots=4,
+                       cache_dtype=jnp.int8, decode_health=True)
+    state = eng._init_state()
+    return BuiltEntry(fn=eng._step_fn, args=(eng.params, state),
+                      donated=_tree_leaves(state), compile=True)
 
 
 @register_entry("serve_refill", "dalle_tpu/serve/engine.py")

@@ -1,0 +1,99 @@
+"""Observability-hygiene rules — codifying the Prometheus cardinality lesson.
+
+A labeled series (``counter_add``/``gauge_set`` with ``labels={...}``) is a
+distinct time series PER LABEL-VALUE COMBINATION, held forever in the obs
+registry and rendered into every textfile/scrape. Bounded dimensions
+(tenant, reject reason, SLO window, layer group) are exactly what labels
+are for; per-request values — trace_id, request_id, raw prompt text — are
+not: every request mints a new series, the registry grows without bound,
+and the scrape (and every ``MetricsLogger`` record, which merges the
+snapshot) bloats with it. graftpulse hit this head-on: per-request decode
+quality is deliberately shipped as span args / flight-recorder events
+(bounded rings) plus UNLABELED aggregate gauges — never as labels
+(serve/engine.py). This rule makes that boundary a lint finding instead of
+a review comment:
+
+  * ``unbounded-metric-label`` — a ``counter_add``/``gauge_set`` call whose
+    ``labels`` dict has a VALUE derived from per-request data: an
+    identifier or attribute named like request identity/payload
+    (``trace_id``, ``request_id``, ``text``, ``prompt``, ...), including
+    through ``str()``/f-string wrapping. Keys are fine — ``{"trace_id":
+    ...}`` is flagged via its value, not its name, so a bounded value under
+    an unfortunate key stays legal.
+
+Syntactic by design (the rules_jit trade): the denylist names the
+identifiers this codebase uses for request-scoped data; a genuinely bounded
+value that happens to share a name takes a one-line suppression next to the
+call.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from .core import FileContext, Finding, Rule, register_rule
+
+_SINKS = ("counter_add", "gauge_set")
+
+# identifiers that carry per-request (unbounded-cardinality) data in this
+# codebase: request identity, raw payload, and per-request randomness
+_REQUEST_NAMES = frozenset({
+    "trace_id", "request_id", "text", "prompt", "caption", "seed",
+    "x_request_id",
+})
+
+
+def _request_taint(node: ast.expr) -> Optional[str]:
+    """The denylisted name a label-value expression reaches, or None.
+    Walks through calls (str(...), f"{...}"), attributes (req.trace_id),
+    and subscripts so wrapping can't launder the value."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in _REQUEST_NAMES:
+            return sub.id
+        if isinstance(sub, ast.Attribute) and sub.attr in _REQUEST_NAMES:
+            return sub.attr
+    return None
+
+
+@register_rule
+class UnboundedMetricLabel(Rule):
+    name = "unbounded-metric-label"
+    description = ("counter_add/gauge_set labels value derived from "
+                   "per-request data (trace_id, request_id, raw text/"
+                   "prompt, seed) — every request mints a new Prometheus "
+                   "series and the registry grows without bound; ship "
+                   "per-request values as span args / recorder events and "
+                   "keep labels for bounded dimensions")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            fname = fn.attr if isinstance(fn, ast.Attribute) else \
+                fn.id if isinstance(fn, ast.Name) else None
+            if fname not in _SINKS:
+                continue
+            # labels is keyword-or-positional: counter_add(name, value,
+            # labels) / gauge_set(name, value, labels) — a positional dict
+            # must not evade the rule
+            labels = next((kw.value for kw in node.keywords
+                           if kw.arg == "labels"), None)
+            if labels is None and len(node.args) >= 3:
+                labels = node.args[2]
+            if not isinstance(labels, ast.Dict):
+                continue
+            for key, val in zip(labels.keys, labels.values):
+                taint = _request_taint(val)
+                if taint is None:
+                    continue
+                kname = (key.value if isinstance(key, ast.Constant)
+                         else "<dynamic>")
+                yield Finding(
+                    self.name, ctx.rel_path, node.lineno,
+                    f"{fname} label {kname!r} takes its value from "
+                    f"per-request data ({taint!r}) — unbounded series "
+                    "cardinality; record per-request values as span args "
+                    "or flight-recorder events (obs.record_span/"
+                    "record_event) and aggregate into unlabeled gauges")

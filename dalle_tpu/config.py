@@ -425,6 +425,23 @@ class ObsConfig(ConfigBase):
     # 0 disables device polling
     device_poll_every: int = 10
     prometheus_path: str = ""      # node-exporter textfile target ("" = off)
+    # -- graftpulse model-health telemetry (obs/health.py, obs/anomaly.py) --
+    # fuse per-layer-group grad/param/update/nonfinite taps (and codebook
+    # vitals on the VAE trainers) into the jitted train step; the scalars
+    # ride the existing metrics fetch — zero added host syncs. Changes the
+    # compiled program, so the graftir goldens pin it (contracts build with
+    # health on).
+    health: bool = False
+    # pytree path depth for layer groups (after dropping flax "params"
+    # levels): 1 = model subtrees (transformer/encoder/decoder/...)
+    health_group_depth: int = 1
+    # anomaly-sentry thresholds (obs/anomaly.py): loss z-score, grad-norm
+    # explosion factor over the EMA, absolute codebook-perplexity collapse
+    # floor, and the warmup observations before any detector may fire
+    health_loss_z: float = 6.0
+    health_grad_factor: float = 10.0
+    health_perplexity_floor: float = 4.0
+    health_min_samples: int = 5
 
 
 @dataclass(frozen=True)

@@ -76,6 +76,11 @@ def engine_fingerprint(engine) -> dict:
         "temperature": engine.temperature,
         "topk_approx": engine.topk_approx,
         "use_kernel": engine.use_kernel,
+        # program-shaping: the graftpulse taps change the step program's
+        # outputs, so a bundle exported without them must not load into an
+        # engine expecting them (and vice versa). Pre-graftpulse bundles
+        # lack the key entirely → mismatch → loud jit fallback.
+        "decode_health": engine.decode_health,
         "param_avals": _aval_digest(engine.params),
     }
 

@@ -130,7 +130,14 @@ class DiscreteVAE(nn.Module):
         return self.decoder(emb.reshape(b, hw, hw, d))
 
     def __call__(self, img, temp: Optional[float] = None, return_loss: bool = False,
-                 return_recons: bool = False, hard_recons: bool = False):
+                 return_recons: bool = False, hard_recons: bool = False,
+                 return_health: bool = False):
+        """``return_health`` appends a graftpulse health dict (codebook
+        usage perplexity/dead-frac, gumbel temperature, straight-through
+        sharpness — obs/health.py) as the LAST tuple element of every
+        return path: pure jnp scalars computed from tensors already live
+        in the step, so the taps fuse into the jitted program with no
+        extra passes and no host syncs."""
         c = self.cfg
         img_n = self.norm(img)
         logits = self.encoder(img_n)
@@ -146,8 +153,16 @@ class DiscreteVAE(nn.Module):
         sampled = jnp.einsum("bhwn,nd->bhwd", one_hot, self.codebook.embedding)
         out = self.decoder(sampled)
 
+        health = None
+        if return_health:
+            from ..obs.health import codebook_health, gumbel_health
+            # usage from the encoder argmax — the same statistic the
+            # reference's wandb collapse histogram plots (train_vae:258-264)
+            health = codebook_health(jnp.argmax(logits, -1), c.num_tokens)
+            health.update(gumbel_health(logits, one_hot, temp))
+
         if not return_loss:
-            return out
+            return (out, health) if return_health else out
 
         # recon loss on *normalized* target, as the reference does (:236);
         # reductions in f32 so a bf16 compute path keeps a clean loss signal
@@ -163,8 +178,8 @@ class DiscreteVAE(nn.Module):
         loss = recon + kl * c.kl_div_loss_weight
 
         if not return_recons:
-            return loss
-        return loss, out
+            return (loss, health) if return_health else loss
+        return (loss, out, health) if return_health else (loss, out)
 
 
 def init_dvae(cfg: DVAEConfig, key: jax.Array, batch: int = 1):

@@ -246,6 +246,25 @@ class VQModel(nn.Module):
         quant = self.codebook(ids).reshape(b, hw, hw, self.cfg.embed_dim)
         return self.decode(quant)
 
+    def health_taps(self, q: VQOutput, temp: Optional[float] = None) -> dict:
+        """graftpulse vitals from one encode's :class:`VQOutput`
+        (obs/health.py): codebook usage perplexity / dead-code fraction /
+        entropy from the quantizer indices, plus — on the gumbel path,
+        where ``q.probs`` carries the relaxation distribution — the live
+        temperature and the encoder's mean argmax confidence. Pure jnp on
+        tensors the step already holds; the VQGAN trainers fuse these into
+        their jitted steps when ``ObsConfig.health`` is on."""
+        from ..obs.health import HEALTH_PREFIX, codebook_health
+        out = codebook_health(q.indices, self.cfg.n_embed)
+        if q.probs is not None:
+            # health taps are f32 by contract (obs/health.py) — deliberate
+            # pin, independent of the compute precision mode
+            out[f"{HEALTH_PREFIX}gumbel_temp"] = jnp.asarray(  # graftlint: disable=hardcoded-dtype
+                1.0 if temp is None else temp, jnp.float32)
+            out[f"{HEALTH_PREFIX}encoder_confidence"] = jnp.mean(
+                jnp.max(q.probs.astype(jnp.float32), axis=-1))
+        return out
+
     def __call__(self, img, temp: Optional[float] = None,
                  deterministic: bool = True):
         q = self.encode(img, temp=temp, deterministic=deterministic)

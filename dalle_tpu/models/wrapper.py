@@ -133,7 +133,7 @@ class DalleWithVae:
     def serve_engine(self, *, slots: int, precision: str = "int8w",
                      filter_thres: float = 0.5, temperature: float = 1.0,
                      topk_approx: bool = False, steps_per_sync: int = 1,
-                     use_kernel=None):
+                     use_kernel=None, decode_health: bool = False):
         """Continuous-batching decode engine over this wrapper's model —
         the serving-side sibling of ``generate_images``. ``slots`` is the
         fixed device batch; precision modes are the same fast paths
@@ -164,7 +164,8 @@ class DalleWithVae:
                             temperature=temperature,
                             topk_approx=topk_approx,
                             steps_per_sync=steps_per_sync,
-                            use_kernel=use_kernel)
+                            use_kernel=use_kernel,
+                            decode_health=decode_health)
 
     def generate_images(self, text, key, *, filter_thres: float = 0.5,
                         temperature: float = 1.0, cond_scale: float = 1.0,

@@ -11,6 +11,9 @@ global ``None`` check until ``configure()`` enables tracing
 (``TrainConfig.obs.trace`` / ``--obs.trace true`` from the CLIs).
 """
 
+from .anomaly import (Breach, CodebookCollapseDetector, GradExplosionDetector,
+                      HealthSentry, LossSpikeDetector, NaNPrecursorDetector,
+                      split_health_key)
 from .context import current_trace_id, new_trace_id, trace_context
 from .prometheus import render_textfile, sanitize_metric_name, write_textfile
 from .recorder import (FlightRecorder, collect_state, configure_recorder,
@@ -29,8 +32,18 @@ from .watchdog import StallReport, StallWatchdog
 _DEVICE_NAMES = ("CompileCounter", "DeviceTelemetry", "device_memory_stats",
                  "device_memory_headroom", "install_compile_counter")
 
+# graftpulse in-jit taps (obs/health.py) import jax; resolved lazily like
+# obs.device so the host-side anomaly/report layers stay jax-free
+_HEALTH_NAMES = ("layer_groups", "group_norms", "nonfinite_fractions",
+                 "tree_health", "codebook_health", "gumbel_health",
+                 "decode_quality")
+
 __all__ = [
-    *_DEVICE_NAMES, "current_trace_id", "new_trace_id", "trace_context",
+    *_DEVICE_NAMES, *_HEALTH_NAMES,
+    "Breach", "CodebookCollapseDetector", "GradExplosionDetector",
+    "HealthSentry", "LossSpikeDetector", "NaNPrecursorDetector",
+    "split_health_key",
+    "current_trace_id", "new_trace_id", "trace_context",
     "render_textfile", "sanitize_metric_name", "write_textfile",
     "FlightRecorder", "collect_state", "configure_recorder",
     "disable_recorder", "dump_recorder", "get_recorder",
@@ -51,4 +64,7 @@ def __getattr__(name):
     if name in _DEVICE_NAMES:
         from . import device
         return getattr(device, name)
+    if name in _HEALTH_NAMES:
+        from . import health
+        return getattr(health, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,217 @@
+"""graftpulse in-jit model-health taps: the numbers that watch the numbers.
+
+The runtime layers (grafttrace spans, graftscope request tracing) watch how
+fast the system moves; nothing before this module watched whether the MODEL
+is healthy while it moves. The classic silent failure modes of this exact
+pipeline — dVAE/VQGAN codebook collapse, gradient explosion, NaN-precursor
+inf creep, degenerate decode sampling — all announce themselves in on-device
+tensors long before they show up as a wasted run or bad images. graftpulse
+reads them there:
+
+  * every tap in this module is **pure jnp on traced values** and is fused
+    into the jitted train step (trainers pass ``health=True`` to their step
+    body factories, driven by ``ObsConfig.health``). The resulting scalars
+    ride the step's existing metrics dict, so they are fetched by the same
+    deferred-metrics ``device_get`` the loss already pays for — **zero
+    added host syncs** (obs_smoke asserts steady-state batch_wait+sync ≈ 0
+    with the taps on, and the regenerated graftir goldens pin the tapped
+    programs with no host-transfer primitives and unchanged collectives).
+  * reductions are f32 regardless of the compute dtype (the graftnum
+    low-precision-reduction discipline: a bf16 grad-norm accumulation would
+    be exactly the kind of quiet numeric rot this layer exists to catch).
+
+Metric keys are ``health/<metric>/<layer_group>`` (group = truncated pytree
+path, ``params`` wrapper levels dropped) or ``health/<metric>`` for
+model-global taps. The host-side consumer is :mod:`dalle_tpu.obs.anomaly`,
+which turns the columns into ``dalle_health_*`` labeled gauges, breach
+events, flight-recorder bundles and the ``obs_report`` MODEL-HEALTH verdict.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+# the flat-key naming contract is shared with the (jax-free) host-side
+# consumers — anomaly.py owns it so report/anomaly never import jax
+from .anomaly import HEALTH_PREFIX, split_health_key  # noqa: F401
+
+
+def _path_parts(path) -> list:
+    """jax key-path entries → name strings (DictKey/GetAttrKey/SequenceKey)."""
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:  # future key kinds degrade to their repr, never crash a tap
+            parts.append(str(p))
+    return parts
+
+
+def layer_groups(tree, depth: int = 1, prefix: str = "") -> Dict[str, list]:
+    """Group a pytree's leaves by truncated path: ``{group: [leaves]}``.
+
+    Flax wraps everything in ``params`` collections; those levels carry no
+    information, so every ``params`` component is dropped before the depth
+    cut. ``depth=1`` on a DALLE state yields transformer/text_emb/image_emb
+    — the granularity an operator can act on. ``prefix`` namespaces the
+    groups (the VQGAN trainer uses gen/disc)."""
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out: Dict[str, list] = {}
+    for path, leaf in leaves:
+        parts = [p for p in _path_parts(path) if p != "params"]
+        key = "/".join(parts[:depth]) if parts else ""
+        if prefix:
+            key = f"{prefix}/{key}" if key else prefix
+        out.setdefault(key or "root", []).append(leaf)
+    return out
+
+
+def _sq_sum_f32(leaves) -> jnp.ndarray:
+    """Σ x² over a leaf list, accumulated in f32 (bf16 leaves upcast per
+    element BEFORE the square — the sum of millions of bf16 squares would
+    lose the very drift these taps watch for). Spelled ``x * x`` rather
+    than ``jnp.square`` so the per-leaf reduce is HLO-identical to optax's
+    ``global_norm``/``clip_by_global_norm`` reduces and CSE folds the grad
+    half of the taps into work the step already does."""
+    total = jnp.float32(0.0)
+    for leaf in leaves:
+        if not jnp.issubdtype(leaf.dtype, jnp.floating):
+            continue
+        x = leaf.astype(jnp.float32)
+        total = total + jnp.sum(x * x)
+    return total
+
+
+def group_norms(tree, depth: int = 1, prefix: str = "") -> Dict[str, jnp.ndarray]:
+    """Per-layer-group L2 norms of a pytree (f32 scalars, on device)."""
+    return {g: jnp.sqrt(_sq_sum_f32(ls))
+            for g, ls in layer_groups(tree, depth, prefix).items()}
+
+
+def nonfinite_fractions(tree, depth: int = 1,
+                        prefix: str = "") -> Dict[str, jnp.ndarray]:
+    """Per-group fraction of non-finite (inf/nan) elements — the NaN
+    PRECURSOR: a handful of infs in one layer's grads precede the step
+    where the loss itself goes NaN, and the rollback machinery only sees
+    the latter."""
+    out = {}
+    for g, ls in layer_groups(tree, depth, prefix).items():
+        fl = [l for l in ls if jnp.issubdtype(l.dtype, jnp.floating)]
+        if not fl:
+            continue
+        n = sum(l.size for l in fl)
+        bad = jnp.float32(0.0)
+        for leaf in fl:
+            bad = bad + jnp.sum((~jnp.isfinite(leaf)).astype(jnp.float32))
+        out[g] = bad / jnp.float32(n)
+    return out
+
+
+def tree_health(grads, params, updates=None, *, depth: int = 1,
+                prefix: str = "") -> Dict[str, jnp.ndarray]:
+    """The per-layer-group training vitals, as ``health/*`` metric columns:
+
+      * ``health/grad_norm/<g>``      — L2 of this step's gradients
+      * ``health/param_norm/<g>``     — L2 of the POST-update params (reading
+        the fresh output buffers, never the donated inputs, so the step's
+        donation aliasing is untouched — the graftir donation audit pins
+        aliased == donated on every trainer)
+      * ``health/update_ratio/<g>``   — |update| / |param|, the effective
+        step size the optimizer actually took (lr × adapted moments), the
+        canonical "is training moving / thrashing" signal
+      * ``health/nonfinite_frac/<g>`` — inf/nan fraction of the gradients
+    """
+    metrics: Dict[str, jnp.ndarray] = {}
+    for g, v in group_norms(grads, depth, prefix).items():
+        metrics[f"{HEALTH_PREFIX}grad_norm/{g}"] = v
+    pnorms = group_norms(params, depth, prefix)
+    for g, v in pnorms.items():
+        metrics[f"{HEALTH_PREFIX}param_norm/{g}"] = v
+    if updates is not None:
+        for g, v in group_norms(updates, depth, prefix).items():
+            pn = pnorms.get(g)
+            if pn is not None:
+                metrics[f"{HEALTH_PREFIX}update_ratio/{g}"] = v / (pn + 1e-12)
+    for g, v in nonfinite_fractions(grads, depth, prefix).items():
+        metrics[f"{HEALTH_PREFIX}nonfinite_frac/{g}"] = v
+    return metrics
+
+
+def codebook_health(indices, num_tokens: int,
+                    prefix: str = "codebook") -> Dict[str, jnp.ndarray]:
+    """Codebook-usage vitals from the quantizer's token indices (any int
+    shape; one batch's histogram):
+
+      * ``health/<p>_perplexity`` — exp(entropy of the usage distribution):
+        ``num_tokens`` when usage is uniform, → 1.0 as the codebook
+        collapses onto a few codes (the legacy train_vae wandb histogram,
+        reduced to one scalar that a detector can threshold)
+      * ``health/<p>_dead_frac``  — fraction of codes unused in this batch
+      * ``health/<p>_usage_entropy`` — the raw entropy (nats)
+
+    Reduced on device: shipping the raw (num_tokens,) histogram through the
+    metrics JSONL would be 8192 columns per record; three scalars carry the
+    collapse signal at zero marginal sync cost."""
+    idx = indices.reshape(-1)
+    counts = jnp.zeros((num_tokens,), jnp.float32).at[idx].add(1.0)
+    p = counts / jnp.float32(idx.shape[0])
+    ent = -jnp.sum(jnp.where(p > 0, p * jnp.log(jnp.where(p > 0, p, 1.0)),
+                             0.0))
+    return {
+        f"{HEALTH_PREFIX}{prefix}_perplexity": jnp.exp(ent),
+        f"{HEALTH_PREFIX}{prefix}_dead_frac": jnp.mean(
+            (counts == 0).astype(jnp.float32)),
+        f"{HEALTH_PREFIX}{prefix}_usage_entropy": ent,
+    }
+
+
+def gumbel_health(logits, one_hot, temp) -> Dict[str, jnp.ndarray]:
+    """Gumbel/straight-through vitals for the relaxed quantizers:
+
+      * ``health/gumbel_temp``        — the live annealed temperature
+      * ``health/st_sharpness``       — mean max of the (relaxed) one-hot
+        the decoder consumed: ≈1 when straight-through/hard, the softmax
+        peakiness when soft — a sagging value means the decoder is being
+        fed mush while the anneal says otherwise
+      * ``health/encoder_confidence`` — mean max softmax prob of the raw
+        encoder logits (temperature-free): low = the encoder itself has no
+        opinion, the upstream cause of collapse
+    """
+    l32 = logits.astype(jnp.float32)
+    probs = jax.nn.softmax(l32, axis=-1)
+    return {
+        f"{HEALTH_PREFIX}gumbel_temp": jnp.asarray(temp, jnp.float32),
+        f"{HEALTH_PREFIX}st_sharpness": jnp.mean(
+            jnp.max(one_hot.astype(jnp.float32), axis=-1)),
+        f"{HEALTH_PREFIX}encoder_confidence": jnp.mean(
+            jnp.max(probs, axis=-1)),
+    }
+
+
+def decode_quality(logits, topk: int = 32) -> Dict[str, jnp.ndarray]:
+    """Per-row decode-quality stats from next-token logits already on
+    device in the serve engine step (``(B, V)`` → ``(B,)`` each):
+
+      * ``entropy``   — nats of the next-token distribution; a healthy
+        image-token field sits well above 0, a degenerate sampler pins
+        near it
+      * ``topk_mass`` — probability mass of the top-``topk`` tokens; → 1.0
+        as the distribution narrows
+
+    f32 throughout (bf16/int8w serve paths emit bf16 logits). These feed
+    the engine's per-request quality span args and the aggregate
+    ``dalle_health_decode_*`` gauges — sampling is untouched (no rng
+    consumed), so per-request token bit-exactness holds with the taps on."""
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    p = jnp.exp(lp)
+    ent = -jnp.sum(p * lp, axis=-1)
+    k = min(int(topk), logits.shape[-1])
+    top = jax.lax.top_k(p, k)[0]
+    return {"entropy": ent, "topk_mass": jnp.sum(top, axis=-1)}

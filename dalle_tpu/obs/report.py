@@ -45,6 +45,16 @@ def percentile(sorted_vals: List[float], q: float) -> float:
     return sorted_vals[i]
 
 
+def fmt_num(v, spec: str = ".4g", suffix: str = "") -> str:
+    """Render a stat or ``n/a`` — a run with zero completed requests /
+    zero steps yields empty sample lists whose percentiles are NaN, and a
+    report that prints ``nan`` rates reads like a bug in the report. Every
+    formatted stat below routes through this guard."""
+    if v is None or (isinstance(v, float) and not math.isfinite(v)):
+        return "n/a"
+    return f"{v:{spec}}{suffix}"
+
+
 def ascii_histogram(vals: List[float], bins: int = 10, width: int = 40,
                     unit: str = "s") -> List[str]:
     """Fixed-width ASCII histogram lines (empty input → one 'no data' line)."""
@@ -217,6 +227,56 @@ def slo_accounting(metrics: List[dict]) -> Optional[dict]:
             "budget": last.get("slo.error_budget")}
 
 
+def health_accounting(metrics: List[dict]) -> Optional[dict]:
+    """graftpulse MODEL-HEALTH verdict inputs from the ``health/*`` columns
+    the jitted taps emit and the breach columns the anomaly sentry merges
+    in (obs/health.py, obs/anomaly.py). ``None`` when no record carries a
+    health column — untapped runs keep their report unchanged.
+
+    The verdict: DEGRADED when any sentry breach was recorded — named with
+    the offending detector and layer group — else ok. Alongside it, the
+    current operating point: the worst grad-norm group, the latest codebook
+    perplexity (+ dead-code fraction), and how many taps were live."""
+    h_rows = [r for r in metrics
+              if any(k.startswith("health/") for k in r)]
+    if not h_rows:
+        return None
+    cols = set()
+    breaches = 0
+    detector = group = None
+    for r in h_rows:
+        cols.update(k for k in r if k.startswith("health/"))
+        b = r.get("health/breach")
+        if b:
+            breaches += int(b)
+            detector = r.get("health/breach_detector", detector)
+            group = r.get("health/breach_group", group)
+    last = h_rows[-1]
+    worst_grad = None
+    for k, v in last.items():
+        if k.startswith("health/grad_norm/") and isinstance(v, (int, float)):
+            g = k[len("health/grad_norm/"):]
+            if worst_grad is None or v > worst_grad[1]:
+                worst_grad = (g, float(v))
+    # newest perplexity reading across rows (the save cadence may skip it
+    # on the final record)
+    perp = dead = None
+    for r in reversed(h_rows):
+        for k, v in r.items():
+            if k.endswith("_perplexity") and k.startswith("health/") \
+                    and isinstance(v, (int, float)):
+                perp = float(v)
+                dead = r.get(k.replace("_perplexity", "_dead_frac"))
+                break
+        if perp is not None:
+            break
+    return {"taps": len(cols), "records": len(h_rows),
+            "breaches": breaches, "detector": detector, "group": group,
+            "worst_grad": worst_grad, "perplexity": perp,
+            "dead_frac": dead,
+            "verdict": "DEGRADED" if breaches else "ok"}
+
+
 def gateway_accounting(metrics: List[dict],
                        spans: List[dict]) -> Optional[dict]:
     """Gateway admission/serving health from the obs registry snapshot the
@@ -282,8 +342,15 @@ def format_report(rows: List[dict], *, topk: int = 10) -> str:
                         f"split out below" if ckpt_steps else "") + ")")
         if st:
             ss = sorted(st)
-            lines.append(f"  min={ss[0]:.4g}s p50={percentile(ss, .5):.4g}s "
-                         f"p99={percentile(ss, .99):.4g}s max={ss[-1]:.4g}s")
+            lines.append(
+                f"  min={fmt_num(ss[0], suffix='s')} "
+                f"p50={fmt_num(percentile(ss, .5), suffix='s')} "
+                f"p99={fmt_num(percentile(ss, .99), suffix='s')} "
+                f"max={fmt_num(ss[-1], suffix='s')}")
+        else:
+            # zero steps (e.g. a serve-only or empty-metrics run): say so
+            # instead of histogramming nothing into NaN stats
+            lines.append("  (no step samples — n/a)")
         lines.extend(ascii_histogram(st))
         if ckpt_steps:
             cs = sorted(ckpt_steps)
@@ -344,9 +411,8 @@ def format_report(rows: List[dict], *, topk: int = 10) -> str:
                 + (f" shed={gw['shed']:.0f}" if gw["shed"] else "")
                 + (f" failovers={gw['failovers']:.0f}" if gw["failovers"]
                    else "")
-                + (f"; queue wait p50={gw['qwait_p50_s']:.4g}s "
-                   f"p95={gw['qwait_p95_s']:.4g}s"
-                   if gw["qwait_p50_s"] is not None else "")
+                + f"; queue wait p50={fmt_num(gw['qwait_p50_s'], suffix='s')}"
+                  f" p95={fmt_num(gw['qwait_p95_s'], suffix='s')}"
                 + f" → {gw['verdict']}")
         slo = slo_accounting(metrics)
         if slo is not None:
@@ -357,6 +423,24 @@ def format_report(rows: List[dict], *, topk: int = 10) -> str:
                 f"== slo burn rate: {wtxt} → "
                 + (f"BURNING (dominating window {slo['dominating']})"
                    if slo["burning"] else "ok"))
+        hl = health_accounting(metrics)
+        if hl is not None:
+            parts = [f"{hl['taps']} taps over {hl['records']} records"]
+            if hl["worst_grad"] is not None:
+                parts.append(f"worst grad_norm {hl['worst_grad'][0]}="
+                             f"{fmt_num(hl['worst_grad'][1])}")
+            if hl["perplexity"] is not None:
+                dtxt = (f" (dead {hl['dead_frac']:.0%})"
+                        if isinstance(hl["dead_frac"], (int, float)) else "")
+                parts.append(
+                    f"codebook perplexity {fmt_num(hl['perplexity'])}{dtxt}")
+            verdict = ("MODEL-HEALTH: DEGRADED "
+                       f"({hl['detector']} in {hl['group']}; "
+                       f"{hl['breaches']} breach"
+                       f"{'es' if hl['breaches'] != 1 else ''})"
+                       if hl["verdict"] == "DEGRADED" else "MODEL-HEALTH: ok")
+            lines.append("== model health (graftpulse): "
+                         + ", ".join(parts) + f" → {verdict}")
     if spans:
         lines.append(f"== spans by total time ({len(spans)} spans)")
         lines.append(f"  {'name':<32}{'count':>7}{'total_s':>10}{'mean_s':>10}"

@@ -42,6 +42,11 @@ class VQOutput(NamedTuple):
     quantized: jnp.ndarray   # same shape as input z
     indices: jnp.ndarray     # int32 codebook indices
     loss: jnp.ndarray        # codebook + commitment loss (scalar)
+    # gumbel path only: the softmax over codebook logits the relaxation
+    # sampled from — graftpulse reads straight-through sharpness and
+    # encoder confidence off it without a recompute (None for the hard VQ
+    # path, whose assignment has no distribution)
+    probs: Optional[jnp.ndarray] = None
 
 
 def vector_quantize(z: jnp.ndarray, codebook: jnp.ndarray, beta: float = 0.25) -> VQOutput:
@@ -79,7 +84,7 @@ def gumbel_quantize(key: jax.Array, logits: jnp.ndarray, codebook: jnp.ndarray,
     probs = jax.nn.softmax(logits, axis=-1)
     kl = kl_weight * jnp.mean(jnp.sum(probs * jnp.log(probs * n + 1e-10), axis=-1))
     idx = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return VQOutput(zq, idx, kl)
+    return VQOutput(zq, idx, kl, probs)
 
 
 def remap_indices(idx: jnp.ndarray, used, unknown="random",

@@ -91,13 +91,14 @@ _PROGRAMS: Dict[int, Dict[tuple, tuple]] = {}
 # _multi_step) read off self — the stand-in carries exactly these
 _PROGRAM_ATTRS = ("model", "use_kernel", "cache_dtype", "n_steps",
                   "filter_thres", "temperature", "topk_approx",
-                  "num_text_tokens", "prefix_len", "park", "steps_per_sync")
+                  "num_text_tokens", "prefix_len", "park", "steps_per_sync",
+                  "decode_health")
 
 
 def _program_key(eng: "DecodeEngine") -> tuple:
     return (eng.slots, np.dtype(eng.cache_dtype).name, eng.filter_thres,
             eng.temperature, eng.topk_approx, eng.steps_per_sync,
-            eng.use_kernel)
+            eng.use_kernel, eng.decode_health)
 
 
 def _shared_programs(eng: "DecodeEngine") -> tuple:
@@ -142,7 +143,8 @@ class DecodeEngine:
     def __init__(self, model: DALLE, params, *, slots: int,
                  cache_dtype=jnp.float32, filter_thres: float = 0.5,
                  temperature: float = 1.0, topk_approx: bool = False,
-                 steps_per_sync: int = 1, use_kernel=None):
+                 steps_per_sync: int = 1, use_kernel=None,
+                 decode_health: bool = False):
         c = model.cfg
         attn_types = tuple(c.attn_types) or ("full",)
         if any(t != "full" for t in attn_types) or c.shift_tokens:
@@ -161,6 +163,13 @@ class DecodeEngine:
         self.temperature = temperature
         self.topk_approx = topk_approx
         self.use_kernel = use_kernel
+        # graftpulse decode-quality taps (obs/health.py): per-row token
+        # entropy + top-k mass computed IN the jitted step from the logits
+        # already on device, fetched in the same host sync as the tokens —
+        # zero added syncs, sampling untouched (no rng consumed), so the
+        # per-request bit-exactness contract holds with the taps on.
+        # Program-shaping (rides _program_key and the AOT fingerprint).
+        self.decode_health = bool(decode_health)
 
         self.text_seq_len = c.text_seq_len
         self.prefix_len = c.text_seq_len + 1          # <bos> + text
@@ -306,8 +315,15 @@ class DecodeEngine:
         uses_fold = final & (n_row == n_steps)
         sample_key = jnp.where(uses_fold[:, None], fin_key, sub)
 
-        tok = gumbel_sample_rows(sample_key,
-                                 logits[:, self.num_text_tokens:],
+        img_logits = logits[:, self.num_text_tokens:]
+        stats = {}
+        if self.decode_health:
+            # per-row quality of the distribution being sampled FROM (the
+            # pre-gumbel logits): entropy + top-k mass, (B,) f32 each —
+            # fetched with the tokens at the same sync
+            from ..obs.health import decode_quality
+            stats = decode_quality(img_logits)
+        tok = gumbel_sample_rows(sample_key, img_logits,
                                  thres=self.filter_thres,
                                  temperature=self.temperature,
                                  approx=self.topk_approx)
@@ -328,21 +344,24 @@ class DecodeEngine:
             "n_row": n_row,
             "active": decode_rows,
         }
-        return tok, finished, state
+        return tok, finished, stats, state
 
     def _multi_step(self, params, state):
-        """steps_per_sync × _step in one program; (K, B) tokens/finished."""
+        """steps_per_sync × _step in one program; (K, B) tokens/finished
+        (+ (K, B) decode-quality stats when ``decode_health`` — an empty
+        dict otherwise, so the program signature is stable)."""
         if self.steps_per_sync == 1:
-            tok, finished, state = self._step(params, state)
-            return tok[None], finished[None], state
+            tok, finished, stats, state = self._step(params, state)
+            return (tok[None], finished[None],
+                    jax.tree.map(lambda x: x[None], stats), state)
 
         def body(carry, _):
-            tok, finished, carry = self._step(params, carry)
-            return carry, (tok, finished)
+            tok, finished, stats, carry = self._step(params, carry)
+            return carry, (tok, finished, stats)
 
-        state, (toks, fins) = jax.lax.scan(body, state, None,
-                                           length=self.steps_per_sync)
-        return toks, fins, state
+        state, (toks, fins, stats) = jax.lax.scan(body, state, None,
+                                                  length=self.steps_per_sync)
+        return toks, fins, stats, state
 
     # -- host loop ---------------------------------------------------------
     def _pad_text(self, text: np.ndarray) -> np.ndarray:
@@ -390,6 +409,9 @@ class DecodeEngine:
         state = self._init_state()
         buffers: Dict[int, List[int]] = {}
         row_t0: Dict[int, float] = {}      # per-slot start of the open row
+        # per-slot decode-quality accumulators [Σentropy, Σtopk_mass, n]
+        # (decode_health only; reset at admission, reduced at completion)
+        qual: Dict[int, List[float]] = {}
         completed: List[CompletedRequest] = []
         self.stats = EngineStats()
 
@@ -415,13 +437,13 @@ class DecodeEngine:
             f"serve.engine[{threading.current_thread().name}]",
             _engine_state)
         try:
-            return self._run(queue, sched, state, buffers, row_t0,
+            return self._run(queue, sched, state, buffers, row_t0, qual,
                              completed, max_steps=max_steps, poll_s=poll_s,
                              on_complete=on_complete, on_rows=on_rows)
         finally:
             unregister_state_provider(provider)
 
-    def _run(self, queue, sched, state, buffers, row_t0, completed, *,
+    def _run(self, queue, sched, state, buffers, row_t0, qual, completed, *,
              max_steps, poll_s, on_complete, on_rows):
         B = self.slots
         while not (queue.drained and not sched.any_active):
@@ -441,6 +463,7 @@ class DecodeEngine:
                     for slot, req in pairs:
                         req.admitted_at = now
                         buffers[slot] = []
+                        qual[slot] = [0.0, 0.0, 0]
                         # queue wait as its own span (admission SLO input:
                         # TTFT = queue wait + prefill + first step) + gauge
                         record_span("serve/request_queue_wait",
@@ -513,9 +536,13 @@ class DecodeEngine:
             if backlog:
                 self.stats.sample_occupancy(sched.occupancy)
 
-            toks, fins, state = self._step_fn(self.params, state)
+            toks, fins, qstats, state = self._step_fn(self.params, state)
             toks = np.asarray(toks)               # (K, B)
             fins = np.asarray(fins)
+            # decode-quality stats ride the SAME host sync as the tokens
+            # (empty dict when decode_health is off)
+            q_ent = np.asarray(qstats["entropy"]) if qstats else None
+            q_mass = np.asarray(qstats["topk_mass"]) if qstats else None
             now = time.perf_counter()
             for k in range(toks.shape[0]):
                 active = sched.active_slots()
@@ -527,6 +554,11 @@ class DecodeEngine:
                         req.first_token_at = now
                     buf = buffers[slot]
                     buf.append(int(toks[k, slot]))
+                    if q_ent is not None:
+                        acc = qual.setdefault(slot, [0.0, 0.0, 0])
+                        acc[0] += float(q_ent[k, slot])
+                        acc[1] += float(q_mass[k, slot])
+                        acc[2] += 1
                     if len(buf) % self.row_len == 0:
                         row = len(buf) // self.row_len - 1
                         # one committed grid row = one timeline segment
@@ -570,6 +602,28 @@ class DecodeEngine:
                         on_complete(cr)
                     else:
                         completed.append(cr)
+                    # per-request decode quality (graftpulse): means of the
+                    # in-jit entropy/top-k taps plus the host-side
+                    # repeated-token ratio. Per-request values travel as
+                    # SPAN ARGS tagged with the trace_id (bounded ring) and
+                    # as unlabeled aggregate gauges — never as metric
+                    # labels, which would be unbounded Prometheus
+                    # cardinality (graftlint: unbounded-metric-label)
+                    q_args = {}
+                    acc = qual.pop(slot, None)
+                    if acc is not None and acc[2] > 0:
+                        t = cr.tokens
+                        rep = (float(np.mean(t[1:] == t[:-1]))
+                               if t.shape[0] > 1 else 0.0)
+                        q_args = {"entropy": round(acc[0] / acc[2], 4),
+                                  "topk_mass": round(acc[1] / acc[2], 4),
+                                  "repeat_ratio": round(rep, 4)}
+                        gauge_set("health.decode_entropy", acc[0] / acc[2])
+                        gauge_set("health.decode_topk_mass", acc[1] / acc[2])
+                        gauge_set("health.decode_repeat_ratio", rep)
+                        record_event("decode_quality",
+                                     request_id=req.request_id,
+                                     trace_id=req.trace_id, **q_args)
                     # retrospective spans: requests overlap, so the
                     # stack-based span() contract cannot hold — see
                     # obs.record_span
@@ -577,7 +631,7 @@ class DecodeEngine:
                                 now - req.admitted_at,
                                 request_id=req.request_id,
                                 trace_id=req.trace_id,
-                                tokens=int(cr.tokens.shape[0]))
+                                tokens=int(cr.tokens.shape[0]), **q_args)
                     record_span("serve/request_ttft", req.submitted_at,
                                 cr.ttft_s, request_id=req.request_id,
                                 trace_id=req.trace_id)

@@ -20,6 +20,15 @@ from ..obs import configure as obs_configure
 from .checkpoints import CheckpointManager
 
 
+def _fmt_metrics(m: dict) -> str:
+    """One-line metric rendering for fit()'s log: numbers get %.5g, the
+    graftpulse breach columns (strings: detector/group names) print as-is."""
+    return " ".join(
+        f"{k}={v:.5g}" if isinstance(v, (int, float))
+        and not isinstance(v, bool) else f"{k}={v}"
+        for k, v in m.items())
+
+
 @jax.jit
 def _tree_copy(t):
     """Bit-exact on-device copy with FRESH buffers: ``jnp.copy`` is never
@@ -45,6 +54,10 @@ class BaseTrainer:
     _deferred_metrics = None
     _obs_last_h2d = 0.0
     _obs_last_ckpt = 0.0
+    # graftpulse (obs/anomaly.py): built by fit() when ObsConfig.health is
+    # set; every fetched metrics dict passes through _health_observe once
+    health_sentry = None
+    _health_last_step = -1
 
     def __init__(self, train_cfg: TrainConfig, mesh=None, backend=None):
         self.train_cfg = train_cfg
@@ -132,6 +145,18 @@ class BaseTrainer:
         rep = self.meter.step(self._host_step)
         if rep:
             metrics.update(rep)
+        return self._health_observe(self._host_step, metrics)
+
+    def _health_observe(self, step: int, metrics: dict) -> dict:
+        """Run the graftpulse sentry over one FETCHED metrics dict (host
+        floats) exactly once per metrics step — every path that finalizes a
+        record (in-band, deferred-consumed, save-boundary fetch, flushes)
+        routes through here. Mutates ``metrics`` with breach columns."""
+        sentry = self.health_sentry
+        if sentry is None or not metrics or step == self._health_last_step:
+            return metrics
+        self._health_last_step = step
+        sentry.observe(step, metrics)
         return metrics
 
     def _put(self, x, dtype=None, stacked: bool = False):
@@ -255,6 +280,15 @@ class BaseTrainer:
                 oc.watchdog_deadline_s, log=log,
                 dump_stacks=oc.watchdog_dump_stacks).start()
             self.last_watchdog = watchdog
+        if (oc is not None and getattr(oc, "health", False)
+                and self.health_sentry is None):
+            # graftpulse sentry: watches the health/* columns the jitted
+            # step now emits (trainers pass obs.health into their step-body
+            # factories); breaches fire gauges/events/flight bundles and
+            # annotate the record obs_report's MODEL-HEALTH verdict reads.
+            # Kept across fit() calls so EMA baselines survive resume.
+            from ..obs.anomaly import HealthSentry
+            self.health_sentry = HealthSentry.from_obs_config(oc)
         scan_k = max(getattr(tc, "scan_steps", 1), 1)
         if scan_k > 1:
             assert hasattr(self, "train_steps"), (
@@ -354,6 +388,7 @@ class BaseTrainer:
                                 dnow = time.perf_counter()
                                 dpart["t_sync_s"] = dnow - dsync0
                                 dm.update(self._finish_breakdown(dpart, dnow))
+                            self._health_observe(dstep, dm)
                             if metrics_writer is not None:
                                 metrics_writer.log(dstep, dm)
                         m = self._fetch_pending_metrics()
@@ -365,8 +400,7 @@ class BaseTrainer:
                         self._rollback()
                     else:
                         if m and crossed(prev_step, step_num, tc.log_every):
-                            log(f"[step {mstep}] " +
-                                " ".join(f"{k}={v:.5g}" for k, v in m.items()))
+                            log(f"[step {mstep}] " + _fmt_metrics(m))
                         if m and metrics_writer is not None:
                             metrics_writer.log(mstep, m)
                         if want_save:
@@ -420,8 +454,8 @@ class BaseTrainer:
                         fnow = time.perf_counter()
                         fpart["t_sync_s"] = fnow - fsync0
                         fm.update(self._finish_breakdown(fpart, fnow))
-                    log(f"[step {fstep}] " +
-                        " ".join(f"{k}={v:.5g}" for k, v in fm.items()))
+                    self._health_observe(fstep, fm)
+                    log(f"[step {fstep}] " + _fmt_metrics(fm))
                     if metrics_writer is not None:
                         metrics_writer.log(fstep, fm)
                 except Exception:  # noqa: BLE001 - the flush is best-effort:
@@ -585,6 +619,7 @@ class BaseTrainer:
                 metrics.update(self._finish_breakdown(part, now))
         else:
             metrics.update(self._step_breakdown(sync0, now))
+        self._health_observe(step_of, metrics)
         if step_of != self._host_step:
             metrics["metrics_step"] = step_of
         return metrics

@@ -65,14 +65,21 @@ class TrainState:
         return cls(step=jnp.zeros((), jnp.int32), params=params,
                    opt_state=tx.init(params), apply_fn=apply_fn, tx=tx)
 
-    def apply_gradients(self, grads, **extra_args):
+    def apply_gradients(self, grads, return_updates: bool = False,
+                        **extra_args):
         """``extra_args`` feed GradientTransformationExtraArgs members of the
         chain — e.g. ``value=loss`` drives the plateau schedule; plain
-        transforms ignore them (the tx is wrapped with extra-args support)."""
+        transforms ignore them (the tx is wrapped with extra-args support).
+        ``return_updates=True`` additionally returns the optimizer's update
+        tree (the graftpulse health taps derive per-layer-group update
+        ratios from it without recomputing ``new - old`` params, which
+        would read the donated input buffers)."""
         updates, opt_state = self.tx.update(grads, self.opt_state, self.params,
                                             **extra_args)
         params = optax.apply_updates(self.params, updates)
-        return self.replace(step=self.step + 1, params=params, opt_state=opt_state)
+        new = self.replace(step=self.step + 1, params=params,
+                           opt_state=opt_state)
+        return (new, updates) if return_updates else new
 
 
 def make_lr_schedule(cfg: OptimConfig):

@@ -26,9 +26,12 @@ from .train_state import (TrainState, cast_floating, compute_dtype,
 
 
 @functools.lru_cache(maxsize=64)
-def _clip_step_body(model: CLIP, dtype=None):
-    # memoized on (model-config, dtype) so equal-config trainers hand
-    # jit_step the SAME body object and share one jitted wrapper
+def _clip_step_body(model: CLIP, dtype=None, health: bool = False,
+                    health_depth: int = 1):
+    # memoized on (model-config, dtype, health wiring) so equal-config
+    # trainers hand jit_step the SAME body object and share one jitted
+    # wrapper. ``health`` fuses the graftpulse per-layer-group taps
+    # (obs/health.py) into the program.
     def loss_fn(params, text, images):
         x = images if dtype is None else images.astype(dtype)
         return model.apply(cast_floating(params, dtype), text, x,
@@ -36,23 +39,35 @@ def _clip_step_body(model: CLIP, dtype=None):
 
     def step(state: TrainState, text, images):
         loss, grads = jax.value_and_grad(loss_fn)(state.params, text, images)
-        state = state.apply_gradients(grads, value=loss)
-        return state, {"loss": loss, "grad_norm": optax.global_norm(grads)}
+        metrics = {"loss": loss, "grad_norm": optax.global_norm(grads)}
+        if health:
+            from ..obs.health import tree_health
+            state, updates = state.apply_gradients(grads, value=loss,
+                                                   return_updates=True)
+            metrics.update(tree_health(grads, state.params, updates,
+                                       depth=health_depth))
+        else:
+            state = state.apply_gradients(grads, value=loss)
+        return state, metrics
 
     return step
 
 
-def make_clip_train_step(model: CLIP, dtype=None, state=None):
+def make_clip_train_step(model: CLIP, dtype=None, state=None,
+                         health: bool = False, health_depth: int = 1):
     """Returns step(state, text, images) -> (state, metrics). ``state`` pins
     the output state's shardings (train_state.jit_step)."""
-    return jit_step(_clip_step_body(model, dtype), state)
+    return jit_step(_clip_step_body(model, dtype, health, health_depth),
+                    state)
 
 
-def make_clip_train_multi_step(model: CLIP, dtype=None):
+def make_clip_train_multi_step(model: CLIP, dtype=None, health: bool = False,
+                               health_depth: int = 1):
     """k steps per dispatch over stacked (texts, imagess) —
     train_state.make_scanned_steps over the identical step body."""
     from .train_state import make_scanned_steps
-    return make_scanned_steps(_clip_step_body(model, dtype))
+    return make_scanned_steps(_clip_step_body(model, dtype, health,
+                                              health_depth))
 
 
 class CLIPTrainer(BaseTrainer):
@@ -67,9 +82,12 @@ class CLIPTrainer(BaseTrainer):
         tx = make_optimizer(train_cfg.optim)
         self.state = commit_to_mesh(self.mesh, TrainState.create(
             apply_fn=self.model.apply, params=params, tx=tx))
+        self._health_kw = dict(
+            health=bool(train_cfg.obs.health),
+            health_depth=train_cfg.obs.health_group_depth)
         self.step_fn = make_clip_train_step(
             self.model, dtype=compute_dtype(train_cfg.precision),
-            state=self.state)
+            state=self.state, **self._health_kw)
         self._multi_step_fn = None   # built lazily on first train_steps()
         n = count_params(self.state.params)
         self.num_params = n
@@ -103,7 +121,8 @@ class CLIPTrainer(BaseTrainer):
             "train_steps wants stacked (k, b, seq) / (k, b, H, W, C)")
         if self._multi_step_fn is None:
             self._multi_step_fn = make_clip_train_multi_step(
-                self.model, dtype=compute_dtype(self.train_cfg.precision))
+                self.model, dtype=compute_dtype(self.train_cfg.precision),
+                **self._health_kw)
         k = texts.shape[0]
         with span("clip/shard_batch", k=k):
             texts, imagess = self._put_batch((texts, imagess), stacked=True)

@@ -54,24 +54,36 @@ def _make_dalle_loss_fn(model: DALLE, *, null_cond_prob: float,
 
 @functools.lru_cache(maxsize=64)
 def _dalle_step_body(model: DALLE, *, null_cond_prob: float = 0.0,
-                     use_dropout: bool = False, dtype=None):
-    # memoized on (model-config, rng wiring, dtype) so equal-config trainers
-    # hand jit_step the SAME body object and share one jitted wrapper
+                     use_dropout: bool = False, dtype=None,
+                     health: bool = False, health_depth: int = 1):
+    # memoized on (model-config, rng wiring, dtype, health wiring) so
+    # equal-config trainers hand jit_step the SAME body object and share one
+    # jitted wrapper. ``health`` fuses the graftpulse per-layer-group taps
+    # (obs/health.py) into the program — scalars in the metrics dict, zero
+    # added host syncs.
     loss_fn = _make_dalle_loss_fn(model, null_cond_prob=null_cond_prob,
                                   use_dropout=use_dropout, dtype=dtype)
 
     def step(state: TrainState, text, image_ids, key):
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, text, image_ids, key)
-        new_state = state.apply_gradients(grads, value=loss)
         metrics = {"loss": loss, "grad_norm": optax.global_norm(grads), **aux}
+        if health:
+            from ..obs.health import tree_health
+            new_state, updates = state.apply_gradients(grads, value=loss,
+                                                       return_updates=True)
+            metrics.update(tree_health(grads, new_state.params, updates,
+                                       depth=health_depth))
+        else:
+            new_state = state.apply_gradients(grads, value=loss)
         return new_state, metrics
 
     return step
 
 
 def make_dalle_train_step(model: DALLE, *, null_cond_prob: float = 0.0,
-                          use_dropout: bool = False, dtype=None, state=None):
+                          use_dropout: bool = False, dtype=None, state=None,
+                          health: bool = False, health_depth: int = 1):
     """Returns step(state, text, image_ids, key) -> (state, metrics). jit-once
     (the (body, shardings)-memoized train_state.jit_step) with the state
     donated; ``null_cond_prob``/``use_dropout`` are compile-time (they select
@@ -80,13 +92,16 @@ def make_dalle_train_step(model: DALLE, *, null_cond_prob: float = 0.0,
     cast inside the step, master copies stay f32 — the TPU-native replacement
     for the DeepSpeed fp16 engine (SURVEY.md §2.9 Apex AMP row)."""
     return jit_step(_dalle_step_body(model, null_cond_prob=null_cond_prob,
-                                     use_dropout=use_dropout, dtype=dtype),
+                                     use_dropout=use_dropout, dtype=dtype,
+                                     health=health,
+                                     health_depth=health_depth),
                     state)
 
 
 @functools.lru_cache(maxsize=64)
 def make_dalle_train_multi_step(model: DALLE, *, null_cond_prob: float = 0.0,
-                                use_dropout: bool = False, dtype=None):
+                                use_dropout: bool = False, dtype=None,
+                                health: bool = False, health_depth: int = 1):
     """k optimizer steps in ONE device program: ``lax.scan`` over the step
     body consuming a (k, b, ...) microbatch stack. Per-dispatch host overhead
     (20ms-class through remote-device tunnels) amortizes over k steps, and
@@ -105,9 +120,17 @@ def make_dalle_train_multi_step(model: DALLE, *, null_cond_prob: float = 0.0,
             text, ids, key = xs
             (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 state.params, text, ids, key)
-            new_state = state.apply_gradients(grads, value=loss)
-            return new_state, {"loss": loss,
-                               "grad_norm": optax.global_norm(grads), **aux}
+            metrics = {"loss": loss,
+                       "grad_norm": optax.global_norm(grads), **aux}
+            if health:
+                from ..obs.health import tree_health
+                new_state, updates = state.apply_gradients(
+                    grads, value=loss, return_updates=True)
+                metrics.update(tree_health(grads, new_state.params, updates,
+                                           depth=health_depth))
+            else:
+                new_state = state.apply_gradients(grads, value=loss)
+            return new_state, metrics
 
         state, ms = jax.lax.scan(body, state, (texts, image_ids, keys))
         metrics = jax.tree.map(lambda x: x[-1], ms)   # last step's metrics
@@ -147,10 +170,14 @@ class DalleTrainer(BaseTrainer):
         use_dropout = (model_cfg.attn_dropout > 0 or model_cfg.ff_dropout > 0)
         self.step_fn = make_dalle_train_step(
             self.model, null_cond_prob=null_cond_prob, use_dropout=use_dropout,
-            dtype=compute_dtype(train_cfg.precision), state=self.state)
+            dtype=compute_dtype(train_cfg.precision), state=self.state,
+            health=bool(train_cfg.obs.health),
+            health_depth=train_cfg.obs.health_group_depth)
         self._multi_step_kw = dict(null_cond_prob=null_cond_prob,
                                    use_dropout=use_dropout,
-                                   dtype=compute_dtype(train_cfg.precision))
+                                   dtype=compute_dtype(train_cfg.precision),
+                                   health=bool(train_cfg.obs.health),
+                                   health_depth=train_cfg.obs.health_group_depth)
         self._multi_step_fn = None   # built lazily on first train_steps()
 
         n = count_params(self.state.params)

@@ -41,42 +41,65 @@ def anneal_temperature(cfg: AnnealConfig, global_step: int) -> float:
 
 
 @functools.lru_cache(maxsize=64)
-def _vae_step_body(model: DiscreteVAE, dtype=None):
-    # memoized on (model-config, dtype) so equal-config trainers hand
-    # jit_step the SAME body object and share one jitted wrapper
+def _vae_step_body(model: DiscreteVAE, dtype=None, health: bool = False,
+                   health_depth: int = 1):
+    # memoized on (model-config, dtype, health wiring) so equal-config
+    # trainers hand jit_step the SAME body object and share one jitted
+    # wrapper. ``health`` fuses the graftpulse taps (obs/health.py) into the
+    # program: the dVAE's codebook/gumbel vitals ride the loss aux, the
+    # per-layer-group grad/param/update stats reduce in the same step — all
+    # scalars in the metrics dict, zero added host syncs.
     def loss_fn(params, images, key, temp):
         if dtype is not None:
             images = images.astype(dtype)
-        loss, recons = model.apply(
+        out = model.apply(
             cast_floating(params, dtype), images, temp=temp, return_loss=True,
-            return_recons=True, rngs={"gumbel": key})
-        return loss, recons
+            return_recons=True, return_health=health, rngs={"gumbel": key})
+        if health:
+            loss, _recons, hm = out
+            return loss, hm
+        loss, _recons = out
+        return loss, None
 
     def step(state: TrainState, images, key, temp):
-        (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        (loss, hm), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, images, key, temp)
-        state = state.apply_gradients(grads, value=loss)
-        return state, {"loss": loss, "grad_norm": optax.global_norm(grads)}
+        metrics = {"loss": loss, "grad_norm": optax.global_norm(grads)}
+        if health:
+            from ..obs.health import tree_health
+            state, updates = state.apply_gradients(grads, value=loss,
+                                                   return_updates=True)
+            metrics.update(hm)
+            metrics.update(tree_health(grads, state.params, updates,
+                                       depth=health_depth))
+        else:
+            state = state.apply_gradients(grads, value=loss)
+        return state, metrics
 
     return step
 
 
-def make_vae_train_step(model: DiscreteVAE, dtype=None, state=None):
+def make_vae_train_step(model: DiscreteVAE, dtype=None, state=None,
+                        health: bool = False, health_depth: int = 1):
     """Returns step(state, images, key, temp) -> (state, metrics). jit-once
     (the (body, shardings)-memoized train_state.jit_step); the state is
     donated so params/moments update in place in HBM. ``state`` pins the
     output state's shardings to the input's — see jit_step. ``dtype``
-    selects the compute precision (params cast per-step; masters stay f32)."""
-    return jit_step(_vae_step_body(model, dtype), state)
+    selects the compute precision (params cast per-step; masters stay f32);
+    ``health`` fuses the graftpulse model-health taps into the program
+    (docs/OBSERVABILITY.md)."""
+    return jit_step(_vae_step_body(model, dtype, health, health_depth), state)
 
 
 @functools.lru_cache(maxsize=64)
-def make_vae_train_multi_step(model: DiscreteVAE, dtype=None):
+def make_vae_train_multi_step(model: DiscreteVAE, dtype=None,
+                              health: bool = False, health_depth: int = 1):
     """k steps per dispatch (train_state.make_scanned_steps) over stacked
     (images, keys, temps) — the identical step body, so with matching key and
     temperature streams the result equals k single dispatches."""
     from .train_state import make_scanned_steps
-    return make_scanned_steps(_vae_step_body(model, dtype))
+    return make_scanned_steps(_vae_step_body(model, dtype, health,
+                                             health_depth))
 
 
 @partial(jax.jit, static_argnums=1)
@@ -101,9 +124,12 @@ class VAETrainer(BaseTrainer):
         tx = make_optimizer(train_cfg.optim)
         self.state = commit_to_mesh(self.mesh, TrainState.create(
             apply_fn=self.model.apply, params=params, tx=tx))
+        self._health_kw = dict(
+            health=bool(train_cfg.obs.health),
+            health_depth=train_cfg.obs.health_group_depth)
         self.step_fn = make_vae_train_step(
             self.model, dtype=compute_dtype(train_cfg.precision),
-            state=self.state)
+            state=self.state, **self._health_kw)
         self._multi_step_fn = None   # built lazily on first train_steps()
 
         n = count_params(self.state.params)
@@ -144,7 +170,8 @@ class VAETrainer(BaseTrainer):
         assert images.ndim == 5, "train_steps wants stacked (k, b, H, W, C)"
         if self._multi_step_fn is None:
             self._multi_step_fn = make_vae_train_multi_step(
-                self.model, dtype=compute_dtype(self.train_cfg.precision))
+                self.model, dtype=compute_dtype(self.train_cfg.precision),
+                **self._health_kw)
         k = images.shape[0]
         steps = self._host_step + np.arange(k)
         keys = self._step_keys(k)

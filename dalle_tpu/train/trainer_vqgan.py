@@ -95,13 +95,18 @@ class GANTrainState:
 
 def make_vqgan_train_step(model: VQModel, disc: NLayerDiscriminator,
                           lpips: Optional[LPIPS], loss_cfg: GANLossConfig,
-                          dtype=None, scanned: bool = False, state=None):
+                          dtype=None, scanned: bool = False, state=None,
+                          health: bool = False, health_depth: int = 1):
     """Returns step(state, images, key, temp) -> (state, metrics) implementing
     both optimizer updates of vqperceptual.py:76-136 in one XLA program.
     ``state`` pins the output state's shardings to the input's
     (train_state.jit_step). ``scanned``: lift the same body into a
     k-steps-per-dispatch program over stacked (imagess, keys, temps)
-    (train_state.make_scanned_steps)."""
+    (train_state.make_scanned_steps). ``health`` fuses the graftpulse taps
+    (obs/health.py) into the program: codebook vitals from the quantizer's
+    own VQOutput plus per-layer-group grad/param/update stats for BOTH
+    optimizers (``gen/*`` and ``disc/*`` groups) — scalars in the metrics
+    dict, zero added host syncs."""
     lc = loss_cfg
     d_loss_fn = hinge_d_loss if lc.disc_loss == "hinge" else vanilla_d_loss
 
@@ -145,6 +150,9 @@ def make_vqgan_train_step(model: VQModel, disc: NLayerDiscriminator,
         aux = {"recon": recon, "nll_loss": nll, "g_loss": g_loss,
                "quant_loss": q.loss, "d_weight": d_weight,
                "disc_factor": disc_factor}
+        if health:
+            # codebook vitals from the encode's own VQOutput — no recompute
+            aux["health"] = model.health_taps(q, temp)
         return loss, aux
 
     def disc_loss_fn(disc_params, batch_stats, images, recon, step):
@@ -188,6 +196,15 @@ def make_vqgan_train_step(model: VQModel, disc: NLayerDiscriminator,
                    "g_loss": aux["g_loss"], "d_weight": aux["d_weight"],
                    "logits_real": d_aux["logits_real"],
                    "logits_fake": d_aux["logits_fake"]}
+        if health:
+            from ..obs.health import tree_health
+            metrics.update(aux["health"])
+            # POST-update params (fresh buffers — donation aliasing intact)
+            metrics.update(tree_health(gen_grads, gen_p, gen_updates,
+                                       depth=health_depth, prefix="gen"))
+            metrics.update(tree_health(disc_grads, disc_p["params"],
+                                       disc_updates, depth=health_depth,
+                                       prefix="disc"))
         return state, metrics
 
     if scanned:
@@ -198,32 +215,46 @@ def make_vqgan_train_step(model: VQModel, disc: NLayerDiscriminator,
 
 def make_vq_simple_train_step(model: VQModel, loss_cfg: GANLossConfig,
                               mode: str, dtype=None, scanned: bool = False,
-                              state=None):
+                              state=None, health: bool = False,
+                              health_depth: int = 1):
     """Single-optimizer VQ variants (taming vqgan.py:159-258):
     ``nodisc`` — L1 recon + codebook loss (VQNoDiscModel);
     ``segmentation`` — BCE over label-map logits + codebook loss
-    (VQSegmentationModel with BCELossWithQuant)."""
+    (VQSegmentationModel with BCELossWithQuant). ``health`` fuses the
+    graftpulse codebook + per-layer-group taps (obs/health.py)."""
     lc = loss_cfg
 
     def loss_fn(params, images, targets, key, temp):
         rngs = {"gumbel": key, "dropout": jax.random.fold_in(key, 1)}
         p = cast_floating(params, dtype)
         x = images if dtype is None else images.astype(dtype)
-        recon, qloss, _ = model.apply(p, x, temp=temp, deterministic=False,
-                                      rngs=rngs)
+        recon, qloss, indices = model.apply(p, x, temp=temp,
+                                            deterministic=False, rngs=rngs)
         recon32 = recon.astype(jnp.float32)
+        hm = {}
+        if health:
+            from ..obs.health import codebook_health
+            hm = codebook_health(indices, model.cfg.n_embed)
         if mode == "segmentation":
             loss, parts = bce_with_quant_loss(recon32, targets, qloss,
                                               lc.codebook_weight)
-            return loss, {"nll_loss": parts["bce_loss"], "quant_loss": qloss}
+            return loss, {"nll_loss": parts["bce_loss"], "quant_loss": qloss,
+                          **hm}
         rec = jnp.mean(jnp.abs(targets - recon32)) * lc.pixelloss_weight
         return rec + lc.codebook_weight * qloss, {"nll_loss": rec,
-                                                  "quant_loss": qloss}
+                                                  "quant_loss": qloss, **hm}
 
     def step(state: TrainState, images, targets, key, temp):
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, images, targets, key, temp)
-        state = state.apply_gradients(grads, value=loss)
+        if health:
+            from ..obs.health import tree_health
+            state, updates = state.apply_gradients(grads, value=loss,
+                                                   return_updates=True)
+            aux = {**aux, **tree_health(grads, state.params, updates,
+                                        depth=health_depth)}
+        else:
+            state = state.apply_gradients(grads, value=loss)
         return state, {"loss": loss, **aux}
 
     if scanned:
@@ -248,6 +279,9 @@ class VQGANTrainer(BaseTrainer):
         self.loss_cfg = loss_cfg or GANLossConfig()
         assert loss_mode in ("gan", "nodisc", "segmentation"), loss_mode
         self.loss_mode = loss_mode
+        self._health_kw = dict(
+            health=bool(train_cfg.obs.health),
+            health_depth=train_cfg.obs.health_group_depth)
 
         self.model, gen_params = init_vqgan(model_cfg, self.base_key)
         if loss_mode != "gan":
@@ -257,7 +291,8 @@ class VQGANTrainer(BaseTrainer):
                 apply_fn=self.model.apply, params=gen_params, tx=tx))
             self.step_fn = make_vq_simple_train_step(
                 self.model, self.loss_cfg, loss_mode,
-                dtype=compute_dtype(train_cfg.precision), state=self.state)
+                dtype=compute_dtype(train_cfg.precision), state=self.state,
+                **self._health_kw)
             self.disc = self.lpips = None
             self._finish_init(temp_scheduler)
             return
@@ -306,7 +341,8 @@ class VQGANTrainer(BaseTrainer):
             gen_tx=gen_tx, disc_tx=disc_tx))
         self.step_fn = make_vqgan_train_step(
             self.model, self.disc, self.lpips, self.loss_cfg,
-            dtype=compute_dtype(train_cfg.precision), state=self.state)
+            dtype=compute_dtype(train_cfg.precision), state=self.state,
+            **self._health_kw)
         self._finish_init(temp_scheduler)
 
     def _finish_init(self, temp_scheduler):
@@ -365,11 +401,11 @@ class VQGANTrainer(BaseTrainer):
             if self.loss_mode == "gan":
                 self._multi_step_fn = make_vqgan_train_step(
                     self.model, self.disc, self.lpips, self.loss_cfg,
-                    dtype=dt, scanned=True)
+                    dtype=dt, scanned=True, **self._health_kw)
             else:
                 self._multi_step_fn = make_vq_simple_train_step(
                     self.model, self.loss_cfg, self.loss_mode, dtype=dt,
-                    scanned=True)
+                    scanned=True, **self._health_kw)
         k = images.shape[0]
         steps = self._host_step + np.arange(k)
         temps = jnp.asarray(

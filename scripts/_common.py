@@ -243,6 +243,61 @@ def install_sigusr2_profiler(default_dir: str, args=None) -> bool:
     return True
 
 
+def add_health_args(parser):
+    """graftpulse model-health flags shared by every train CLI
+    (docs/OBSERVABILITY.md "Model health"): the in-jit taps + anomaly
+    sentries. Off by default — enabling changes the compiled step program
+    (pinned by the graftir goldens, which build health-on)."""
+    grp = parser.add_argument_group("model health (graftpulse, "
+                                    "docs/OBSERVABILITY.md)")
+    grp.add_argument("--health", action="store_true",
+                     help="fuse per-layer-group grad/param/update/"
+                          "non-finite taps (and codebook vitals on the VAE "
+                          "trainers) into the jitted step and run the "
+                          "anomaly sentries — zero added host syncs; "
+                          "breaches fire dalle_health_* gauges, flight "
+                          "bundles and the obs_report MODEL-HEALTH verdict")
+    grp.add_argument("--health_group_depth", type=int, default=1,
+                     help="pytree depth for layer groups (1 = model "
+                          "subtrees)")
+    grp.add_argument("--health_loss_z", type=float, default=6.0,
+                     help="loss-spike z-score threshold")
+    grp.add_argument("--health_grad_factor", type=float, default=10.0,
+                     help="grad-norm explosion factor over the EMA")
+    grp.add_argument("--health_perplexity_floor", type=float, default=4.0,
+                     help="codebook-collapse floor (usage perplexity)")
+    grp.add_argument("--health_flight_dir", type=str, default=None,
+                     help="configure a flight recorder here so health "
+                          "breaches dump post-mortem bundles (default: "
+                          "<output_dir>/health_bundles when --health)")
+    return parser
+
+
+def health_obs_kwargs(args) -> dict:
+    """ObsConfig kwargs from add_health_args flags."""
+    return {
+        "health": args.health,
+        "health_group_depth": args.health_group_depth,
+        "health_loss_z": args.health_loss_z,
+        "health_grad_factor": args.health_grad_factor,
+        "health_perplexity_floor": args.health_perplexity_floor,
+    }
+
+
+def install_health_recorder(args, default_dir: str) -> bool:
+    """With --health, make sure a flight recorder exists so breach bundles
+    have somewhere to land (an already-configured recorder wins). Returns
+    True when a recorder was installed here."""
+    if not getattr(args, "health", False):
+        return False
+    from dalle_tpu import obs
+    if obs.get_recorder() is not None:
+        return False
+    obs.configure_recorder(getattr(args, "health_flight_dir", None)
+                           or default_dir)
+    return True
+
+
 def add_overlap_args(parser):
     """Host-overlap flags shared by every train CLI (docs/PERFORMANCE.md):
     async checkpointing, device prefetch depth, deferred metrics, and the

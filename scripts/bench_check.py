@@ -66,10 +66,10 @@ def extract_metrics(path: str) -> dict:
     return out
 
 
-def newest_pair(family_glob: str):
+def newest_pair(family_glob: str, root: str = ROOT):
     """(newest_path, prior_path) by round number; (path, None) when only
     one round exists, (None, None) when none do."""
-    paths = sorted(glob.glob(os.path.join(ROOT, family_glob)),
+    paths = sorted(glob.glob(os.path.join(root, family_glob)),
                    key=_round_of)
     if not paths:
         return None, None
@@ -108,12 +108,15 @@ def main(argv=None):
                     help="relative band before a move counts (default 10%%)")
     ap.add_argument("--strict", action="store_true",
                     help="exit 1 on REGRESSED (default: advisory, exit 0)")
+    ap.add_argument("--root", type=str, default=ROOT,
+                    help="directory holding the BENCH_r*/MULTICHIP_r* "
+                         "rounds (default: the repo root)")
     args = ap.parse_args(argv)
 
     regressed = 0
     compared = 0
     for family in ("BENCH_r*.json", "MULTICHIP_r*.json"):
-        newest, prior = newest_pair(family)
+        newest, prior = newest_pair(family, args.root)
         label = family.split("_")[0]
         if newest is None:
             print(f"-- {label}: no rounds found")
@@ -129,6 +132,15 @@ def main(argv=None):
               f"±{args.tolerance:.0%})")
         if not new_m and not old_m:
             print("   (no metric records in either round)")
+            continue
+        if not new_m:
+            # an empty newest trajectory (fresh clone / a placeholder round
+            # committed before its capture ran) is a NEW baseline, not a
+            # wall of MISSING verdicts — the advisory stage stays quiet on
+            # first run and the next captured round diffs normally
+            print(f"   NEW        (no metric records in "
+                  f"{os.path.basename(newest)} — treating the trajectory "
+                  "as a fresh baseline, nothing to diff)")
             continue
         for name, ov, nv, delta, verdict in compare(new_m, old_m,
                                                     args.tolerance):

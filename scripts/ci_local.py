@@ -73,14 +73,18 @@ def run_precision_audit_stage() -> int:
 
 
 def run_obs_smoke_stage() -> int:
-    """The grafttrace + host-overlap smoke stage: a short synthetic traced
-    fit (device prefetch + async checkpointing + deferred metrics ON) that
-    must produce a well-formed Perfetto trace, the step-time breakdown in
-    the metrics JSONL, steady-state batch_wait+sync ≈ 0, a bounded
-    checkpoint-boundary step, a quiet watchdog, and <1% span overhead
-    (scripts/obs_smoke.py; the workflow's matching step is skipped below).
-    Artifacts (incl. breakdown.json) land in ./obs_artifacts — the dir
-    ci.yml uploads."""
+    """The grafttrace + host-overlap + graftpulse smoke stage: a short
+    synthetic traced fit (device prefetch + async checkpointing + deferred
+    metrics + model-health taps ON) that must produce a well-formed
+    Perfetto trace, the step-time breakdown AND health/* columns in the
+    metrics JSONL, steady-state batch_wait+sync ≈ 0 with the taps fused
+    in, a bounded checkpoint-boundary step, a quiet watchdog, <1% span
+    overhead, the no-host-transfer/scalar-all-reduce-only tap contract
+    (pinned goldens + a live health-on/off probe), and the injected
+    codebook collapse → exactly one flight bundle + MODEL-HEALTH DEGRADED
+    verdict (scripts/obs_smoke.py; the workflow's matching step is skipped
+    below). Artifacts (incl. breakdown.json + health_artifacts/) land in
+    ./obs_artifacts — the dir ci.yml uploads."""
     cmd = [sys.executable, os.path.join(ROOT, "scripts", "obs_smoke.py"),
            "--outdir", os.path.join(ROOT, "obs_artifacts")]
     print(f"== [obs] {' '.join(cmd[1:])}")

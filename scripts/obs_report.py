@@ -6,8 +6,11 @@ inside, e.g. ``<checkpoint_dir>/obs/`` or a ``--trace`` export dir), a
 ``spans.jsonl``, or a ``MetricsLogger`` metrics JSONL. Span rows yield the
 per-name aggregate and slowest-spans tables; metrics rows yield the
 step-time histogram, the input-bound/compute-bound verdict from the
-data-starvation ratio, and HBM/recompile callouts. See docs/OBSERVABILITY.md
-for reading the output.
+data-starvation ratio, HBM/recompile callouts, and — when the graftpulse
+``health/*`` columns are present (``--health`` runs) — the MODEL-HEALTH
+verdict line naming the breaching detector and layer group. Zero-sample
+sections (no completed requests, no steps) print ``n/a``, never NaN. See
+docs/OBSERVABILITY.md for reading the output.
 
 ``--request <id>`` switches to graftscope's per-request view: every span
 tagged with that trace_id (or engine request_id), from every thread the

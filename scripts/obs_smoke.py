@@ -1,8 +1,9 @@
 #!/usr/bin/env python
 """Observability + host-overlap smoke: a short synthetic traced DALLE fit
 with every PR3 overlap layer ON (device prefetch, async checkpointing,
-deferred metrics), then assert the telemetry AND overlap contracts end to
-end (the CI stage behind docs/OBSERVABILITY.md and docs/PERFORMANCE.md):
+deferred metrics) AND the graftpulse health taps fused into the step, then
+assert the telemetry AND overlap contracts end to end (the CI stage behind
+docs/OBSERVABILITY.md and docs/PERFORMANCE.md):
 
   1. the Chrome trace JSON is well-formed, contains fit/batch_wait,
      fit/dispatch and fit/sync spans, and the in-band sync span NESTS inside
@@ -11,16 +12,27 @@ end (the CI stage behind docs/OBSERVABILITY.md and docs/PERFORMANCE.md):
   2. the metrics JSONL carries the per-step breakdown — t_batch_wait_s /
      t_dispatch_s / t_sync_s / t_h2d_s, a data-starvation ratio, the HBM
      gauge, and t_ckpt_s on the records after each save boundary;
-  3. OVERLAP: steady-state t_batch_wait_s + t_sync_s is ~0 (prefetch keeps
-     batches device-resident; deferred metrics read finished steps), and a
-     step crossing a checkpoint boundary stays within a bounded multiple of
-     the median step time (async save = snapshot only, not
-     snapshot+serialize+write);
+  3. OVERLAP: steady-state t_batch_wait_s + t_sync_s is ~0 WITH the health
+     taps on (the graftpulse free-tap contract: the per-layer-group
+     vitals ride the existing deferred-metrics fetch, zero added host
+     syncs), and a step crossing a checkpoint boundary stays within a
+     bounded multiple of the median step time;
   4. the watchdog (armed with a generous deadline) stayed quiet;
   5. measured span overhead extrapolated to a full step's span count is
-     < 1% of the median step time.
+     < 1% of the median step time;
+  6. GRAFTPULSE: health/* columns present in the records; the pinned
+     graftir goldens for all four trainer steps carry ZERO host-transfer
+     primitives (the taps are in-graph reductions only — any drift there
+     fails the graftir stage first, this re-asserts the transfer half);
+  7. ANOMALY PATH, end to end: a second tiny dVAE fit with a synthetic
+     codebook collapse injected (the perplexity floor forced above any
+     reachable usage perplexity) must fire the codebook-collapse detector
+     EXACTLY once — one flight-recorder bundle in health_artifacts/, and
+     an obs_report MODEL-HEALTH: DEGRADED verdict naming the detector and
+     layer group.
 
-Artifacts (trace.json, spans.jsonl, metrics.jsonl, breakdown.json, the
+Artifacts (trace.json, spans.jsonl, metrics.jsonl, breakdown.json,
+health_artifacts/ with the collapse bundle + vae_metrics.jsonl, the
 obs_report summary) land in --outdir; ci.yml uploads them so every CI run
 leaves an openable Perfetto trace + the step-breakdown behind.
 
@@ -51,6 +63,14 @@ def main(argv=None):
     args = ap.parse_args(argv)
     os.makedirs(args.outdir, exist_ok=True)
 
+    # the graftpulse live-contract probe (check 6) compiles a trainer step
+    # on a 2x2 dp/fsdp mesh, so force the 8-device CPU platform BEFORE jax
+    # initializes (the conftest trick; the main fit still pins devices[:1])
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
     import jax
     import numpy as np
     from dalle_tpu import obs
@@ -74,7 +94,8 @@ def main(argv=None):
         checkpoint_dir=os.path.join(args.outdir, "ckpt"),
         mesh=mesh_cfg,
         obs=ObsConfig(trace=True, trace_dir=args.outdir,
-                      watchdog_deadline_s=300.0, device_poll_every=1))
+                      watchdog_deadline_s=300.0, device_poll_every=1,
+                      health=True))
     # one explicit device: an inherited XLA_FLAGS=...device_count=8 would
     # otherwise auto-scale dp to 8 and reject the batch-4 sharding
     trainer = DalleTrainer(tiny, tc, mesh=build_mesh(
@@ -192,12 +213,149 @@ def main(argv=None):
     else:
         check(False, "no t_dispatch_s records — overhead gate unmeasurable")
 
+    # -- 6. graftpulse: live taps + pinned-golden transfer invariant -------
+    health_cols = sorted({k for r in recs for k in r
+                          if k.startswith("health/")})
+    check(any(k.startswith("health/grad_norm/") for k in health_cols)
+          and any(k.startswith("health/update_ratio/") for k in health_cols)
+          and any(k.startswith("health/nonfinite_frac/") for k in health_cols),
+          f"health taps in records ({len(health_cols)} health columns)")
+    nf = [r[k] for r in recs for k in r
+          if k.startswith("health/nonfinite_frac/")]
+    check(bool(nf) and all(v == 0.0 for v in nf),
+          "nonfinite_frac taps all zero on a healthy run")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for entry in ("train_step_dalle", "train_step_vae", "train_step_vqgan",
+                  "train_step_clip"):
+        gpath = os.path.join(repo, "contracts", f"{entry}.json")
+        try:
+            with open(gpath) as fh:
+                golden = json.load(fh)
+            ok = golden.get("transfers") == []
+        except OSError:
+            ok = False
+        check(ok, f"graftir golden {entry}: zero host-transfer primitives "
+                  "with health taps pinned")
+
+    # LIVE probe: trace+compile the dVAE train step with the taps on and
+    # off, on a real 2x2 dp/fsdp mesh, and diff the contracts directly —
+    # the taps must (a) introduce zero host-transfer primitives, (b) keep
+    # donation fully aliased, and (c) change the collective inventory by at
+    # most scalar-sized all-reduces on axes the step already used (the
+    # unavoidable cross-shard combine for group norms of sharded state;
+    # no new collective kinds, no new mesh axes, nothing > 1 KB)
+    from collections import Counter
+
+    from dalle_tpu.analysis.contracts import BuiltEntry
+    from dalle_tpu.analysis.ir_audit import build_contract
+    from dalle_tpu.config import DVAEConfig, PrecisionConfig
+    from dalle_tpu.train.trainer_vae import VAETrainer
+    import jax.numpy as jnp
+    probe_cfg = DVAEConfig(image_size=16, num_tokens=32, codebook_dim=16,
+                           num_layers=2, hidden_dim=8, num_resnet_blocks=0)
+    mesh22_cfg = MeshConfig(dp=2, fsdp=2)
+    mesh22 = build_mesh(mesh22_cfg)
+
+    def probe_contract(health: bool) -> dict:
+        tc2 = TrainConfig(
+            batch_size=8, preflight_checkpoint=False,
+            checkpoint_dir=os.path.join(args.outdir, "probe_ckpt"),
+            mesh=mesh22_cfg, precision=PrecisionConfig(compute="float32"),
+            obs=ObsConfig(health=health))
+        tr2 = VAETrainer(probe_cfg, tc2, mesh=mesh22)
+        images = tr2._put(rng.rand(8, 16, 16, 3).astype(np.float32),
+                          np.float32)
+        key = jax.random.fold_in(tr2.base_key, 0)
+        donated = len(jax.tree.leaves(tr2.state))
+        be = BuiltEntry(fn=tr2.step_fn,
+                        args=(tr2.state, images, key, jnp.float32(1.0)),
+                        donated=donated, mesh=tr2.mesh, compile=True)
+        return build_contract("health_probe", be)
+
+    con_on, con_off = probe_contract(True), probe_contract(False)
+    check(con_on["transfers"] == [] and con_off["transfers"] == [],
+          "live probe: health taps add no host-transfer primitives")
+    don = con_on.get("donation") or {}
+    check(don.get("aliased") == don.get("donated"),
+          f"live probe: donation fully aliased with taps on "
+          f"({don.get('aliased')}/{don.get('donated')})")
+
+    def _series(con):
+        return Counter({(c["kind"], c["axes"], c["bytes"]): c["count"]
+                        for c in con.get("collectives", [])})
+
+    on_c, off_c = _series(con_on), _series(con_off)
+    removed = off_c - on_c
+    added = on_c - off_c
+    axes_off = {k[1] for k in off_c}
+    added_ok = all(kind == "all-reduce" and axes in axes_off
+                   and nbytes <= 1024
+                   for (kind, axes, nbytes) in added)
+    check(not removed and added_ok,
+          "live probe: tap delta is scalar all-reduces only, on existing "
+          f"axes (added={sorted(added)!r})")
+
+    # -- 7. injected codebook collapse → one bundle + DEGRADED verdict -----
+    health_dir = os.path.join(args.outdir, "health_artifacts")
+    os.makedirs(health_dir, exist_ok=True)
+    obs.configure_recorder(health_dir)
+    vae_cfg = DVAEConfig(image_size=16, num_tokens=32, codebook_dim=16,
+                         num_layers=2, hidden_dim=8, num_resnet_blocks=0)
+    vae_tc = TrainConfig(
+        batch_size=4, log_every=1, metrics_every=1, save_every_steps=0,
+        preflight_checkpoint=False, device_prefetch=0,
+        checkpoint_dir=os.path.join(args.outdir, "vae_ckpt"), mesh=mesh_cfg,
+        # the injection: a floor no 32-code codebook can satisfy —
+        # perplexity is ≤ num_tokens, so the detector MUST trip (once:
+        # edge-triggered, the collapse "persists" every later step). The
+        # loss/grad detectors are parked at unreachable thresholds so this
+        # 6-step toy run (whose warm-up loss swings would look like spikes
+        # to a 2-sample EMA) exercises exactly one detector
+        obs=ObsConfig(health=True, health_perplexity_floor=1e6,
+                      health_loss_z=1e9, health_grad_factor=1e9,
+                      health_min_samples=2))
+    vae_tr = VAETrainer(vae_cfg, vae_tc, mesh=build_mesh(
+        mesh_cfg, devices=jax.devices()[:1]))
+    vae_metrics = os.path.join(health_dir, "vae_metrics.jsonl")
+    if os.path.exists(vae_metrics):
+        os.remove(vae_metrics)
+    vae_writer = MetricsLogger(path=vae_metrics)
+    vae_tr.fit(iter([(rng.rand(4, 16, 16, 3).astype(np.float32),)
+                     for _ in range(6)]), steps=6,
+               metrics_writer=vae_writer, log=lambda *a, **k: None)
+    vae_writer.close()
+    bundles = [n for n in sorted(os.listdir(health_dir))
+               if n.startswith("postmortem_health_codebook-collapse")]
+    check(len(bundles) == 1,
+          f"injected codebook collapse → exactly one flight bundle "
+          f"(got {len(bundles)})")
+    if bundles:
+        with open(os.path.join(health_dir, bundles[0],
+                               "postmortem.json")) as fh:
+            pm = json.load(fh)
+        breach = (pm.get("extra") or {}).get("breach", {})
+        check(breach.get("detector") == "codebook-collapse"
+              and breach.get("layer_group") == "codebook",
+              f"bundle names detector+group ({breach.get('detector')}, "
+              f"{breach.get('layer_group')})")
+    vae_report = summarize_run(vae_metrics)
+    check("MODEL-HEALTH: DEGRADED (codebook-collapse in codebook" in
+          vae_report, "obs_report MODEL-HEALTH: DEGRADED verdict names "
+                      "detector and layer group")
+    check("=nan" not in vae_report and " nan" not in vae_report,
+          "health report free of NaN rates")
+    with open(os.path.join(health_dir, "vae_report.txt"), "w") as fh:
+        fh.write(vae_report)
+    obs.disable_recorder()
+
     # -- breakdown artifact (uploaded by ci.yml with the trace) ------------
     breakdown = {
         "median_step_s": med_step,
         "median_batch_wait_plus_sync_s": waits[len(waits) // 2] if waits else None,
         "checkpoint_boundary_steps_s": sorted(boundary),
         "records": len(recs), "saves_observed": n_ckpt,
+        "health_columns": len(health_cols),
+        "health_bundles": bundles,
         "failures": list(FAILURES),
     }
     with open(os.path.join(args.outdir, "breakdown.json"), "w") as fh:

@@ -82,6 +82,13 @@ def build_parser():
     scope.add_argument("--slo_objective", type=float, default=0.999,
                        help="availability objective for the burn-rate "
                             "sentry (error budget = 1 - objective)")
+    scope.add_argument("--decode_health", action="store_true",
+                       help="graftpulse decode-quality gauges: per-request "
+                            "token entropy / top-k mass / repeated-token "
+                            "ratio from logits already on device (zero "
+                            "added host syncs; tokens stay bit-exact). "
+                            "Program-shaping: pair with a matching "
+                            "--aot_export")
     add_compile_cache_args(ap)
     add_profiler_args(ap)
     return ap
@@ -130,7 +137,8 @@ def main(argv=None):
 
     def make_engine():
         return dv.serve_engine(slots=args.slots, precision=args.precision,
-                               steps_per_sync=args.steps_per_sync)
+                               steps_per_sync=args.steps_per_sync,
+                               decode_health=args.decode_health)
 
     if args.aot_export:
         manifest = save_engine_aot(make_engine(), args.aot_export)

@@ -18,9 +18,11 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from _common import (add_compile_cache_args, add_overlap_args,  # noqa: E402
-                     add_profiler_args, install_sigusr2_profiler,
-                     enable_compile_cache, overlap_train_kwargs)
+from _common import (add_compile_cache_args, add_health_args,  # noqa: E402
+                     add_overlap_args, add_profiler_args,
+                     enable_compile_cache, health_obs_kwargs,
+                     install_health_recorder, install_sigusr2_profiler,
+                     overlap_train_kwargs)
 
 
 def build_parser():
@@ -61,6 +63,7 @@ def build_parser():
     train.add_argument("--no_preflight", action="store_true")
 
     add_overlap_args(ap)
+    add_health_args(ap)
     add_compile_cache_args(ap)
     add_profiler_args(ap)
     from dalle_tpu.parallel import wrap_arg_parser
@@ -79,7 +82,8 @@ def main(argv=None):
     install_sigusr2_profiler(os.path.join(args.output_dir, "profile"),
                              args)
     import numpy as np
-    from dalle_tpu.config import ClipConfig, OptimConfig, TrainConfig
+    from dalle_tpu.config import (ClipConfig, ObsConfig, OptimConfig,
+                                  TrainConfig)
     from dalle_tpu.parallel import set_backend_from_args
     from dalle_tpu.text.tokenizer import get_tokenizer
     from dalle_tpu.train.trainer_clip import CLIPTrainer
@@ -108,8 +112,11 @@ def main(argv=None):
         save_every_steps=args.save_every_n_steps,
         preflight_checkpoint=not args.no_preflight, scan_steps=args.scan_steps,
         **overlap_train_kwargs(args),
+        obs=ObsConfig(**health_obs_kwargs(args)),
         optim=OptimConfig(learning_rate=args.learning_rate,
                           grad_clip_norm=args.clip_grad_norm))
+    install_health_recorder(args, os.path.join(args.output_dir,
+                                               "health_bundles"))
 
     trainer = CLIPTrainer(model_cfg, train_cfg, backend=backend)
 

@@ -19,11 +19,12 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from _common import (add_compile_cache_args, add_overlap_args,  # noqa: E402
-                     add_profiler_args, add_vae_args, enable_compile_cache,
-                     build_vae_from_args, install_sigusr2_profiler,
-                     overlap_train_kwargs, save_image_grid,
-                     save_vae_sidecar)
+from _common import (add_compile_cache_args, add_health_args,  # noqa: E402
+                     add_overlap_args, add_profiler_args, add_vae_args,
+                     build_vae_from_args, enable_compile_cache,
+                     health_obs_kwargs, install_health_recorder,
+                     install_sigusr2_profiler, overlap_train_kwargs,
+                     save_image_grid, save_vae_sidecar)
 
 
 def build_parser():
@@ -97,6 +98,7 @@ def build_parser():
                        help="profile at step 200 then exit (ref :492-499)")
 
     add_overlap_args(ap)
+    add_health_args(ap)
     add_compile_cache_args(ap)
     add_profiler_args(ap)
 
@@ -173,7 +175,10 @@ def main(argv=None):
                           lr_scheduler=args.lr_scheduler),
         obs=ObsConfig(trace=args.trace,
                       watchdog_deadline_s=args.watchdog_deadline_s,
-                      prometheus_path=args.prometheus_path))
+                      prometheus_path=args.prometheus_path,
+                      **health_obs_kwargs(args)))
+    install_health_recorder(args, os.path.join(args.output_dir,
+                                               "health_bundles"))
 
     trainer = DalleTrainer(model_cfg, train_cfg, backend=backend,
                            null_cond_prob=args.null_cond_prob)

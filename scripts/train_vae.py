@@ -17,9 +17,11 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from _common import (add_compile_cache_args, add_overlap_args,  # noqa: E402
-                     add_profiler_args, install_sigusr2_profiler,
-                     enable_compile_cache, overlap_train_kwargs)
+from _common import (add_compile_cache_args, add_health_args,  # noqa: E402
+                     add_overlap_args, add_profiler_args,
+                     enable_compile_cache, health_obs_kwargs,
+                     install_health_recorder, install_sigusr2_profiler,
+                     overlap_train_kwargs)
 
 
 def build_parser():
@@ -70,6 +72,7 @@ def build_parser():
     train.add_argument("--log_artifacts", action="store_true")
 
     add_overlap_args(ap)
+    add_health_args(ap)
     add_compile_cache_args(ap)
     add_profiler_args(ap)
     from dalle_tpu.parallel import wrap_arg_parser
@@ -86,7 +89,8 @@ def main(argv=None):
     enable_compile_cache(args)
     install_sigusr2_profiler(os.path.join(args.output_dir, "profile"),
                              args)
-    from dalle_tpu.config import (AnnealConfig, DVAEConfig, OptimConfig, TrainConfig)
+    from dalle_tpu.config import (AnnealConfig, DVAEConfig, ObsConfig,
+                                  OptimConfig, TrainConfig)
     from dalle_tpu.parallel import set_backend_from_args
     from dalle_tpu.train.trainer_vae import VAETrainer
 
@@ -107,10 +111,13 @@ def main(argv=None):
         sample_every_steps=args.sample_every_steps,
         log_artifacts=args.log_artifacts, scan_steps=args.scan_steps,
         **overlap_train_kwargs(args),
+        obs=ObsConfig(**health_obs_kwargs(args)),
         optim=OptimConfig(learning_rate=args.learning_rate,
                           grad_clip_norm=args.clip_grad_norm,
                           lr_scheduler="exponential",
                           lr_decay_rate=args.lr_decay_rate))
+    install_health_recorder(args, os.path.join(args.output_dir,
+                                               "health_bundles"))
     anneal = AnnealConfig(starting_temp=args.starting_temp,
                           temp_min=args.temp_min, anneal_rate=args.anneal_rate)
 

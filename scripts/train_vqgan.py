@@ -19,9 +19,11 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from _common import (add_compile_cache_args, add_overlap_args,  # noqa: E402
-                     add_profiler_args, install_sigusr2_profiler,
-                     enable_compile_cache, overlap_train_kwargs)
+from _common import (add_compile_cache_args, add_health_args,  # noqa: E402
+                     add_overlap_args, add_profiler_args,
+                     enable_compile_cache, health_obs_kwargs,
+                     install_health_recorder, install_sigusr2_profiler,
+                     overlap_train_kwargs)
 
 
 def build_parser():
@@ -80,6 +82,7 @@ def build_parser():
     train.add_argument("--log_artifacts", action="store_true")
 
     add_overlap_args(ap)
+    add_health_args(ap)
     add_compile_cache_args(ap)
     add_profiler_args(ap)
     from dalle_tpu.parallel import wrap_arg_parser
@@ -97,7 +100,8 @@ def main(argv=None):
     install_sigusr2_profiler(os.path.join(args.output_dir, "profile"),
                              args)
     import numpy as np
-    from dalle_tpu.config import OptimConfig, TrainConfig, VQGANConfig
+    from dalle_tpu.config import (ObsConfig, OptimConfig, TrainConfig,
+                                  VQGANConfig)
     from dalle_tpu.models.gan import GANLossConfig
     from dalle_tpu.parallel import set_backend_from_args
     from dalle_tpu.train.trainer_vqgan import VQGANTrainer
@@ -127,9 +131,12 @@ def main(argv=None):
         sample_every_steps=args.sample_every_steps,
         log_artifacts=args.log_artifacts, scan_steps=args.scan_steps,
         **overlap_train_kwargs(args),
+        obs=ObsConfig(**health_obs_kwargs(args)),
         # taming: Adam(lr, betas=(0.5, 0.9)) for both nets (vqgan.py:121-131)
         optim=OptimConfig(learning_rate=lr, beta1=0.5, beta2=0.9,
                           grad_clip_norm=0.0))
+    install_health_recorder(args, os.path.join(args.output_dir,
+                                               "health_bundles"))
 
     trainer = VQGANTrainer(model_cfg, train_cfg, loss_cfg=loss_cfg,
                            backend=backend)

@@ -357,12 +357,20 @@ def test_gateway_loopback_stream_quota_health(model_params, refs):
         assert health["status"] == "ok"
         assert health["replicas"][0]["healthy"]
         conn.close()
-        conn = http.client.HTTPConnection(host, port, timeout=10)
-        conn.request("GET", "/metrics")
-        metrics = conn.getresponse().read().decode()
+        # the streamed POST's handler may still be inside its exit
+        # bookkeeping when the client saw SSE EOF (HTTP/1.0 close races the
+        # server-side finally), so poll the scrape briefly for inflight=0
+        deadline = time.time() + 5.0
+        while True:
+            conn = http.client.HTTPConnection(host, port, timeout=10)
+            conn.request("GET", "/metrics")
+            metrics = conn.getresponse().read().decode()
+            conn.close()
+            if "dalle_gateway_inflight 0" in metrics or time.time() > deadline:
+                break
+            time.sleep(0.05)
         assert "dalle_gateway_rejected_total" in metrics
         assert "dalle_gateway_inflight 0" in metrics
-        conn.close()
         conn = http.client.HTTPConnection(host, port, timeout=10)
         conn.request("GET", "/nope")
         assert conn.getresponse().status == 404

@@ -1,0 +1,347 @@
+"""graftpulse (dalle_tpu/obs/health.py + obs/anomaly.py): the in-jit tap
+library, the anomaly sentries' edge-trigger/baseline semantics, the
+trainer integration (taps ride the step's metrics dict — same fetch, no
+extra syncs), breach side-effects (gauges, events, flight bundle), and the
+obs_report MODEL-HEALTH verdict."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_tpu import obs
+from dalle_tpu.obs import anomaly
+from dalle_tpu.obs.health import (codebook_health, decode_quality,
+                                  gumbel_health, layer_groups, tree_health)
+from dalle_tpu.obs.report import format_report, health_accounting
+
+# ceiling = the module's cold full-run total (measured 132) + slack for
+# cross-jax-version compile-count variance (the test_speculative convention)
+pytestmark = pytest.mark.recompile_budget(155)
+
+
+@pytest.fixture
+def tracer():
+    t = obs.configure(2048)
+    t.spans.clear()
+    t.counters.clear()
+    t.gauges.clear()
+    yield t
+    obs.disable()
+
+
+# ---------------------------------------------------------------------------
+# tap library (pure jnp)
+# ---------------------------------------------------------------------------
+
+def test_layer_groups_drops_params_and_truncates():
+    tree = {"params": {"encoder": {"conv1": {"kernel": jnp.ones((2, 2)),
+                                             "bias": jnp.ones((2,))},
+                                   "conv2": {"kernel": jnp.ones((2, 2))}},
+                       "codebook": {"embedding": jnp.ones((4, 2))}}}
+    g = layer_groups(tree, depth=1)
+    assert set(g) == {"encoder", "codebook"}
+    assert len(g["encoder"]) == 3
+    g2 = layer_groups(tree, depth=2, prefix="gen")
+    assert "gen/encoder/conv1" in g2 and "gen/codebook/embedding" in g2
+
+
+def test_tree_health_norms_ratios_and_nonfinite():
+    params = {"params": {"a": jnp.full((4,), 2.0), "b": jnp.full((2,), 1.0)}}
+    grads = {"params": {"a": jnp.full((4,), 3.0), "b": jnp.full((2,), 0.0)}}
+    updates = {"params": {"a": jnp.full((4,), 0.2), "b": jnp.zeros((2,))}}
+    m = tree_health(grads, params, updates, depth=1)
+    np.testing.assert_allclose(float(m["health/grad_norm/a"]), 6.0, rtol=1e-6)
+    np.testing.assert_allclose(float(m["health/param_norm/a"]), 4.0,
+                               rtol=1e-6)
+    np.testing.assert_allclose(float(m["health/update_ratio/a"]), 0.1,
+                               rtol=1e-5)
+    assert float(m["health/nonfinite_frac/a"]) == 0.0
+    bad = {"params": {"a": jnp.array([1.0, jnp.inf, jnp.nan, 0.0]),
+                      "b": jnp.full((2,), 0.0)}}
+    m = tree_health(bad, params, None, depth=1)
+    np.testing.assert_allclose(float(m["health/nonfinite_frac/a"]), 0.5)
+    assert "health/update_ratio/a" not in m   # no updates given
+
+
+def test_tree_health_is_jittable_scalars_only():
+    grads = {"w": jnp.ones((3, 3)), "b": jnp.ones((3,))}
+    m = jax.jit(lambda g: tree_health(g, g, g))(grads)
+    assert all(v.shape == () and v.dtype == jnp.float32
+               for v in m.values())
+
+
+def test_codebook_health_uniform_vs_collapsed():
+    uniform = codebook_health(jnp.arange(16, dtype=jnp.int32), 16)
+    np.testing.assert_allclose(float(uniform["health/codebook_perplexity"]),
+                               16.0, rtol=1e-5)
+    assert float(uniform["health/codebook_dead_frac"]) == 0.0
+    collapsed = codebook_health(jnp.zeros((64,), jnp.int32), 16)
+    np.testing.assert_allclose(float(collapsed["health/codebook_perplexity"]),
+                               1.0, rtol=1e-5)
+    np.testing.assert_allclose(float(collapsed["health/codebook_dead_frac"]),
+                               15 / 16)
+
+
+def test_gumbel_health_sharpness_bounds():
+    logits = jnp.array([[[0.0, 10.0, 0.0]]])
+    onehot = jax.nn.one_hot(jnp.array([[1]]), 3)
+    m = gumbel_health(logits, onehot, 0.7)
+    assert float(m["health/gumbel_temp"]) == pytest.approx(0.7)
+    assert float(m["health/st_sharpness"]) == pytest.approx(1.0)
+    assert 0.9 < float(m["health/encoder_confidence"]) <= 1.0
+
+
+def test_decode_quality_entropy_and_topk():
+    # uniform logits → entropy log(V), peaked logits → ~0
+    V = 64
+    logits = jnp.stack([jnp.zeros((V,)),
+                        jnp.where(jnp.arange(V) == 3, 100.0, 0.0)])
+    q = decode_quality(logits, topk=8)
+    np.testing.assert_allclose(float(q["entropy"][0]), np.log(V), rtol=1e-4)
+    assert float(q["entropy"][1]) < 1e-3
+    np.testing.assert_allclose(float(q["topk_mass"][0]), 8 / V, rtol=1e-4)
+    np.testing.assert_allclose(float(q["topk_mass"][1]), 1.0, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# detectors: baselines, thresholds, edge-trigger
+# ---------------------------------------------------------------------------
+
+def test_loss_spike_fires_once_per_episode_after_warmup():
+    det = anomaly.LossSpikeDetector(z=4.0, min_samples=3)
+    for step in range(5):
+        assert det.observe(step, {"loss": 1.0 + 0.01 * step}) == []
+    b = det.observe(5, {"loss": 50.0})
+    assert len(b) == 1 and b[0].detector == "loss-spike" \
+        and b[0].layer_group == "loss"
+    # still spiking → same episode, no refire; recovery re-arms
+    assert det.observe(6, {"loss": 60.0}) == []
+    for step in range(7, 17):
+        det.observe(step, {"loss": 1.0})
+    assert len(det.observe(20, {"loss": 80.0})) == 1
+
+
+def test_loss_spike_cold_start_never_fires():
+    det = anomaly.LossSpikeDetector(z=1.0, min_samples=5)
+    assert det.observe(0, {"loss": 1e9}) == []
+
+
+def test_grad_explosion_names_the_group():
+    det = anomaly.GradExplosionDetector(factor=5.0, min_samples=3)
+    for step in range(4):
+        det.observe(step, {"health/grad_norm/encoder": 1.0,
+                           "health/grad_norm/decoder": 2.0})
+    b = det.observe(4, {"health/grad_norm/encoder": 100.0,
+                        "health/grad_norm/decoder": 2.0})
+    assert len(b) == 1 and b[0].layer_group == "encoder"
+
+
+def test_codebook_collapse_floor_and_recovery():
+    det = anomaly.CodebookCollapseDetector(floor=4.0, min_samples=1)
+    assert det.observe(0, {"health/codebook_perplexity": 9.0}) == []
+    b = det.observe(1, {"health/codebook_perplexity": 1.2})
+    assert len(b) == 1 and b[0].detector == "codebook-collapse" \
+        and b[0].layer_group == "codebook"
+    assert det.observe(2, {"health/codebook_perplexity": 1.1}) == []
+    det.observe(3, {"health/codebook_perplexity": 9.0})   # recovers
+    assert len(det.observe(4, {"health/codebook_perplexity": 0.5})) == 1
+
+
+def test_nan_precursor_zero_tolerance():
+    det = anomaly.NaNPrecursorDetector()
+    assert det.observe(0, {"health/nonfinite_frac/ffn": 0.0}) == []
+    b = det.observe(1, {"health/nonfinite_frac/ffn": 1e-6})
+    assert len(b) == 1 and b[0].layer_group == "ffn"
+
+
+# ---------------------------------------------------------------------------
+# sentry: gauges, events, bundle, breach columns
+# ---------------------------------------------------------------------------
+
+def test_sentry_publishes_labeled_gauges_and_breach_columns(tracer,
+                                                            tmp_path):
+    obs.configure_recorder(str(tmp_path))
+    try:
+        sentry = anomaly.HealthSentry([
+            anomaly.CodebookCollapseDetector(floor=4.0, min_samples=1)])
+        m = {"loss": 1.0, "health/grad_norm/encoder": 0.5,
+             "health/codebook_perplexity": 2.0}
+        sentry.observe(0, m)   # min_samples=1 → first reading may fire
+        assert m.get("health/breach") == 1
+        assert m["health/breach_detector"] == "codebook-collapse"
+        assert m["health/breach_group"] == "codebook"
+        snap = obs.metrics_snapshot()
+        assert snap['health.grad_norm{layer_group="encoder"}'] == 0.5
+        assert snap["health.codebook_perplexity"] == 2.0
+        assert snap[
+            'health.breaches_total{detector="codebook-collapse"}'] == 1
+        rec = obs.get_recorder()
+        bundles = [d for d in os.listdir(str(tmp_path))
+                   if d.startswith("postmortem_health_")]
+        assert len(bundles) == 1
+        with open(os.path.join(str(tmp_path), bundles[0],
+                               "postmortem.json")) as fh:
+            pm = json.load(fh)
+        assert pm["extra"]["breach"]["detector"] == "codebook-collapse"
+        events = [e for e in rec.events if e["kind"] == "health_breach"]
+        assert len(events) == 1
+    finally:
+        obs.disable_recorder()
+
+
+def test_sentry_survives_detector_crash(tracer, capsys):
+    class Broken:
+        name = "broken"
+
+        def observe(self, step, metrics):
+            raise RuntimeError("boom")
+
+    sentry = anomaly.HealthSentry([
+        Broken(), anomaly.NaNPrecursorDetector()])
+    b = sentry.observe(0, {"health/nonfinite_frac/x": 1.0})
+    assert len(b) == 1   # the healthy detector still ran
+    assert "broken" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# trainer integration: taps in the metrics dict, sentry through fit()
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def vae_trainer():
+    from dalle_tpu.config import (DVAEConfig, MeshConfig, ObsConfig,
+                                  PrecisionConfig, TrainConfig)
+    from dalle_tpu.parallel.mesh import build_mesh
+    from dalle_tpu.train.trainer_vae import VAETrainer
+    import tempfile
+    cfg = DVAEConfig(image_size=16, num_tokens=32, codebook_dim=16,
+                     num_layers=2, hidden_dim=8, num_resnet_blocks=0)
+    tc = TrainConfig(batch_size=4, preflight_checkpoint=False,
+                     checkpoint_dir=tempfile.mkdtemp(), log_every=1,
+                     save_every_steps=0, mesh=MeshConfig(),
+                     precision=PrecisionConfig(compute="float32"),
+                     obs=ObsConfig(health=True,
+                                   health_perplexity_floor=1e6,
+                                   health_min_samples=2))
+    return VAETrainer(cfg, tc, mesh=build_mesh(MeshConfig(),
+                                               devices=jax.devices()[:1]))
+
+
+def test_vae_step_metrics_carry_health_columns(vae_trainer, rng):
+    m = vae_trainer.train_step(rng.rand(4, 16, 16, 3).astype(np.float32))
+    for col in ("health/codebook_perplexity", "health/codebook_dead_frac",
+                "health/gumbel_temp", "health/st_sharpness",
+                "health/grad_norm/encoder", "health/param_norm/decoder",
+                "health/update_ratio/codebook",
+                "health/nonfinite_frac/encoder"):
+        assert col in m, col
+    assert 1.0 <= m["health/codebook_perplexity"] <= 32.0
+    assert m["health/nonfinite_frac/encoder"] == 0.0
+
+
+def test_fit_sentry_fires_once_and_report_degrades(vae_trainer, rng,
+                                                   tmp_path):
+    from dalle_tpu.obs.report import load_jsonl, summarize_run
+    from dalle_tpu.train.metrics import MetricsLogger
+    vae_trainer.health_sentry = None      # fresh sentry for this fit
+    vae_trainer._health_last_step = -1
+    mpath = str(tmp_path / "metrics.jsonl")
+    w = MetricsLogger(path=mpath)
+    batches = [(rng.rand(4, 16, 16, 3).astype(np.float32),)
+               for _ in range(5)]
+    vae_trainer.fit(iter(batches), steps=5, metrics_writer=w,
+                    log=lambda *a, **k: None)
+    w.close()
+    recs = load_jsonl(mpath)
+    # the impossible floor (1e6) trips codebook-collapse exactly once —
+    # edge-triggered, even though every later step is also "collapsed"
+    assert sum(int(r.get("health/breach", 0)) for r in recs) == 1
+    rep = summarize_run(mpath)
+    assert "MODEL-HEALTH: DEGRADED (codebook-collapse in codebook" in rep
+
+
+def test_dalle_trainer_health_off_by_default(rng):
+    import tempfile
+    from dalle_tpu.config import (DalleConfig, MeshConfig, PrecisionConfig,
+                                  TrainConfig)
+    from dalle_tpu.parallel.mesh import build_mesh
+    from dalle_tpu.train.trainer_dalle import DalleTrainer
+    cfg = DalleConfig(num_text_tokens=32, text_seq_len=8, dim=32, depth=2,
+                      heads=2, dim_head=16, image_size=16,
+                      image_vocab_size=32, image_fmap_size=4)
+    tc = TrainConfig(batch_size=2, preflight_checkpoint=False,
+                     checkpoint_dir=tempfile.mkdtemp(), mesh=MeshConfig(),
+                     precision=PrecisionConfig(compute="float32"))
+    tr = DalleTrainer(cfg, tc, mesh=build_mesh(MeshConfig(),
+                                               devices=jax.devices()[:1]))
+    m = tr.train_step(rng.randint(1, 32, (2, 8)), rng.randint(0, 32, (2, 16)))
+    assert not any(k.startswith("health/") for k in m)
+    assert tr.health_sentry is None
+
+
+# ---------------------------------------------------------------------------
+# report: MODEL-HEALTH verdict + n/a hardening
+# ---------------------------------------------------------------------------
+
+def test_health_accounting_ok_and_degraded():
+    ok_rows = [{"step": 0, "health/grad_norm/enc": 1.0,
+                "health/codebook_perplexity": 9.0,
+                "health/codebook_dead_frac": 0.1}]
+    acc = health_accounting(ok_rows)
+    assert acc["verdict"] == "ok" and acc["perplexity"] == 9.0
+    bad_rows = ok_rows + [{"step": 1, "health/breach": 1,
+                           "health/breach_detector": "grad-explosion",
+                           "health/breach_group": "enc",
+                           "health/grad_norm/enc": 99.0}]
+    acc = health_accounting(bad_rows)
+    assert acc["verdict"] == "DEGRADED"
+    assert acc["detector"] == "grad-explosion" and acc["group"] == "enc"
+    rep = format_report(bad_rows)
+    assert "MODEL-HEALTH: DEGRADED (grad-explosion in enc; 1 breach)" in rep
+    assert health_accounting([{"step": 0, "loss": 1.0}]) is None
+
+
+def test_report_zero_requests_zero_steps_prints_na_not_nan(tmp_path):
+    # the obs_report hardening satellite: a gateway record with zero
+    # completed requests and no step samples must yield n/a, never NaN
+    rows = [{"step": 0, "time": 1.0, "gateway.inflight": 0.0,
+             "gateway.rejected_total": 0.0, "gateway.shed_total": 0.0}]
+    rep = format_report(rows)
+    assert "=nan" not in rep and " nan" not in rep
+    assert "n/a" in rep
+    assert "(no step samples — n/a)" in rep
+    # fully empty metrics file
+    p = tmp_path / "empty.jsonl"
+    p.write_text("")
+    from dalle_tpu.obs.report import summarize_run
+    assert "nan" not in summarize_run(str(p)).lower()
+
+
+def test_sentry_clears_breach_gauge_on_recovery(tracer):
+    sentry = anomaly.HealthSentry(
+        [anomaly.CodebookCollapseDetector(floor=4.0, min_samples=1)],
+        dump_bundles=False)
+    sentry.observe(0, {"health/codebook_perplexity": 1.0})
+    key = 'health.breach{detector="codebook-collapse",layer_group="codebook"}'
+    assert obs.metrics_snapshot()[key] == 1.0
+    sentry.observe(1, {"health/codebook_perplexity": 9.0})   # recovers
+    assert obs.metrics_snapshot()[key] == 0.0
+
+
+def test_collapse_detector_honors_min_samples_knob():
+    import types
+    oc = types.SimpleNamespace(health_loss_z=6.0, health_grad_factor=10.0,
+                               health_perplexity_floor=4.0,
+                               health_min_samples=4)
+    sentry = anomaly.HealthSentry.from_obs_config(oc)
+    det = next(d for d in sentry.detectors
+               if d.name == "codebook-collapse")
+    # a cold codebook's perplexity is legitimately low: the warmup knob
+    # must gate this detector too, not just the loss/grad EMAs
+    for step in range(3):
+        assert det.observe(step, {"health/codebook_perplexity": 1.0}) == []
+    assert len(det.observe(3, {"health/codebook_perplexity": 1.0})) == 1

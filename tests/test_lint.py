@@ -815,3 +815,76 @@ def test_compile_counter_counts_backend_compiles():
 def test_recompile_budget_marker_passes_under_budget():
     f = jax.jit(lambda x: x + 2)
     f(jnp.arange(39))
+
+
+# ---------------------------------------------------------------------------
+# unbounded-metric-label (rules_obs)
+# ---------------------------------------------------------------------------
+
+def test_unbounded_label_flags_trace_id_value():
+    src = """
+    from dalle_tpu.obs import counter_add, gauge_set
+    def f(req):
+        counter_add("serve.tokens_total", 1.0,
+                    labels={"request": req.trace_id})
+    """
+    found = lint_source("unbounded-metric-label", src)
+    assert len(found) == 1 and "trace_id" in found[0].message \
+        and "cardinality" in found[0].message
+
+
+def test_unbounded_label_sees_through_str_and_fstring():
+    src = """
+    from dalle_tpu.obs import gauge_set
+    def f(request_id, text):
+        gauge_set("a", 1.0, labels={"rid": str(request_id)})
+        gauge_set("b", 2.0, labels={"t": f"p:{text}"})
+    """
+    found = lint_source("unbounded-metric-label", src)
+    assert len(found) == 2
+
+
+def test_unbounded_label_catches_positional_labels_dict():
+    # labels is keyword-or-positional in counter_add/gauge_set — passing
+    # the dict positionally must not evade the rule
+    src = """
+    from dalle_tpu.obs import counter_add
+    def f(req):
+        counter_add("serve.x_total", 1.0, {"rid": req.request_id})
+    """
+    found = lint_source("unbounded-metric-label", src)
+    assert len(found) == 1 and "request_id" in found[0].message
+
+
+def test_unbounded_label_clean_on_bounded_dimensions():
+    # tenant / reason / window / layer_group are bounded dimensions — the
+    # blessed label uses across gateway/slo/graftpulse stay legal, as does
+    # a "trace_id" KEY whose value is bounded, and label-free calls
+    src = """
+    from dalle_tpu.obs import counter_add, gauge_set
+    def f(tenant, reason, group):
+        counter_add("gateway.rejected_by_total", 1.0,
+                    labels={"tenant": tenant, "reason": reason})
+        gauge_set("health.grad_norm", 1.0, labels={"layer_group": group})
+        gauge_set("slo.burn_rate", 2.0, labels={"window": "5m"})
+        gauge_set("x", 1.0, labels={"trace_id": "constant"})
+        counter_add("y", 1.0)
+    """
+    assert lint_source("unbounded-metric-label", src) == []
+
+
+def test_unbounded_label_suppression_and_scope():
+    src = """
+    from dalle_tpu.obs import gauge_set
+    def f(trace_id):
+        gauge_set("z", 1.0, labels={"rid": trace_id})  # graftlint: disable=unbounded-metric-label
+    """
+    assert lint_source("unbounded-metric-label", src) == []
+    # tests/ are out of the lint surface entirely
+    bare = """
+    from dalle_tpu.obs import gauge_set
+    def f(trace_id):
+        gauge_set("z", 1.0, labels={"rid": trace_id})
+    """
+    assert lint_source("unbounded-metric-label", bare,
+                       rel_path="tests/test_fixture.py") == []

@@ -3,6 +3,7 @@ counters/gauges, Prometheus textfile, device telemetry, stall watchdog, and
 the MetricsLogger/MFU satellites."""
 
 import json
+import os
 import threading
 import time
 
@@ -681,3 +682,92 @@ def test_report_gateway_by_tenant_parses_labeled_counters(tmp_path):
         obs_report.load_jsonl(path), [])
     assert gw["by_tenant"] == {"capped": 2, "best": 1}
     assert gw["verdict"] == "ADMISSION-LIMITED"
+
+
+# -- SIGUSR2 on-demand profiler (scripts/_common.py, PR 8 satellite) --------
+
+def _load_common():
+    import importlib.util
+    import os as _os
+    import sys as _sys
+    scripts = _os.path.join(_os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))), "scripts")
+    if scripts not in _sys.path:
+        _sys.path.insert(0, scripts)
+    spec = importlib.util.spec_from_file_location(
+        "_common_under_test", _os.path.join(scripts, "_common.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_sigusr2_profiler_bounded_single_capture(monkeypatch, tmp_path):
+    """The handler must start exactly ONE bounded capture even when a
+    second signal lands mid-capture, and the timer must stop it exactly
+    once — a profiler left running fills the disk, which is the failure
+    the bound exists to prevent."""
+    import signal
+    import types
+    import jax
+    _common = _load_common()
+    calls = {"start": 0, "stop": 0}
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda path: calls.__setitem__(
+                            "start", calls["start"] + 1))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: calls.__setitem__("stop", calls["stop"] + 1))
+    prev = signal.getsignal(signal.SIGUSR2)
+    try:
+        args = types.SimpleNamespace(profiler_dir=None,
+                                     profiler_capture_s=0.15)
+        assert _common.install_sigusr2_profiler(str(tmp_path), args)
+        handler = signal.getsignal(signal.SIGUSR2)
+        assert callable(handler)
+        handler(signal.SIGUSR2, None)
+        # concurrent second signal while the capture is active: ignored
+        # (one capture at a time — the active latch, not a second trace)
+        handler(signal.SIGUSR2, None)
+        assert calls["start"] == 1
+        deadline = time.time() + 5.0
+        while calls["stop"] == 0 and time.time() < deadline:
+            time.sleep(0.01)
+        assert calls["stop"] == 1, "bounded capture did not stop"
+        assert calls["start"] == 1
+        # capture dirs are timestamped under the target dir
+        assert any(n.startswith("profile_") for n in os.listdir(str(tmp_path)))
+    finally:
+        signal.signal(signal.SIGUSR2, prev)
+
+
+def test_sigusr2_profiler_rearms_after_stop(monkeypatch, tmp_path):
+    import signal
+    import types
+    import jax
+    _common = _load_common()
+    calls = {"start": 0, "stop": 0}
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda path: calls.__setitem__(
+                            "start", calls["start"] + 1))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: calls.__setitem__("stop", calls["stop"] + 1))
+    prev = signal.getsignal(signal.SIGUSR2)
+    try:
+        args = types.SimpleNamespace(profiler_dir=None,
+                                     profiler_capture_s=0.05)
+        assert _common.install_sigusr2_profiler(str(tmp_path), args)
+        handler = signal.getsignal(signal.SIGUSR2)
+        handler(signal.SIGUSR2, None)
+        deadline = time.time() + 5.0
+        while calls["stop"] == 0 and time.time() < deadline:
+            time.sleep(0.01)
+        handler(signal.SIGUSR2, None)   # a NEW capture after the stop
+        assert calls["start"] == 2
+    finally:
+        signal.signal(signal.SIGUSR2, prev)
+
+
+def test_sigusr2_profiler_disabled_via_flag(tmp_path):
+    import types
+    _common = _load_common()
+    args = types.SimpleNamespace(profiler_dir="off", profiler_capture_s=1.0)
+    assert _common.install_sigusr2_profiler(str(tmp_path), args) is False

@@ -444,3 +444,49 @@ def test_engine_spans_and_gauges(model_params):
         assert m["serve.queue_wait_s"] >= 0
     finally:
         obs.disable()
+
+
+def test_engine_decode_health_exact_with_quality_telemetry(model_params):
+    """graftpulse decode-quality taps (engine decode_health=True): tokens
+    stay BIT-exact vs the untapped engine and the single-request reference
+    (the taps read the logits, consume no rng), and each completed request's
+    serve/request span carries entropy / topk_mass / repeat_ratio args while
+    the aggregate dalle_health_decode_* gauges go live."""
+    import math
+    from dalle_tpu import obs
+    model, params = model_params
+    refs = {i: _reference(model, params, t, 40 + i)
+            for i, t in enumerate(TEXTS[:3])}
+
+    def run(decode_health):
+        q = RequestQueue()
+        for i, t in enumerate(TEXTS[:3]):
+            q.submit(t, seed=40 + i, request_id=i)
+        q.close()
+        eng = DecodeEngine(model, params, slots=2,
+                           decode_health=decode_health)
+        return eng.run(q)
+
+    plain = {c.request_id: c.tokens for c in run(False)}
+    tracer = obs.configure()
+    try:
+        tapped = run(True)
+        for c in tapped:
+            np.testing.assert_array_equal(c.tokens, refs[c.request_id])
+            np.testing.assert_array_equal(c.tokens, plain[c.request_id])
+        qspans = [args for name, _r, _d, _t, _dep, args
+                  in tracer.snapshot_spans() if name == "serve/request"]
+        assert len(qspans) == 3
+        for args in qspans:
+            assert math.isfinite(args["entropy"]) and args["entropy"] >= 0
+            assert 0.0 <= args["topk_mass"] <= 1.0 + 1e-6
+            assert 0.0 <= args["repeat_ratio"] <= 1.0
+            assert "trace_id" in args   # per-request values ride span args,
+            # never metric labels (graftlint: unbounded-metric-label)
+        m = obs.metrics_snapshot()
+        for g in ("health.decode_entropy", "health.decode_topk_mass",
+                  "health.decode_repeat_ratio"):
+            assert g in m, g
+        assert not any("{" in k and "trace_id" in k for k in m)
+    finally:
+        obs.disable()
