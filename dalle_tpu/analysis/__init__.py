@@ -38,4 +38,5 @@ from . import rules_scatter  # noqa: F401,E402
 from . import rules_weaktype  # noqa: F401,E402
 from . import rules_precision  # noqa: F401,E402
 from . import rules_obs  # noqa: F401,E402
+from . import rules_distributed  # noqa: F401,E402
 from . import rules_coverage  # noqa: F401,E402

@@ -1,0 +1,137 @@
+"""Distributed-I/O hygiene — codifying the graftmend retry-layer lesson.
+
+A production pod fails at the edges: the coordinator isn't listening yet
+when a rejoining worker dials in, a checkpoint write races a filesystem
+blip. ``utils/retry.py`` exists so those single-attempt edges absorb
+transient failures with jittered backoff and obs counters — but only at
+call sites that actually route through it. This rule makes a bare edge a
+lint finding instead of a 3 a.m. page:
+
+  * ``unguarded-distributed-io`` — a ``jax.distributed.initialize(...)``
+    call, or a ``save``/``restore`` call on an orbax manager handle (the
+    ``_mgr`` naming convention set by ``train/checkpoints.py``), that is
+    not executed under the retry layer. "Under the retry layer" is
+    recognized syntactically (the rules_jit trade): the call sits inside a
+    function decorated with ``@retry(...)``, or inside a function whose
+    name is passed to ``with_retry(...)``/``retry(...)(...)`` in the same
+    module. A deliberate single-attempt call takes a one-line suppression
+    next to the code with the why.
+
+The runtime half of the story lives in ``dalle_tpu/utils/retry.py``
+(policy, counters) and ``scripts/chaos_smoke.py`` (the CI stage that
+injects coordinator/checkpoint faults and asserts they are absorbed, not
+fatal — docs/RESILIENCE.md).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from .core import FileContext, Finding, Rule, register_rule
+from .jit_scan import dotted_name
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+# the RAW orbax handle naming convention (train/checkpoints.py). The
+# public CheckpointManager.save/restore wrappers are themselves the
+# retry layer, so calls on a `mgr`-named wrapper instance are not flagged.
+_MGR_NAMES = ("_mgr",)
+_MGR_METHODS = ("save", "restore")
+
+
+def _call_name(node: ast.Call) -> Optional[str]:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def _retry_guarded_names(tree: ast.AST) -> Set[str]:
+    """Function names executed under the retry layer: arguments of
+    ``with_retry(op, fn, ...)`` calls and targets of ``retry(...)(fn)``
+    immediate application."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if name == "with_retry":
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    out.add(arg.id)
+        # retry("op", ...)(fn): the decorator factory applied inline
+        if (isinstance(node.func, ast.Call)
+                and _call_name(node.func) == "retry"):
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    out.add(arg.id)
+    return out
+
+
+def _has_retry_decorator(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = (target.attr if isinstance(target, ast.Attribute)
+                else target.id if isinstance(target, ast.Name) else "")
+        if name in ("retry", "with_retry"):
+            return True
+    return False
+
+
+def _is_distributed_init(node: ast.Call) -> bool:
+    name = dotted_name(node.func) or ""
+    return name.endswith("distributed.initialize")
+
+
+def _is_mgr_io(node: ast.Call) -> bool:
+    fn = node.func
+    if not (isinstance(fn, ast.Attribute) and fn.attr in _MGR_METHODS):
+        return False
+    recv = fn.value
+    # self._mgr.save(...) / mgr.restore(...): the receiver chain must name
+    # an orbax manager handle — plain .save()/.restore() on anything else
+    # (a model, a figure) is not this rule's business
+    for sub in ast.walk(recv):
+        if isinstance(sub, ast.Attribute) and sub.attr in _MGR_NAMES:
+            return True
+        if isinstance(sub, ast.Name) and sub.id in _MGR_NAMES:
+            return True
+    return False
+
+
+@register_rule
+class UnguardedDistributedIO(Rule):
+    name = "unguarded-distributed-io"
+    description = (
+        "jax.distributed.initialize or an orbax manager save/restore "
+        "call outside the retry layer (utils/retry.py) — a transient "
+        "coordinator/filesystem blip becomes a dead worker instead of a "
+        "few ms of jittered backoff; wrap the call in @retry/with_retry "
+        "or suppress with the why")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        guarded = _retry_guarded_names(ctx.tree)
+
+        def walk(node: ast.AST, stack: List[ast.AST]):
+            if isinstance(node, _FUNC_NODES):
+                stack = stack + [node]
+            if isinstance(node, ast.Call):
+                kind = ("jax.distributed.initialize"
+                        if _is_distributed_init(node)
+                        else f"orbax manager .{node.func.attr}()"
+                        if _is_mgr_io(node) else None)
+                if kind is not None and not any(
+                        fn.name in guarded or _has_retry_decorator(fn)
+                        for fn in stack):
+                    yield Finding(
+                        self.name, ctx.rel_path, node.lineno,
+                        f"{kind} runs single-attempt — route it through "
+                        "the retry layer (utils/retry.py: @retry or "
+                        "with_retry) so transient failures back off "
+                        "instead of killing the run")
+            for child in ast.iter_child_nodes(node):
+                yield from walk(child, stack)
+
+        yield from walk(ctx.tree, [])

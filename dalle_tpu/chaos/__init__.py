@@ -1,0 +1,28 @@
+"""graftmend chaos harness — deterministic fault injection for elastic
+training (docs/RESILIENCE.md).
+
+A resilience layer that has never seen a failure is a hypothesis, not a
+feature. This package injects the failures on purpose, from a scripted,
+seeded :class:`~dalle_tpu.chaos.faults.FaultPlan`: kill/hang/slow a worker
+at step N, fail checkpoint or coordinator I/O k times before healing,
+corrupt a checkpoint on disk. Hook points are compiled into the real code
+paths (``BaseTrainer.fit`` step boundaries, ``CheckpointManager`` I/O,
+``JaxBackend`` coordinator connect, elastic heartbeat writes) and cost one
+module-global ``None`` check when no plan is installed — the ``obs.span``
+discipline.
+
+``scripts/chaos_smoke.py`` runs the scenario catalog over the real
+2-process gloo/DCN path and asserts the recovery invariant each time:
+post-recovery state bitwise-identical to an uninterrupted run at the same
+step.
+"""
+
+from .faults import (EPOCH_ENV, PLAN_ENV, RANK_ENV, Fault, FaultPlan,
+                     InjectedFault, active_plan, corrupt_checkpoint, install,
+                     install_from_env, io_hook, step_hook, uninstall)
+
+__all__ = [
+    "EPOCH_ENV", "PLAN_ENV", "RANK_ENV", "Fault", "FaultPlan",
+    "InjectedFault", "active_plan", "corrupt_checkpoint", "install",
+    "install_from_env", "io_hook", "step_hook", "uninstall",
+]

@@ -474,6 +474,15 @@ class TrainConfig(ConfigBase):
     #              (bytes_limit known and in_use + 1.15×snapshot < limit,
     #              or no limit reported, e.g. CPU), else host
     rollback_snapshot: str = "auto"
+    # graftmend (train/actions.py, docs/RESILIENCE.md): give TrainState a
+    # runtime lr_scale data leaf so breach actions can cut the learning
+    # rate host-side without a recompile. Opt-in (armed by the CLIs'
+    # --breach_actions): the leaf adds one multiply per param leaf to the
+    # compiled step, which is free at runtime but measurably taxes
+    # COMPILE time across the suite's fleet of trainer programs, and
+    # arming must happen at state creation (a mid-run treedef change
+    # would break the step's pinned out_shardings)
+    runtime_lr_scale: bool = False
     # double-buffered device prefetch depth for fit(): while step N runs, the
     # next `device_prefetch` batches are already converted + device_put with
     # their target shardings, so batch-wait + H2D leave the device critical

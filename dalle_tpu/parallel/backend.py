@@ -29,7 +29,9 @@ from typing import Any, Optional
 import jax
 import numpy as np
 
+from ..chaos import io_hook
 from ..config import MeshConfig
+from ..utils.retry import TRANSIENT, with_retry
 from .mesh import build_mesh, single_device_mesh
 
 
@@ -125,6 +127,11 @@ class JaxBackend(DistributedBackend):
 
     BACKEND_NAME = "jax"
 
+    # coordinator-connect retry policy (utils/retry.py); class-level so the
+    # elastic runtime / tests can widen or pin it fleet-wide
+    connect_retry_kw = {"attempts": 5, "base_delay_s": 0.2,
+                        "max_delay_s": 2.0}
+
     def wrap_arg_parser(self, parser):
         grp = parser.add_argument_group("jax distributed backend")
         grp.add_argument("--coordinator_address", type=str, default=None,
@@ -174,12 +181,38 @@ class JaxBackend(DistributedBackend):
                     and current in (None, "none")):
                 jax.config.update("jax_cpu_collectives_implementation", "gloo")
             # pid None → jax.distributed.initialize infers it from platform
-            # metadata (the TPU-pod norm); forcing 0 would collide across hosts
-            jax.distributed.initialize(
-                coordinator_address=coord,
-                num_processes=int(nproc),
-                process_id=pid,
-            )
+            # metadata (the TPU-pod norm); forcing 0 would collide across
+            # hosts. The connect is retried with jittered backoff
+            # (utils/retry.py): worker N dialing in before the coordinator
+            # listens — routine during elastic reconfiguration, when every
+            # survivor restarts at once — used to be a single attempt and a
+            # dead worker. XlaRuntimeError (DEADLINE_EXCEEDED and friends)
+            # is a RuntimeError, hence the widened retry_on; a genuinely
+            # unreachable coordinator still fails after the budget, which
+            # the elastic agent treats as a failed epoch.
+            def _connect():
+                io_hook("coordinator_connect")   # chaos injection point
+                try:
+                    jax.distributed.initialize(
+                        coordinator_address=coord,
+                        num_processes=int(nproc),
+                        process_id=pid,
+                    )
+                except Exception:  # noqa: BLE001 - any failed dial must
+                    # reset the process-global distributed state before
+                    # re-raising: jax assigns the client BEFORE connecting,
+                    # so without the shutdown every later attempt would die
+                    # on "initialize should only be called once" instead of
+                    # actually redialing
+                    try:
+                        jax.distributed.shutdown()
+                    except Exception:  # noqa: BLE001 - nothing was
+                        pass           # initialized; keep the real error
+                    raise
+
+            with_retry("coordinator_connect", _connect,
+                       retry_kw=dict(self.connect_retry_kw,
+                                     retry_on=TRANSIENT + (RuntimeError,)))
         self.mesh = build_mesh(mesh_config)
 
     def _get_world_size(self) -> int:

@@ -1,0 +1,176 @@
+"""graftmend breach→action automation: the policy layer that makes the
+graftpulse sentries DO something (docs/RESILIENCE.md).
+
+PRs 2–9 built detectors that can see a run dying — loss-spike z-scores,
+per-layer-group grad explosions, codebook-collapse perplexity floors,
+nan-precursor inf fractions (:mod:`dalle_tpu.obs.anomaly`). Until now a
+breach paged (gauge + flight bundle) and the operator intervened by hand.
+:class:`BreachActions` closes the loop with one policy action per breach
+class, each applied host-side between steps so NOTHING here touches the
+compiled program:
+
+  * ``nan-precursor`` → **preemptive snapshot**
+    (``BaseTrainer.take_preemptive_snapshot``): the classic divergence
+    shape is inf-in-grads → loss NaN a few steps later, and the NaN
+    rollback rewinds to the last save boundary. Snapshotting at the
+    precursor means the eventual rollback burns breach→NaN steps (usually
+    a handful) instead of up to ``save_every_steps``. The rung is
+    one-shot: if the precursor state itself was already contaminated, the
+    second rollback falls through to the durable boundary snapshot.
+  * ``grad-explosion`` → **rollback + lr cut**: restore the last good
+    (params, opt_state) immediately — don't wait for the NaN — and scale
+    the learning rate down by ``lr_cut_factor`` so the restored state
+    doesn't march straight back into the same cliff. The cut writes
+    ``TrainState.lr_scale`` (a data leaf — no recompile) and is clamped at
+    ``min_lr_scale`` so repeated breaches can't silently zero the run.
+  * ``codebook-collapse`` → **lr cut + gumbel re-anneal**: a collapsed
+    codebook at low gumbel temperature is frozen — the straight-through
+    gradients all route through the same few codes. Re-annealing (restart
+    the temperature schedule from the breach step, for trainers that
+    expose ``reanneal_gumbel``) re-softens the assignment distribution so
+    unused codes see gradient again, and the lr cut keeps the re-warmed
+    phase from tearing up the encoder.
+  * ``loss-spike`` → **no action** by default (a spike is the precursor's
+    precursor; acting on it double-fires with the detectors above). Policy
+    is data: pass ``policy={...}`` to remap.
+
+Discipline (mirrors the sentry's): actions are EDGE-TRIGGERED — the sentry
+only delivers ok→breach transitions, and this layer additionally coalesces
+one action kind per step (five layer groups exploding in one boundary is
+ONE rollback, not five) and honors an optional ``cooldown_steps``. Every
+fired action emits a ``breach_action`` flight-recorder event, an
+``actions.fired_total{action=}`` counter and an ``actions.lr_scale``
+gauge, so post-mortems show what the automation did, not just what it saw.
+A failing action degrades to a logged error — the policy layer must never
+kill the training loop it protects.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from ..obs import counter_add, gauge_set, record_event
+from ..obs.anomaly import Breach, HealthSentry
+
+# detector name -> action name (the policy table in docs/RESILIENCE.md)
+DEFAULT_POLICY: Dict[str, str] = {
+    "nan-precursor": "preemptive_snapshot",
+    "grad-explosion": "rollback_lr_cut",
+    "codebook-collapse": "lr_cut_reanneal",
+}
+
+
+class BreachActions:
+    """Callable policy object wired as ``HealthSentry.on_breach``.
+
+    ``attach()`` binds it to the trainer's sentry (creating one from the
+    trainer's ObsConfig if ``fit`` hasn't yet), chaining — not replacing —
+    any existing ``on_breach`` sink."""
+
+    def __init__(self, trainer, *, policy: Optional[Dict[str, str]] = None,
+                 lr_cut_factor: float = 0.5, min_lr_scale: float = 1e-3,
+                 cooldown_steps: int = 0, log=print):
+        self.trainer = trainer
+        self.policy = dict(DEFAULT_POLICY if policy is None else policy)
+        self.lr_cut_factor = float(lr_cut_factor)
+        self.min_lr_scale = float(min_lr_scale)
+        self.cooldown_steps = int(cooldown_steps)
+        self.log = log
+        self.fired = []                    # (step, action, detector, group)
+        self._last_fired: Dict[str, int] = {}   # action -> step
+        self._handlers: Dict[str, Callable[[Breach], None]] = {
+            "preemptive_snapshot": self._act_preemptive_snapshot,
+            "rollback_lr_cut": self._act_rollback_lr_cut,
+            "lr_cut_reanneal": self._act_lr_cut_reanneal,
+        }
+
+    # -- wiring ------------------------------------------------------------
+    def attach(self) -> "BreachActions":
+        """Bind to the trainer's HealthSentry (building it from
+        ``train_cfg.obs`` when fit() hasn't run yet — fit's ``is None``
+        check then reuses the same sentry, so EMA baselines are shared)."""
+        sentry = self.trainer.health_sentry
+        if sentry is None:
+            sentry = HealthSentry.from_obs_config(self.trainer.train_cfg.obs)
+            self.trainer.health_sentry = sentry
+        prev = sentry.on_breach
+        if prev is None:
+            sentry.on_breach = self
+        else:
+            def chained(breach, _prev=prev, _self=self):
+                _prev(breach)
+                _self(breach)
+            sentry.on_breach = chained
+        return self
+
+    # -- dispatch ----------------------------------------------------------
+    def __call__(self, breach: Breach) -> None:
+        action = self.policy.get(breach.detector)
+        if action is None:
+            return
+        handler = self._handlers.get(action)
+        if handler is None:
+            self.log(f"[actions] unknown action {action!r} for "
+                     f"{breach.detector}; ignoring")
+            return
+        last = self._last_fired.get(action)
+        if last is not None and (breach.step == last
+                                 or breach.step - last < self.cooldown_steps):
+            # coalesce: N groups breaching in one boundary = one action;
+            # cooldown bounds the rate across boundaries
+            return
+        self._last_fired[action] = breach.step
+        try:
+            handler(breach)
+        except Exception as exc:  # noqa: BLE001 - a policy bug must degrade
+            # to a missed remediation, never kill the run it protects
+            self.log(f"[actions] {action} failed on {breach.detector} "
+                     f"breach: {exc!r}")
+            return
+        self.fired.append((breach.step, action, breach.detector,
+                           breach.layer_group))
+        counter_add("actions.fired_total", 1.0, labels={"action": action})
+        record_event("breach_action", action=action,
+                     detector=breach.detector, layer_group=breach.layer_group,
+                     step=breach.step, value=breach.value)
+        self.log(f"[actions] step {breach.step}: {breach.detector} breach "
+                 f"in [{breach.layer_group}] → {action}")
+
+    # -- the actions -------------------------------------------------------
+    def _act_preemptive_snapshot(self, breach: Breach) -> None:
+        self.trainer.take_preemptive_snapshot()
+
+    def _act_rollback_lr_cut(self, breach: Breach) -> None:
+        self.trainer._rollback()
+        self._cut_lr()
+
+    def _act_lr_cut_reanneal(self, breach: Breach) -> None:
+        self._cut_lr()
+        reanneal = getattr(self.trainer, "reanneal_gumbel", None)
+        if reanneal is not None:
+            reanneal(breach.step)
+
+    def _cut_lr(self) -> float:
+        """Multiply ``TrainState.lr_scale`` by the cut factor (clamped at
+        ``min_lr_scale``). A data-leaf write placed with the old leaf's
+        sharding — same program signature, no recompile; one scalar
+        device_get per breach (rare) is the whole host cost."""
+        import jax
+        import jax.numpy as jnp
+        state = self.trainer.state
+        # getattr: GANTrainState (full-GAN VQGAN) has no lr_scale FIELD at
+        # all, and un-armed TrainStates carry None — both degrade to a
+        # logged skip, never an AttributeError that would eat the action
+        old = getattr(state, "lr_scale", None)
+        if old is None:
+            self.log("[actions] state has no lr_scale leaf; lr cut skipped")
+            return 1.0
+        new = max(float(jax.device_get(old)) * self.lr_cut_factor,
+                  self.min_lr_scale)
+        leaf = jnp.asarray(new, jnp.float32)
+        sharding = getattr(old, "sharding", None)
+        if sharding is not None:
+            leaf = jax.device_put(leaf, sharding)
+        self.trainer.state = state.replace(lr_scale=leaf)
+        gauge_set("actions.lr_scale", new)
+        return new

@@ -13,6 +13,7 @@ from typing import Callable, Optional
 import jax
 import numpy as np
 
+from ..chaos import step_hook as _chaos_step_hook
 from ..config import TrainConfig
 from ..obs import (DeviceTelemetry, StallWatchdog, export_chrome_trace,
                    export_spans_jsonl, span)
@@ -58,6 +59,13 @@ class BaseTrainer:
     # set; every fetched metrics dict passes through _health_observe once
     health_sentry = None
     _health_last_step = -1
+    # graftmend (docs/RESILIENCE.md): SIGTERM graceful-preemption latch and
+    # the one-shot preemptive-snapshot rung (train/actions.py nan-precursor
+    # action) — class-level so duck-typed FakeTrainers satisfy fit()
+    _preempt = False
+    preempted = False
+    _preemptive_good = None
+    _preemptive_good_device = None
 
     def __init__(self, train_cfg: TrainConfig, mesh=None, backend=None):
         self.train_cfg = train_cfg
@@ -117,6 +125,27 @@ class BaseTrainer:
 
         self._signal_save = False
         signal.signal(signal.SIGUSR1, handler)
+
+    def install_preemption_handler(self, log=print):
+        """SIGTERM → graceful preemption (the k8s/TPU-preemption contract,
+        docs/RESILIENCE.md): the handler only latches flags; ``fit`` then
+        finishes the in-flight step, forces a synchronous save through the
+        SIGUSR1-latch path (which drains async checkpointing), and returns
+        with ``self.preempted`` set so the CLI exits 0 with the state
+        durable. A second SIGTERM during the wind-down is idempotent."""
+        import signal
+
+        def handler(_sig, _frame):
+            self._signal_save = True
+            self._preempt = True
+            log("SIGTERM: graceful preemption — will checkpoint at the "
+                "next step boundary and exit")
+
+        self._preempt = False
+        self.preempted = False
+        # materialize the latch without clobbering a pending SIGUSR1 save
+        self._signal_save = getattr(self, "_signal_save", False)
+        signal.signal(signal.SIGTERM, handler)
 
     def _fetch_pending_metrics(self) -> dict:
         """Host-fetch the most recent step's device metrics (used when a save
@@ -231,7 +260,8 @@ class BaseTrainer:
 
     def fit(self, batches, *, steps: Optional[int] = None, log=print,
             sample_fn: Optional[Callable[[int], None]] = None,
-            metrics_writer=None):
+            metrics_writer=None,
+            on_step: Optional[Callable[[int], None]] = None):
         """Epoch-agnostic loop over ``batches`` (iterable of tuples fed to
         ``train_step``) with the reference's parity behaviors.
 
@@ -268,7 +298,17 @@ class BaseTrainer:
         ``obs.watchdog_deadline_s > 0`` a heartbeat watchdog reports stalls
         (open spans + thread stacks) instead of hanging silently; with
         ``obs.trace`` the span ring is exported as Perfetto-openable
-        ``trace.json`` + ``spans.jsonl`` when the loop ends."""
+        ``trace.json`` + ``spans.jsonl`` when the loop ends.
+
+        graftmend (docs/RESILIENCE.md): every iteration passes through the
+        chaos hook (``chaos.step_hook`` — a no-op ``None`` check unless a
+        FaultPlan is installed); ``on_step(step)`` is called after each
+        completed step (the elastic runtime's heartbeat point — exceptions
+        it raises propagate, which is how an elastic worker aborts the loop
+        on a membership change); and after
+        :meth:`install_preemption_handler`, a SIGTERM finishes the in-
+        flight step, forces a synchronous drained save, sets
+        ``self.preempted`` and returns — callers then exit 0."""
         tc = self.train_cfg
         oc = getattr(tc, "obs", None)
         tracing = bool(oc is not None and oc.trace)
@@ -339,6 +379,10 @@ class BaseTrainer:
                     k_this = batch[0].shape[0] if stacked else 1
                     prev_step = self._host_step
                     step_span.set(step=prev_step)
+                    # chaos injection point: kill/hang/slow/corrupt faults
+                    # fire here, BEFORE the dispatch — "mid-step" from the
+                    # run's point of view (the last durable save < this step)
+                    _chaos_step_hook(prev_step)
                     self._obs_dispatch_t0 = time.perf_counter()
                     # profile the REAL step containing profile_step — no
                     # hidden extra update (the reference's flops profile also
@@ -355,6 +399,8 @@ class BaseTrainer:
                     step_num = self._host_step
                     if watchdog is not None:
                         watchdog.beat(step_num)
+                    if on_step is not None:
+                        on_step(step_num)
                     # latch the signal flag ONCE per iteration; a save
                     # decision must see the same value the metrics-fetch
                     # decision does
@@ -410,8 +456,13 @@ class BaseTrainer:
                                 # async manager: returns after the snapshot;
                                 # the write overlaps the next steps. An
                                 # operator-requested (SIGUSR1) save drains so
-                                # the latch means "durable now".
-                                self.ckpt.save(step_num, self.state, meta)
+                                # the latch means "durable now". Metadata is
+                                # re-evaluated per save: extra_meta can
+                                # change mid-run (the gumbel re-anneal
+                                # action records its rebase there) and the
+                                # sidecar must carry the CURRENT values
+                                self.ckpt.save(step_num, self.state,
+                                               self._meta())
                                 if signal_save:
                                     self._ckpt_wait()
                                 self._snapshot_good()
@@ -432,9 +483,22 @@ class BaseTrainer:
                                     os.path.join(tc.checkpoint_dir, str(step_num)),
                                     name=f"trained-{self.model_class.lower()}",
                                     metadata={"step": step_num})
+                        if want_save and getattr(self, "_preempt", False):
+                            # SIGTERM wind-down: the save above ran through
+                            # the signal-latch path (synchronous + drained),
+                            # so the state is durable — exit the loop; the
+                            # CLI then exits 0. A NaN at this boundary skips
+                            # the save, so the latch stays set and the NEXT
+                            # boundary (post-rollback, finite) winds down.
+                            self.preempted = True
+                            self._preempt = False
+                            log(f"[step {step_num}] graceful preemption: "
+                                f"checkpoint durable; exiting fit")
                         if sample_fn and crossed(prev_step, step_num,
                                                  getattr(tc, "sample_every_steps", 0)):
                             sample_fn(step_num)
+                if self.preempted:
+                    break
                 # the steps budget must bound the loop even when steps go NaN
                 if steps is not None and step_num >= steps:
                     break
@@ -539,6 +603,11 @@ class BaseTrainer:
         # new one needs), and holding both through the copy would spike to
         # 3× the state footprint
         self._last_good_device = None
+        # a fresh boundary snapshot supersedes any parked preemptive rung
+        # (which is now the OLDER state — rolling back to it would discard
+        # progress the boundary snapshot preserves)
+        self._preemptive_good = None
+        self._preemptive_good_device = None
         mode = self._snapshot_mode(live)
         with span("ckpt/snapshot_good", mode=mode):
             if mode == "device":
@@ -550,6 +619,29 @@ class BaseTrainer:
                 self._last_good = jax.device_get(live)
                 self._last_good_device = None
 
+    def take_preemptive_snapshot(self):
+        """graftmend breach→action (train/actions.py): copy the CURRENT
+        (params, opt_state) into a ONE-SHOT rung above the save-boundary
+        snapshot. Fired on a nan-precursor breach — the classic divergence
+        shape is inf-in-grads → loss NaN a few steps later, and without
+        this rung the eventual rollback rewinds to the last save boundary,
+        burning up to ``save_every_steps`` of progress. The first rollback
+        consumes this rung (burn ≈ breach→NaN steps); if the restored
+        state goes NaN again — the precursor state itself was already
+        contaminated — the next rollback falls through to the durable
+        boundary snapshot, so the ladder never loops on a poisoned rung.
+        Same device/host placement policy as :meth:`_snapshot_good`."""
+        live = (self.state.params, self.state.opt_state)
+        self._preemptive_shardings = jax.tree.map(lambda x: x.sharding, live)
+        self._preemptive_good = None
+        self._preemptive_good_device = None
+        mode = self._snapshot_mode(live)
+        with span("ckpt/preemptive_snapshot", mode=mode):
+            if mode == "device":
+                self._preemptive_good_device = _tree_copy(live)
+            else:
+                self._preemptive_good = jax.device_get(live)
+
     def _rollback(self):
         # metrics computed from the poisoned state must die with it: a
         # parked (defer_metrics) NaN record would otherwise trigger a
@@ -558,7 +650,17 @@ class BaseTrainer:
         self._deferred_metrics = None
         self._pending_metrics = None
         with span("ckpt/rollback"):
-            if self._last_good_device is not None:
+            if self._preemptive_good_device is not None:
+                # one-shot rung: install directly (no defensive copy — the
+                # rung is consumed; a repeat NaN falls to the boundary
+                # snapshot below, never back here)
+                restored, self._preemptive_good_device = (
+                    self._preemptive_good_device, None)
+            elif self._preemptive_good is not None:
+                host, self._preemptive_good = self._preemptive_good, None
+                restored = jax.tree.map(jax.device_put, host,
+                                        self._preemptive_shardings)
+            elif self._last_good_device is not None:
                 # install a COPY: the restored tree becomes the live state and
                 # gets donated into the next step — the snapshot itself must
                 # stay valid in case that step goes NaN again

@@ -19,18 +19,40 @@ SIGUSR1 latch path), and at ``close()``/atexit — an interrupted write never
 finalizes its step directory, and orbax lists only finalized steps, so a save
 racing process exit leaves either a complete checkpoint or an ignored
 ``*.orbax-checkpoint-tmp-*`` directory, never a truncated one.
+
+graftmend resilience layers (docs/RESILIENCE.md):
+
+  * **Retried I/O** — the orbax save/restore calls run under the
+    jittered-backoff retry policy (``utils/retry.py``), so a transient
+    filesystem blip costs milliseconds of backoff instead of a dead run;
+    absorbed failures show as ``retry.attempts_total{op="ckpt_save"|
+    "ckpt_restore"}``. The chaos harness injects exactly here
+    (``chaos.io_hook`` inside the retried callable).
+  * **Stale-tmp GC** — interrupted ``*-tmp-*`` directories used to pile up
+    forever; :meth:`CheckpointManager.gc_stale_tmp` sweeps them on
+    ``restore``/``preflight``, skipping any younger than a grace window so
+    a sibling process's in-flight write is never deleted under it.
+  * **Corruption fallback** — a latest-step restore that fails (torn or
+    bitrotted files) falls back to the next older durable step instead of
+    raising, counted as ``ckpt.restore_fallback_total`` and recorded as a
+    flight event; an explicitly pinned ``step`` still raises (the caller
+    asked for THAT state).
 """
 
 from __future__ import annotations
 
 import atexit
 import os
+import shutil
+import time
 import weakref
 from typing import Any, Optional
 
 import orbax.checkpoint as ocp
 
-from ..obs import gauge_set, span
+from ..chaos import io_hook
+from ..obs import counter_add, gauge_set, record_event, span
+from ..utils.retry import RetryBudgetExceeded, with_retry
 
 # every live manager, drained at interpreter exit so an in-flight background
 # write can finish before the process dies (a WeakSet: test suites create
@@ -49,6 +71,20 @@ def _inflight_delta(d: int) -> None:
     gauge_set("ckpt.write_inflight", _inflight_count)
 
 
+def _newest_mtime(path: str) -> float:
+    """Most recent mtime in ``path``'s tree (the path itself for files) —
+    the liveness signal for a possibly-in-flight checkpoint tmp dir."""
+    newest = os.path.getmtime(path)
+    for dirpath, _dirs, files in os.walk(path):
+        for name in files:
+            try:
+                newest = max(newest,
+                             os.path.getmtime(os.path.join(dirpath, name)))
+            except OSError:
+                continue   # file finalized/vanished mid-walk
+    return newest
+
+
 @atexit.register
 def _drain_live_managers():
     for mgr in list(_LIVE_MANAGERS):
@@ -59,11 +95,19 @@ def _drain_live_managers():
 
 
 class CheckpointManager:
+    # retry policy for the orbax I/O calls (utils/retry.py); instance-
+    # overridable so tests pin a fake sleep / tighter budget
+    retry_kw = {"attempts": 4, "base_delay_s": 0.05, "max_delay_s": 1.0}
+
     def __init__(self, directory: str, keep_n: Optional[int] = None,
-                 async_save: bool = False):
+                 async_save: bool = False, tmp_grace_s: float = 600.0):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.async_save = bool(async_save)
+        # stale-tmp sweep threshold: an interrupted write's *-tmp-* dir is
+        # reclaimable once it is plausibly ownerless; anything younger may
+        # be a sibling process's in-flight write and survives the sweep
+        self.tmp_grace_s = float(tmp_grace_s)
         opts = ocp.CheckpointManagerOptions(
             max_to_keep=keep_n, create=True,
             enable_async_checkpointing=self.async_save)
@@ -82,10 +126,19 @@ class CheckpointManager:
         args = {"state": ocp.args.PyTreeSave(state)}
         if metadata is not None:
             args["metadata"] = ocp.args.JsonSave(metadata)
+
+        def _do_save():
+            io_hook("ckpt_save")     # chaos injection point (fail_io)
+            return self._mgr.save(step, args=ocp.args.Composite(**args))
+
         # orbax itself drains any still-running previous save at the top of
-        # save() — back-to-back boundaries (rotation pressure) self-serialize
+        # save() — back-to-back boundaries (rotation pressure) self-serialize.
+        # The retry absorbs transient I/O failures (attempts that reached
+        # orbax and tore leave only an unfinalized *-tmp-* dir, which the
+        # stale-tmp GC reclaims; a same-step re-save after finalization
+        # raises a non-transient error and propagates immediately).
         with span("ckpt/snapshot", step=step, asynchronous=self.async_save):
-            self._mgr.save(step, args=ocp.args.Composite(**args))
+            with_retry("ckpt_save", _do_save, retry_kw=self.retry_kw)
         if self.async_save:
             if self.in_flight_step is None:
                 _inflight_delta(+1)   # orbax drained any previous write above
@@ -103,21 +156,173 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
-    def restore(self, state_template: Any, step: Optional[int] = None):
+    def _restore_step(self, state_template: Any, step: int):
+        """One step's retried restore (transient I/O absorbed; a corrupt
+        checkpoint's deterministic error propagates to the caller).
+
+        ``restore_args`` are constructed from the template explicitly —
+        each leaf restores onto the TEMPLATE's sharding, not the sharding
+        recorded in the checkpoint. That is what makes restore-with-
+        RESHARDING work (graftmend elastic): a checkpoint written by a
+        2-process pod names devices a surviving 1-process pod doesn't
+        have, so restoring 'as saved' is impossible after a topology
+        change; placing onto the live state's shardings is always
+        well-defined."""
+
+        def _do_restore():
+            io_hook("ckpt_restore")   # chaos injection point (fail_io)
+            return self._mgr.restore(
+                step, args=ocp.args.Composite(
+                    state=ocp.args.PyTreeRestore(
+                        state_template,
+                        restore_args=ocp.checkpoint_utils.
+                        construct_restore_args(state_template))))
+
+        restored = with_retry("ckpt_restore", _do_restore,
+                              retry_kw=self.retry_kw)
+        return restored["state"], self.load_metadata(step)
+
+    def restore(self, state_template: Any, step: Optional[int] = None,
+                log=print):
         """Restore into the structure/shardings of ``state_template``.
         Returns (state, metadata|None). Drains in-flight saves first so a
         just-requested step is durable before it is read back; steps whose
         write never finalized (``*-tmp-*`` dirs) are invisible to orbax and
-        are never restored."""
+        are never restored — and stale ones are garbage-collected here
+        (:meth:`gc_stale_tmp`).
+
+        With ``step=None`` (resume-from-latest) a step whose restore FAILS
+        — truncated or corrupted files from a crash mid-finalize or disk
+        rot — falls back to the next older durable step instead of killing
+        the resume (``ckpt.restore_fallback_total`` + a
+        ``ckpt_restore_fallback`` flight event per skipped step). An
+        explicit ``step`` is a pinned request for exactly that state and
+        still raises."""
         self.wait_until_finished()
-        step = step if step is not None else self._mgr.latest_step()
-        if step is None:
+        self.gc_stale_tmp(log=log)
+        if step is not None:
+            return self._restore_step(state_template, step)
+        steps = sorted(self._mgr.all_steps(), reverse=True)
+        if not steps:
             raise FileNotFoundError(f"no checkpoint found in {self.directory}")
-        restored = self._mgr.restore(
-            step, args=ocp.args.Composite(
-                state=ocp.args.PyTreeRestore(state_template)))
-        meta = self.load_metadata(step)
-        return restored["state"], meta
+        last_exc: Optional[BaseException] = None
+        bad_steps: list = []
+        for s in steps:
+            try:
+                out = self._restore_step(state_template, s)
+                # quarantine is DEFERRED until some step restores: a
+                # successful restore proves the template/reader are fine,
+                # so the skipped newer steps really are bad on disk. If
+                # EVERY step fails (a template↔checkpoint tree mismatch, a
+                # broken reader) nothing is renamed — a systemic failure
+                # must not destroy the whole checkpoint history.
+                for b in bad_steps:
+                    self._quarantine_step(b)
+                return out
+            except RetryBudgetExceeded as exc:
+                if isinstance(exc.last, FileNotFoundError):
+                    # the step VANISHED between listing and reading — a
+                    # peer's quarantine rename (every pod member races the
+                    # same fallback) or rotation. Skip it; there is
+                    # nothing on disk to quarantine, and crashing here
+                    # would kill the peer mid-restore too.
+                    last_exc = exc
+                    log(f"[ckpt] step {s} vanished during restore (peer "
+                        "quarantine/rotation); falling back")
+                    continue
+                # transient I/O exhaustion is an INFRASTRUCTURE failure,
+                # not evidence this step is corrupt — falling back (and
+                # quarantining!) would discard a healthy checkpoint and,
+                # in a pod, desync this worker's step list from peers
+                # whose restore succeeded
+                raise
+            except Exception as exc:  # noqa: BLE001 - a corrupt step raises
+                # version-dependent orbax/numpy types; any failure here
+                # means THIS step is unusable, and the run is better served
+                # by the previous durable step than by the traceback
+                last_exc = exc
+                bad_steps.append(int(s))
+                counter_add("ckpt.restore_fallback_total", 1.0)
+                record_event("ckpt_restore_fallback", step=int(s),
+                             error=repr(exc))
+                log(f"[ckpt] restore of step {s} failed ({exc!r}); "
+                    "falling back to the previous durable step")
+        raise RuntimeError(
+            f"every checkpoint in {self.directory} failed to restore "
+            f"(steps tried: {steps})") from last_exc
+
+    def _quarantine_step(self, step: int) -> None:
+        """Rename an unrestorable step dir to ``<step>.corrupt`` — bytes
+        kept for forensics, step NUMBER freed so resumed training can
+        re-save it when it re-crosses the boundary. Best-effort: in a
+        multi-process pod every worker races the same rename and one wins
+        — but the reload must run on EVERY worker regardless of who won
+        (a worker whose manager still lists the quarantined step would
+        later run different save/rotation collectives than its peers —
+        observed as a gloo payload-size mismatch abort)."""
+        bad = os.path.join(self.directory, str(step))
+        try:
+            os.replace(bad, bad + ".corrupt")
+        except OSError:
+            pass
+        try:
+            self._mgr.reload()
+        except AttributeError:
+            pass
+
+    def gc_stale_tmp(self, grace_s: Optional[float] = None,
+                     log=print) -> list:
+        """Sweep interrupted ``*.orbax-checkpoint-tmp-*`` directories (and
+        files) under the checkpoint root and one level down. An async save
+        killed mid-write leaves its tmp dir forever — orbax ignores it on
+        restore but never reclaims it, so crash-looping runs leak disk.
+        Entries younger than the grace window (default
+        ``self.tmp_grace_s``) are skipped: they may be a live sibling
+        process's write in flight. Returns the reclaimed paths."""
+        grace = self.tmp_grace_s if grace_s is None else float(grace_s)
+        now = time.time()
+        reclaimed = []
+        parents = [self.directory]
+        parents += [os.path.join(self.directory, d)
+                    for d in sorted(os.listdir(self.directory))
+                    if os.path.isdir(os.path.join(self.directory, d))]
+        for parent in parents:
+            try:
+                names = os.listdir(parent)
+            except OSError:
+                continue
+            for name in names:
+                if ".orbax-checkpoint-tmp" not in name:
+                    continue
+                p = os.path.join(parent, name)
+                try:
+                    # liveness = the NEWEST mtime anywhere in the tree: a
+                    # long-running save streams leaf data into nested
+                    # files without touching the top-level dir's mtime, so
+                    # judging the dir alone would sweep an in-flight write
+                    # out from under the saver at exactly the large-
+                    # checkpoint scale the grace window exists to protect
+                    if now - _newest_mtime(p) < grace:
+                        continue
+                except OSError:
+                    continue   # vanished under us (racing sweep/finalize)
+                if os.path.isdir(p):
+                    shutil.rmtree(p, ignore_errors=True)
+                    if os.path.exists(p):
+                        continue   # rmtree failed: still leaking, don't
+                                   # count it reclaimed
+                else:
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        continue
+                reclaimed.append(p)
+        if reclaimed:
+            counter_add("ckpt.tmp_reclaimed_total", float(len(reclaimed)))
+            log(f"[ckpt] reclaimed {len(reclaimed)} stale checkpoint tmp "
+                f"entr{'y' if len(reclaimed) == 1 else 'ies'}: "
+                + ", ".join(os.path.basename(r) for r in reclaimed))
+        return reclaimed
 
     def load_metadata(self, step: Optional[int] = None) -> Optional[dict]:
         self.wait_until_finished()
@@ -127,9 +332,14 @@ class CheckpointManager:
         meta_path = os.path.join(self.directory, str(step), "metadata")
         if not os.path.isdir(meta_path):
             return None
-        try:
-            restored = self._mgr.restore(
+        def _do_restore_meta():
+            io_hook("ckpt_restore")
+            return self._mgr.restore(
                 step, args=ocp.args.Composite(metadata=ocp.args.JsonRestore()))
+
+        try:
+            restored = with_retry("ckpt_restore_meta", _do_restore_meta,
+                                  retry_kw=self.retry_kw)
             return restored["metadata"]
         except Exception:  # noqa: BLE001 - metadata is best-effort sidecar:
             # orbax raises version-dependent types for a missing/corrupt item
@@ -140,7 +350,9 @@ class CheckpointManager:
         """Save-before-training so a broken checkpoint config fails immediately
         (reference legacy/train_dalle.py:591-594) — synchronous even on async
         managers: a preflight that fails in a background thread three steps
-        later defeats its purpose."""
+        later defeats its purpose. Also the second stale-tmp sweep point:
+        a fresh run inherits whatever a crashed predecessor left behind."""
+        self.gc_stale_tmp()
         self.save(0, state, metadata, wait=True)
 
     def close(self):

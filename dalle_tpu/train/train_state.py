@@ -53,17 +53,41 @@ class TrainState:
     step: jnp.ndarray
     params: Any
     opt_state: Any
-    # static fields
+    # runtime learning-rate multiplier (graftmend breach→action layer,
+    # train/actions.py): a (), f32 DATA leaf — the host writes a new value
+    # between steps (``state.replace(lr_scale=...)``) without recompiling,
+    # which a schedule closed over by the tx (static) cannot do. Updates
+    # are multiplied by it after ``tx.update``, which for Adam-family
+    # optimizers (update = -lr·normalized ± decay) is exactly a
+    # learning-rate scale; moments are untouched, so restoring the scale
+    # to 1.0 restores the original trajectory going forward.
+    #
+    # OPT-IN at creation (``create(..., lr_scale=1.0)``; trainers arm it
+    # from ``TrainConfig.runtime_lr_scale``): None means no leaf at all —
+    # the compiled program is byte-identical to a scale-less step (the
+    # extra per-leaf multiply measurably taxes compile time across the
+    # fleet of trainer programs), and arming mid-run is deliberately
+    # unsupported because the treedef change would break the pinned
+    # out_shardings of an already-jitted step.
+    #
+    # static fields (no defaults: a direct construction missing them must
+    # fail at construction, not later inside apply_gradients); lr_scale is
+    # declared last purely for dataclass default ordering — static fields
+    # are not pytree leaves, so the leaf order is unchanged
     apply_fn: Callable = flax.struct.field(pytree_node=False)
     tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+    lr_scale: Any = None
 
     @classmethod
-    def create(cls, *, apply_fn, params, tx):
+    def create(cls, *, apply_fn, params, tx, lr_scale=None):
         import inspect
         if inspect.ismethod(apply_fn):
             apply_fn = _ValueEqMethod(apply_fn)
         return cls(step=jnp.zeros((), jnp.int32), params=params,
-                   opt_state=tx.init(params), apply_fn=apply_fn, tx=tx)
+                   opt_state=tx.init(params),
+                   lr_scale=(None if lr_scale is None
+                             else jnp.asarray(lr_scale, jnp.float32)),
+                   apply_fn=apply_fn, tx=tx)
 
     def apply_gradients(self, grads, return_updates: bool = False,
                         **extra_args):
@@ -73,9 +97,13 @@ class TrainState:
         ``return_updates=True`` additionally returns the optimizer's update
         tree (the graftpulse health taps derive per-layer-group update
         ratios from it without recomputing ``new - old`` params, which
-        would read the donated input buffers)."""
+        would read the donated input buffers) — post-``lr_scale``, i.e. the
+        update actually applied."""
         updates, opt_state = self.tx.update(grads, self.opt_state, self.params,
                                             **extra_args)
+        if self.lr_scale is not None:
+            scale = self.lr_scale
+            updates = jax.tree.map(lambda u: u * scale, updates)
         params = optax.apply_updates(self.params, updates)
         new = self.replace(step=self.step + 1, params=params,
                            opt_state=opt_state)

@@ -81,7 +81,8 @@ class CLIPTrainer(BaseTrainer):
         params = shard_params(self.mesh, params)
         tx = make_optimizer(train_cfg.optim)
         self.state = commit_to_mesh(self.mesh, TrainState.create(
-            apply_fn=self.model.apply, params=params, tx=tx))
+            apply_fn=self.model.apply, params=params, tx=tx,
+            lr_scale=1.0 if train_cfg.runtime_lr_scale else None))
         self._health_kw = dict(
             health=bool(train_cfg.obs.health),
             health_depth=train_cfg.obs.health_group_depth)

@@ -166,7 +166,8 @@ class DalleTrainer(BaseTrainer):
         params = shard_params(self.mesh, params)
         tx = make_optimizer(train_cfg.optim)
         self.state = commit_to_mesh(self.mesh, TrainState.create(
-            apply_fn=self.model.apply, params=params, tx=tx))
+            apply_fn=self.model.apply, params=params, tx=tx,
+            lr_scale=1.0 if train_cfg.runtime_lr_scale else None))
         use_dropout = (model_cfg.attn_dropout > 0 or model_cfg.ff_dropout > 0)
         self.step_fn = make_dalle_train_step(
             self.model, null_cond_prob=null_cond_prob, use_dropout=use_dropout,

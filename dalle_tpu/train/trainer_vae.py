@@ -119,11 +119,17 @@ class VAETrainer(BaseTrainer):
         self.model_cfg = model_cfg
         self.anneal_cfg = anneal_cfg or AnnealConfig()
 
+        # graftmend (train/actions.py): temperature-schedule rebase point —
+        # reanneal_gumbel(step) restarts the anneal from `step`, re-warming
+        # a collapsed codebook; temp is a traced scalar so no recompile
+        self._anneal_step0 = 0
+
         self.model, params = init_dvae(model_cfg, self.base_key)
         params = shard_params(self.mesh, params)
         tx = make_optimizer(train_cfg.optim)
         self.state = commit_to_mesh(self.mesh, TrainState.create(
-            apply_fn=self.model.apply, params=params, tx=tx))
+            apply_fn=self.model.apply, params=params, tx=tx,
+            lr_scale=1.0 if train_cfg.runtime_lr_scale else None))
         self._health_kw = dict(
             health=bool(train_cfg.obs.health),
             health_depth=train_cfg.obs.health_group_depth)
@@ -144,10 +150,38 @@ class VAETrainer(BaseTrainer):
         images, *rest = batch
         return (self._put(images, np.float32, stacked), *rest)
 
+    def _temp_at(self, step: int) -> float:
+        """Anneal temperature with the re-anneal rebase applied: the
+        schedule runs on ``step - _anneal_step0`` so a codebook-collapse
+        action can restart the warm phase mid-run (docs/RESILIENCE.md)."""
+        return anneal_temperature(self.anneal_cfg,
+                                  max(step - self._anneal_step0, 0))
+
+    def reanneal_gumbel(self, step: int) -> float:
+        """Restart the gumbel temperature schedule from ``step`` (the
+        codebook-collapse breach action). Returns the re-warmed temp.
+        The rebase point rides checkpoint METADATA (``extra_meta`` flows
+        into every later save's sidecar) so a preemption/respawn resumes
+        the re-warmed schedule instead of snapping back to the cold
+        end-of-schedule temperature — the lr-cut action gets the same
+        durability from ``TrainState.lr_scale`` living in the state."""
+        self._anneal_step0 = int(step)
+        self.extra_meta["anneal_step0"] = self._anneal_step0
+        return self._temp_at(step)
+
+    def restore(self, step=None):
+        meta = super().restore(step)
+        if meta and meta.get("anneal_step0"):
+            # best-effort like all metadata: a missing sidecar resumes the
+            # un-rebased schedule (and a breach would just re-fire)
+            self._anneal_step0 = int(meta["anneal_step0"])
+            self.extra_meta["anneal_step0"] = self._anneal_step0
+        return meta
+
     # -- single step -------------------------------------------------------
     def train_step(self, images: np.ndarray, _labels=None):
         step_num = self._host_step
-        temp = anneal_temperature(self.anneal_cfg, step_num)
+        temp = self._temp_at(step_num)
         key = jax.random.fold_in(self.base_key, step_num)
         with span("vae/shard_batch"):
             images = self._put(images, np.float32)
@@ -175,8 +209,8 @@ class VAETrainer(BaseTrainer):
         k = images.shape[0]
         steps = self._host_step + np.arange(k)
         keys = self._step_keys(k)
-        temps = jnp.asarray([anneal_temperature(self.anneal_cfg, int(s))
-                             for s in steps], jnp.float32)
+        temps = jnp.asarray([self._temp_at(int(s)) for s in steps],
+                            jnp.float32)
         with span("vae/shard_batch", k=k):
             images = self._put(images, np.float32, stacked=True)
         with span("vae/steps", k=k):

@@ -288,7 +288,8 @@ class VQGANTrainer(BaseTrainer):
             gen_params = shard_params(self.mesh, gen_params)
             tx = make_optimizer(train_cfg.optim)
             self.state = commit_to_mesh(self.mesh, TrainState.create(
-                apply_fn=self.model.apply, params=gen_params, tx=tx))
+                apply_fn=self.model.apply, params=gen_params, tx=tx,
+                lr_scale=1.0 if train_cfg.runtime_lr_scale else None))
             self.step_fn = make_vq_simple_train_step(
                 self.model, self.loss_cfg, loss_mode,
                 dtype=compute_dtype(train_cfg.precision), state=self.state,

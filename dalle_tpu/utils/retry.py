@@ -1,0 +1,139 @@
+"""Jittered-exponential-backoff retry — the absorption layer for transient
+distributed-I/O failures (graftmend, docs/RESILIENCE.md).
+
+Production pod training fails at the EDGES, not in the math: the
+coordinator isn't listening yet when worker 7 dials in, a checkpoint write
+races a filesystem hiccup, a heartbeat lands on a briefly-full disk. Each
+of those used to be a single attempt (``backend.py`` dialed the coordinator
+exactly once; orbax save/restore surfaced the first ``OSError`` straight
+into the fit loop), turning a 50 ms blip into a dead worker the elastic
+layer then has to reshape around. This module gives every such call site
+one shared policy:
+
+  * **budget** — at most ``attempts`` tries; exhaustion raises
+    :class:`RetryBudgetExceeded` chained onto the last real error, so the
+    caller's except clauses still see the root cause via ``__cause__``.
+  * **jittered exponential backoff** — delay ``min(base·2ⁱ, max)`` scaled
+    by ``1 ± jitter`` so a fleet of workers retrying the same dead
+    coordinator doesn't synchronize into a thundering herd. The jitter
+    stream is seedable for deterministic tests.
+  * **obs integration** — every retried failure increments
+    ``retry.attempts_total{op=}``, exhaustion increments
+    ``retry.exhausted_total{op=}``, a success after ≥1 failure increments
+    ``retry.recovered_total{op=}``; each backoff wait is a
+    ``retry/backoff`` span tagged with op/attempt/delay, so a run that
+    survived a flaky filesystem says so in its trace and scrape instead of
+    silently eating latency. This is the acceptance signal chaos_smoke
+    asserts on: an injected I/O fault must show up as counters, not a
+    crash.
+
+Only *transient* classes are retried (:data:`TRANSIENT` by default —
+``OSError``/``ConnectionError``/``TimeoutError``; the chaos harness's
+injected faults subclass ``OSError`` so they ride the same path). A
+``ValueError`` from a genuinely corrupt checkpoint propagates immediately:
+retrying a deterministic failure just burns the budget hiding the bug.
+
+graftlint's ``unguarded-distributed-io`` rule (docs/LINT.md) flags bare
+``jax.distributed.initialize`` / orbax manager save-restore call sites that
+bypass this layer.
+"""
+
+from __future__ import annotations
+
+import functools
+import random
+import time
+from typing import Callable, Optional, Tuple, Type
+
+from ..obs import counter_add, span
+
+# the default retry surface: classes that plausibly heal on their own.
+# ConnectionError/TimeoutError are OSError subclasses (spelled out for the
+# reader); chaos.faults.InjectedFault subclasses OSError deliberately.
+TRANSIENT: Tuple[Type[BaseException], ...] = (
+    OSError, ConnectionError, TimeoutError)
+
+
+class RetryBudgetExceeded(RuntimeError):
+    """Raised when every attempt failed; ``__cause__`` is the last error."""
+
+    def __init__(self, op: str, attempts: int, last: BaseException):
+        super().__init__(
+            f"retry budget exhausted for {op!r}: {attempts} attempts, "
+            f"last error: {last!r}")
+        self.op = op
+        self.attempts = attempts
+        self.last = last
+
+
+def backoff_delays(attempts: int, *, base_delay_s: float = 0.05,
+                   max_delay_s: float = 2.0, jitter: float = 0.5,
+                   seed: Optional[int] = None):
+    """The deterministic-given-seed backoff schedule: ``attempts - 1``
+    delays (no wait after the final failure), each ``min(base·2ⁱ, max)``
+    scaled uniformly in ``[1-jitter, 1+jitter]``. Exposed separately so
+    tests (and capacity math in docs/RESILIENCE.md) can inspect the exact
+    schedule a policy produces."""
+    rng = random.Random(seed)
+    out = []
+    for i in range(max(attempts - 1, 0)):
+        d = min(base_delay_s * (2.0 ** i), max_delay_s)
+        out.append(d * (1.0 + jitter * (2.0 * rng.random() - 1.0)))
+    return out
+
+
+def retry(op: str, *, attempts: int = 5, base_delay_s: float = 0.05,
+          max_delay_s: float = 2.0, jitter: float = 0.5,
+          retry_on: Tuple[Type[BaseException], ...] = TRANSIENT,
+          seed: Optional[int] = None,
+          sleep: Callable[[float], None] = time.sleep,
+          log=None):
+    """Decorator factory: ``@retry("ckpt_save")`` makes the wrapped callable
+    absorb up to ``attempts - 1`` transient failures with jittered
+    exponential backoff between tries. See the module docstring for the
+    policy; ``sleep`` is injectable so tests assert the schedule without
+    waiting it out."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            delays = backoff_delays(
+                attempts, base_delay_s=base_delay_s,
+                max_delay_s=max_delay_s, jitter=jitter, seed=seed)
+            last: Optional[BaseException] = None
+            for attempt in range(attempts):
+                try:
+                    out = fn(*args, **kwargs)
+                except retry_on as exc:
+                    last = exc
+                    counter_add("retry.attempts_total", 1.0,
+                                labels={"op": op})
+                    if attempt + 1 >= attempts:
+                        break
+                    delay = delays[attempt]
+                    if log is not None:
+                        log(f"[retry] {op}: attempt {attempt + 1}/"
+                            f"{attempts} failed ({exc!r}); retrying in "
+                            f"{delay * 1e3:.0f} ms")
+                    with span("retry/backoff", op=op, attempt=attempt + 1,
+                              delay_s=delay):
+                        sleep(delay)
+                else:
+                    if attempt > 0:
+                        counter_add("retry.recovered_total", 1.0,
+                                    labels={"op": op})
+                    return out
+            counter_add("retry.exhausted_total", 1.0, labels={"op": op})
+            raise RetryBudgetExceeded(op, attempts, last) from last
+        return wrapped
+
+    return deco
+
+
+def with_retry(op: str, fn: Callable, *args, retry_kw: Optional[dict] = None,
+               **kwargs):
+    """One-shot call-site form: ``with_retry("ckpt_restore", mgr.restore,
+    step, args=...)`` — the same policy as :func:`retry` without decorating
+    a def. ``retry_kw`` forwards policy overrides (attempts, delays, seed,
+    sleep)."""
+    return retry(op, **(retry_kw or {}))(fn)(*args, **kwargs)

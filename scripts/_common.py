@@ -298,6 +298,41 @@ def install_health_recorder(args, default_dir: str) -> bool:
     return True
 
 
+def add_resilience_args(parser):
+    """graftmend flags shared by every train CLI (docs/RESILIENCE.md):
+    the SIGTERM graceful-preemption handler (default ON — the k8s/TPU
+    preemption contract) and the breach→action automation over the
+    graftpulse sentries (opt-in; needs --health for the detectors to see
+    anything)."""
+    grp = parser.add_argument_group("resilience (graftmend, "
+                                    "docs/RESILIENCE.md)")
+    grp.add_argument("--no_preemption_handler", action="store_true",
+                     help="do NOT install the SIGTERM handler (default: "
+                          "SIGTERM finishes the in-flight step, takes a "
+                          "synchronous drained save, and exits 0)")
+    grp.add_argument("--breach_actions", action="store_true",
+                     help="act on graftpulse breaches: nan-precursor → "
+                          "preemptive snapshot, grad-explosion → rollback "
+                          "+ lr cut, codebook-collapse → lr cut + gumbel "
+                          "re-anneal (pair with --health)")
+    grp.add_argument("--lr_cut_factor", type=float, default=0.5,
+                     help="lr_scale multiplier applied per lr-cut action")
+    return parser
+
+
+def install_resilience(args, trainer, log=print):
+    """Arm the graftmend layers on a built trainer per the CLI flags."""
+    if not getattr(args, "no_preemption_handler", False):
+        trainer.install_preemption_handler(log=log)
+    if getattr(args, "breach_actions", False):
+        from dalle_tpu.train.actions import BreachActions
+        BreachActions(trainer, lr_cut_factor=args.lr_cut_factor,
+                      log=log).attach()
+        if not getattr(args, "health", False):
+            log("[actions] --breach_actions without --health: the "
+                "detectors see no health/* columns and will never fire")
+
+
 def add_overlap_args(parser):
     """Host-overlap flags shared by every train CLI (docs/PERFORMANCE.md):
     async checkpointing, device prefetch depth, deferred metrics, and the

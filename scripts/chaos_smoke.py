@@ -1,0 +1,437 @@
+#!/usr/bin/env python
+"""graftmend chaos smoke: scripted fault scenarios over the REAL 2-process
+gloo/DCN path, each asserting the recovery invariant (docs/RESILIENCE.md —
+the CI stage behind it):
+
+  **bit-exact resume** — post-recovery (params, opt_state) must be
+  BITWISE-identical (sha256 over every leaf's raw bytes) to an
+  uninterrupted run at the same step.
+
+Scenario catalog (fast set; ``--heavy`` adds the hang-detection scenario,
+whose liveness timeouts dominate its runtime):
+
+  * ``kill_respawn`` — SIGKILL worker 1 mid-step; the elastic agent tears
+    the epoch down and respawns the full gang; both workers restore the
+    last durable step over the real coordinator and resume. Digest must
+    equal the clean 2-process reference.
+  * ``kill_sigterm`` — SIGTERM instead: the victim finishes its in-flight
+    step, takes a synchronous drained save (the graceful-preemption
+    contract), and exits asking for reconfiguration; the step it was
+    killed at must exist as a durable checkpoint.
+  * ``coordinator_flaky`` — the victim's first two
+    ``jax.distributed.initialize`` dials fail (injected); the retry layer
+    must absorb them (visible as ``retry.attempts_total{op=
+    "coordinator_connect"}``), with NO reconfiguration and a clean digest.
+  * ``ckpt_io_flaky`` — same for checkpoint-save I/O.
+  * ``corrupt_recover`` — corrupt the newest durable checkpoint, then
+    SIGKILL: recovery must fall back to the previous durable step
+    (``ckpt.restore_fallback_total``), quarantine the corrupt one, and
+    still converge to the reference digest.
+  * ``shrink`` — SIGKILL under ``policy=shrink``: the pod reshapes to
+    world size 1, restores WITH RESHARDING onto the smaller mesh, and
+    resumes. Invariant: recovery ≡ a clean single-process run pinned to
+    the same restore step (crossing world sizes changes reduction
+    grouping, so the oracle holds topology fixed — see RESILIENCE.md).
+  * ``hang_detect`` (``--heavy``) — worker 1 hangs mid-step: the
+    survivor's peer-liveness watcher and the agent's heartbeat timeout
+    must detect it (no exit code to key on), kill it, and recover.
+
+Per-scenario verdicts + the agent event log + a flight-recorder bundle
+land in ``--outdir`` (``chaos_artifacts/`` in CI; ci.yml uploads them).
+
+Run: JAX_PLATFORMS=cpu python scripts/chaos_smoke.py --outdir chaos_artifacts
+"""
+
+import argparse
+import glob
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from dalle_tpu.chaos import (EPOCH_ENV, PLAN_ENV, RANK_ENV, Fault,  # noqa: E402
+                             FaultPlan)
+from dalle_tpu.obs import configure_recorder, dump_recorder  # noqa: E402
+from dalle_tpu.parallel.elastic import (DIR_ENV, WORKER_ENV,  # noqa: E402
+                                        ElasticAgent, python_worker_env)
+
+WORKER = os.path.join(ROOT, "scripts", "chaos_worker.py")
+
+FAILURES = []
+
+
+def check(ok: bool, what: str):
+    print(("ok   " if ok else "FAIL ") + what)
+    if not ok:
+        FAILURES.append(what)
+    return ok
+
+
+def child_env(extra=None):
+    return python_worker_env(devices_per_proc=1, repo_root=ROOT, extra=extra)
+
+
+def make_spawn(run_dir: str, cache: str, target: int, save_every: int,
+               plan: FaultPlan = None, peer_timeout_s: float = 0.0,
+               extra_args: tuple = ()):
+    """The ElasticAgent spawn fn: one chaos_worker.py child per member,
+    logs to <run_dir>/logs/."""
+    logdir = os.path.join(run_dir, "logs")
+    os.makedirs(logdir, exist_ok=True)
+
+    def spawn(worker_id, epoch):
+        extra = {DIR_ENV: run_dir, WORKER_ENV: str(worker_id)}
+        if plan is not None:
+            extra.update({PLAN_ENV: plan.to_json(),
+                          RANK_ENV: str(worker_id),
+                          EPOCH_ENV: str(epoch.epoch)})
+        cmd = [sys.executable, WORKER, "--run_dir", run_dir,
+               "--target_steps", str(target),
+               "--save_every", str(save_every),
+               "--compile_cache", cache, *extra_args]
+        if peer_timeout_s > 0:
+            cmd += ["--peer_timeout_s", str(peer_timeout_s)]
+        log = open(os.path.join(
+            logdir, f"w{worker_id}_e{epoch.epoch}.log"), "a")
+        return subprocess.Popen(cmd, env=child_env(extra), stdout=log,
+                                stderr=subprocess.STDOUT, cwd=ROOT)
+    return spawn
+
+
+def read_digests(run_dir: str) -> dict:
+    out = {}
+    for p in glob.glob(os.path.join(run_dir, "digest_*.json")):
+        with open(p, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        out[os.path.basename(p)[len("digest_"):-len(".json")]] = doc
+    return out
+
+
+def counters_of(digests: dict) -> dict:
+    merged = {}
+    for doc in digests.values():
+        for k, v in doc.get("counters", {}).items():
+            merged[k] = merged.get(k, 0) + v
+    return merged
+
+
+def tail_logs(run_dir: str, n: int = 30) -> str:
+    out = []
+    for p in sorted(glob.glob(os.path.join(run_dir, "logs", "*.log"))):
+        with open(p, errors="replace") as fh:
+            lines = fh.readlines()
+        out.append(f"---- {os.path.basename(p)} ----\n"
+                   + "".join(lines[-n:]))
+    return "\n".join(out)
+
+
+def run_pod(name: str, outdir: str, cache: str, *, nproc: int, target: int,
+            save_every: int, plan: FaultPlan = None, policy: str = "respawn",
+            hb_timeout_s: float = 0.0, peer_timeout_s: float = 0.0,
+            term_grace_s: float = 5.0, deadline_s: float = 420.0,
+            extra_args: tuple = ()):
+    """One pod run under the elastic agent; returns (agent, digests)."""
+    run_dir = os.path.join(outdir, name)
+    shutil.rmtree(run_dir, ignore_errors=True)
+    os.makedirs(run_dir)
+    agent = ElasticAgent(
+        run_dir, make_spawn(run_dir, cache, target, save_every, plan,
+                            peer_timeout_s, extra_args),
+        members=list(range(nproc)), policy=policy,
+        hb_timeout_s=hb_timeout_s, term_grace_s=term_grace_s, poll_s=0.2)
+    t0 = time.time()
+    try:
+        agent.run(deadline_s=deadline_s)
+    except Exception as exc:  # noqa: BLE001 - a failed pod must produce a
+        # verdict + logs, not a stack trace that hides them
+        check(False, f"{name}: pod run failed: {exc!r}")
+        print(tail_logs(run_dir))
+    digests = read_digests(run_dir)
+    print(f"-- {name}: {time.time() - t0:.1f}s, "
+          f"{agent.reconfigures} reconfigure(s), "
+          f"{len(digests)} digest artifact(s)")
+    return agent, digests
+
+
+def verdict(outdir: str, name: str, agent, digests: dict, checks: dict):
+    doc = {"scenario": name, "ok": all(checks.values()), "checks": checks,
+           "events": agent.events if agent is not None else [],
+           "digests": digests}
+    path = os.path.join(outdir, name, "verdict.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, default=str)
+    return doc
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--outdir", default="./chaos_smoke_out")
+    ap.add_argument("--target_steps", type=int, default=8)
+    ap.add_argument("--save_every", type=int, default=2)
+    ap.add_argument("--kill_step", type=int, default=5)
+    ap.add_argument("--heavy", action="store_true",
+                    help="include the hang-detection scenario (liveness "
+                    "timeouts dominate its runtime)")
+    ap.add_argument("--scenarios", default="",
+                    help="comma-separated subset (default: the fast set)")
+    args = ap.parse_args(argv)
+    outdir = os.path.abspath(args.outdir)
+    os.makedirs(outdir, exist_ok=True)
+    # The persistent XLA compile cache is the near-zero-compile REJOIN story
+    # on real hardware (docs/RESILIENCE.md), but on the CPU mesh a cache HIT
+    # in a multi-process gloo run deserializes executables that corrupt
+    # memory (segfault/abort/garbage collectives — observed on jax 0.4.37;
+    # a respawned worker re-reading the gang's own cache died every epoch).
+    # The smoke therefore runs cache-off; chaos_worker keeps the
+    # --compile_cache flag for the hardware path.
+    cache = ""
+    configure_recorder(os.path.join(outdir, "flight"),
+                       min_dump_interval_s=0.0)
+    target, save_every, kill_at = (args.target_steps, args.save_every,
+                                   args.kill_step)
+    t_all = time.time()
+
+    wanted = set(filter(None, args.scenarios.split(",")))
+
+    def enabled(name):
+        return not wanted or name in wanted
+
+    summaries = []
+
+    # -- reference: uninterrupted 2-process run -> the bitwise oracle ------
+    agent, ref = run_pod("reference2", outdir, cache, nproc=2,
+                         target=target, save_every=0)
+    ref_digest = next(iter(ref.values()))["digest"] if ref else None
+    ok = check(len(ref) == 2 and len({d["digest"] for d in ref.values()}) == 1,
+               "reference2: both workers agree on the state digest")
+    if not ok:
+        print(tail_logs(os.path.join(outdir, "reference2")))
+    summaries.append(verdict(outdir, "reference2", agent, ref,
+                             {"agree": ok}))
+
+    def assert_recovered(name, agent, digests, *, expect_world=2,
+                         expect_reconfigure=True, ref_d=None,
+                         restored_below=None):
+        """The shared recovery checks: every surviving worker completed,
+        digests agree with the reference, recovery actually resumed from a
+        durable step rather than restarting from scratch."""
+        checks = {}
+        ref_d = ref_d if ref_d is not None else ref_digest
+        limit = kill_at if restored_below is None else restored_below
+        got = {d["digest"] for d in digests.values()}
+        checks["bitwise_resume"] = check(
+            bool(digests) and got == {ref_d},
+            f"{name}: post-recovery state BITWISE-identical to the "
+            f"uninterrupted reference at step {target}")
+        if expect_reconfigure:
+            kinds = [e["kind"] for e in agent.events]
+            checks["reconfigured"] = check(
+                "reconfigure" in kinds,
+                f"{name}: the agent reshaped the pod (events: {kinds})")
+            checks["resumed_durable"] = check(
+                all(d.get("restored_from") is not None
+                    and 0 < d["restored_from"] < limit
+                    or d.get("epoch", 0) == 0
+                    for d in digests.values())
+                and any(d.get("restored_from") is not None
+                        for d in digests.values()),
+                f"{name}: recovery resumed from a durable step < "
+                f"{limit}, not from scratch")
+        checks["world_size"] = check(
+            all(d["world_size"] == expect_world for d in digests.values()),
+            f"{name}: completed at world size {expect_world}")
+        if not all(checks.values()):
+            print(tail_logs(os.path.join(outdir, name)))
+        return checks
+
+    # -- kill_respawn: the acceptance scenario ------------------------------
+    if enabled("kill_respawn"):
+        plan = FaultPlan([Fault(kind="kill", step=kill_at, rank=1,
+                                signal="SIGKILL")])
+        agent, digests = run_pod("kill_respawn", outdir, cache, nproc=2,
+                                 target=target, save_every=save_every,
+                                 plan=plan)
+        checks = assert_recovered("kill_respawn", agent, digests)
+        checks["worker_lost"] = check(
+            any(e["kind"] == "worker_lost" and e.get("worker") == 1
+                for e in agent.events),
+            "kill_respawn: the agent saw worker 1 die")
+        summaries.append(verdict(outdir, "kill_respawn", agent, digests,
+                                 checks))
+        dump_recorder("kill_respawn")
+
+    # -- kill_sigterm: graceful-preemption contract -------------------------
+    if enabled("kill_sigterm"):
+        # rank=-1: real preemption SIGTERMs every host at once, and the
+        # orbax save barrier needs the whole gang saving the same boundary
+        plan = FaultPlan([Fault(kind="kill", step=kill_at, rank=-1,
+                                signal="SIGTERM")])
+        agent, digests = run_pod("kill_sigterm", outdir, cache, nproc=2,
+                                 target=target, save_every=save_every,
+                                 plan=plan)
+        # the latch lands while step kill_at+1 is in flight (the hook runs
+        # at the top of that iteration): the graceful save is at kill_at+1
+        boundary = kill_at + 1
+        checks = assert_recovered("kill_sigterm", agent, digests,
+                                  restored_below=boundary + 1)
+        ckpt_dir = os.path.join(outdir, "kill_sigterm", "ckpt")
+        checks["graceful_save"] = check(
+            os.path.isdir(os.path.join(ckpt_dir, str(boundary))),
+            f"kill_sigterm: SIGTERM victims finished the in-flight step "
+            f"and left a durable checkpoint at step {boundary}")
+        summaries.append(verdict(outdir, "kill_sigterm", agent, digests,
+                                 checks))
+
+    # -- flaky coordinator connect: absorbed by retry, not a crash ----------
+    if enabled("coordinator_flaky"):
+        plan = FaultPlan([Fault(kind="fail_io", site="coordinator_connect",
+                                rank=1, times=2)])
+        agent, digests = run_pod("coordinator_flaky", outdir, cache,
+                                 nproc=2, target=target,
+                                 save_every=save_every, plan=plan)
+        checks = assert_recovered("coordinator_flaky", agent, digests,
+                                  expect_reconfigure=False)
+        cs = counters_of(digests)
+        checks["absorbed"] = check(
+            agent.reconfigures == 0
+            and cs.get('retry.attempts_total{op="coordinator_connect"}',
+                       0) >= 2
+            and cs.get('retry.recovered_total{op="coordinator_connect"}',
+                       0) >= 1,
+            "coordinator_flaky: injected connect failures absorbed by the "
+            f"retry layer (counters: { {k: v for k, v in cs.items() if 'retry' in k} })")
+        summaries.append(verdict(outdir, "coordinator_flaky", agent,
+                                 digests, checks))
+
+    # -- flaky checkpoint I/O: absorbed by retry ----------------------------
+    if enabled("ckpt_io_flaky"):
+        plan = FaultPlan([Fault(kind="fail_io", site="ckpt_save",
+                                rank=0, times=2)])
+        agent, digests = run_pod("ckpt_io_flaky", outdir, cache, nproc=2,
+                                 target=target, save_every=save_every,
+                                 plan=plan)
+        checks = assert_recovered("ckpt_io_flaky", agent, digests,
+                                  expect_reconfigure=False)
+        cs = counters_of(digests)
+        checks["absorbed"] = check(
+            agent.reconfigures == 0
+            and cs.get('retry.attempts_total{op="ckpt_save"}', 0) >= 2
+            and cs.get('retry.recovered_total{op="ckpt_save"}', 0) >= 1,
+            "ckpt_io_flaky: injected checkpoint-save failures absorbed by "
+            "the retry layer")
+        summaries.append(verdict(outdir, "ckpt_io_flaky", agent, digests,
+                                 checks))
+
+    # -- corrupt newest checkpoint + kill: fallback restore -----------------
+    if enabled("corrupt_recover"):
+        ckpt_dir = os.path.join(outdir, "corrupt_recover", "ckpt")
+        plan = FaultPlan([
+            Fault(kind="corrupt_ckpt", step=kill_at, rank=1, path=ckpt_dir,
+                  mode="garbage"),
+            Fault(kind="kill", step=kill_at, rank=1, signal="SIGKILL"),
+        ])
+        # --sync_ckpt: the scenario scripts against "the newest durable
+        # step is kill_at-1's boundary save", which async finalize would
+        # make racy
+        agent, digests = run_pod("corrupt_recover", outdir, cache, nproc=2,
+                                 target=target, save_every=save_every,
+                                 plan=plan, extra_args=("--sync_ckpt",))
+        checks = assert_recovered("corrupt_recover", agent, digests)
+        # durable evidence, not counters: the epoch that EXPERIENCED the
+        # fallback may not be the epoch that completes and reports
+        corrupted_step = kill_at - 1          # last durable boundary save
+        checks["fallback"] = check(
+            bool(glob.glob(os.path.join(ckpt_dir, "*.corrupt")))
+            and all(d.get("restored_from") is not None
+                    and d["restored_from"] < corrupted_step
+                    for d in digests.values()),
+            f"corrupt_recover: restore fell back PAST the corrupted step "
+            f"{corrupted_step} (quarantined on disk) to an older durable "
+            f"step")
+        summaries.append(verdict(outdir, "corrupt_recover", agent, digests,
+                                 checks))
+        dump_recorder("corrupt_recover")
+
+    # -- shrink: reshape to world size 1 with resharding restore ------------
+    if enabled("shrink"):
+        plan = FaultPlan([Fault(kind="kill", step=kill_at, rank=1,
+                                signal="SIGKILL")])
+        agent, digests = run_pod("shrink", outdir, cache, nproc=2,
+                                 target=target, save_every=save_every,
+                                 plan=plan, policy="shrink")
+        # crossing world sizes changes reduction grouping, so the bitwise
+        # oracle holds topology fixed: a clean single-process leg pinned to
+        # the SAME restore step over a copy of the pod's checkpoints
+        w0 = digests.get("w0", {})
+        restored_from = w0.get("restored_from")
+        ref_d = None
+        if restored_from is not None:
+            ref_dir = os.path.join(outdir, "shrink_ref")
+            shutil.rmtree(ref_dir, ignore_errors=True)
+            os.makedirs(ref_dir)
+            shutil.copytree(os.path.join(outdir, "shrink", "ckpt"),
+                            os.path.join(ref_dir, "ckpt"))
+            log = open(os.path.join(ref_dir, "ref.log"), "w")
+            rc = subprocess.run(
+                [sys.executable, WORKER, "--run_dir", ref_dir,
+                 "--target_steps", str(target), "--save_every", "0",
+                 "--restore_step", str(restored_from),
+                 "--reference", "--compile_cache", cache],
+                env=child_env(), stdout=log, stderr=subprocess.STDOUT,
+                cwd=ROOT).returncode
+            refs = read_digests(ref_dir)
+            ref_d = (next(iter(refs.values()))["digest"]
+                     if rc == 0 and refs else None)
+        checks = {}
+        checks["shrunk"] = check(
+            w0.get("world_size") == 1 and agent.reconfigures >= 1,
+            "shrink: pod reshaped to world size 1 and completed")
+        checks["reshard_resume"] = check(
+            restored_from is not None and 0 < restored_from < kill_at,
+            f"shrink: survivor restored a durable 2-process checkpoint "
+            f"(step {restored_from}) onto the 1-device mesh")
+        checks["bitwise_vs_pinned_ref"] = check(
+            ref_d is not None and w0.get("digest") == ref_d,
+            "shrink: recovered state BITWISE-identical to a clean "
+            "single-process run pinned to the same restore step")
+        if not all(checks.values()):
+            print(tail_logs(os.path.join(outdir, "shrink")))
+        summaries.append(verdict(outdir, "shrink", agent, digests, checks))
+
+    # -- hang detection (heavy: dominated by liveness timeouts) -------------
+    if args.heavy and enabled("hang_detect"):
+        plan = FaultPlan([Fault(kind="hang", step=kill_at, rank=1,
+                                duration_s=600.0)])
+        agent, digests = run_pod("hang_detect", outdir, cache, nproc=2,
+                                 target=target, save_every=save_every,
+                                 plan=plan, hb_timeout_s=3.0,
+                                 peer_timeout_s=3.0, term_grace_s=3.0)
+        checks = assert_recovered("hang_detect", agent, digests)
+        checks["hang_seen"] = check(
+            any(e["kind"] in ("worker_hung", "worker_lost")
+                for e in agent.events),
+            "hang_detect: liveness (not an exit code) caught the hang")
+        summaries.append(verdict(outdir, "hang_detect", agent, digests,
+                                 checks))
+
+    # -- summary -------------------------------------------------------------
+    summary = {"ok": not FAILURES, "failures": FAILURES,
+               "elapsed_s": round(time.time() - t_all, 1),
+               "scenarios": {s["scenario"]: s["ok"] for s in summaries}}
+    with open(os.path.join(outdir, "summary.json"), "w",
+              encoding="utf-8") as fh:
+        json.dump(summary, fh, indent=2)
+    print(f"\nchaos_smoke: {'GREEN' if not FAILURES else 'FAILED'} "
+          f"({len(summaries)} scenarios, {summary['elapsed_s']}s)"
+          + (f"\n  failures: {FAILURES}" if FAILURES else ""))
+    return 1 if FAILURES else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -122,6 +122,24 @@ def run_gateway_smoke_stage() -> int:
     return subprocess.run(cmd, cwd=ROOT, env=env).returncode
 
 
+def run_chaos_smoke_stage() -> int:
+    """The graftmend chaos stage: scripted fault scenarios over the real
+    2-process gloo/DCN path (scripts/chaos_smoke.py; docs/RESILIENCE.md)
+    — kill a worker mid-step and assert BITWISE-exact recovery vs an
+    uninterrupted reference, SIGTERM graceful preemption, injected
+    coordinator/checkpoint I/O faults absorbed by the retry layer (not
+    crashes), corruption fallback, and an elastic shrink with resharding
+    restore. Per-scenario verdicts + agent event logs + flight bundles
+    land in ./chaos_artifacts — the dir ci.yml uploads (the workflow's
+    matching step is skipped below). Heavy liveness-timeout scenarios stay
+    behind --heavy / the slow test tier."""
+    cmd = [sys.executable, os.path.join(ROOT, "scripts", "chaos_smoke.py"),
+           "--outdir", os.path.join(ROOT, "chaos_artifacts")]
+    print(f"== [chaos] {' '.join(cmd[1:])}")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(cmd, cwd=ROOT, env=env).returncode
+
+
 def run_bench_check_stage() -> None:
     """ADVISORY perf-regression sentry: diff the newest BENCH_r*/
     MULTICHIP_r* round against the prior one with a tolerance band
@@ -176,6 +194,10 @@ def main():
         print("ci_local: FAILED (gateway smoke) — test tiers not run")
         return 1
 
+    if run_chaos_smoke_stage() != 0:
+        print("ci_local: FAILED (chaos smoke) — test tiers not run")
+        return 1
+
     run_bench_check_stage()
 
     wf = yaml.safe_load(open(os.path.join(ROOT, ".github/workflows/ci.yml")))
@@ -205,6 +227,9 @@ def main():
         if "scripts/gateway_smoke.py" in cmd:
             print(f"-- [skip] {name}: already run in the gateway smoke "
                   "stage")
+            continue
+        if "scripts/chaos_smoke.py" in cmd:
+            print(f"-- [skip] {name}: already run in the chaos smoke stage")
             continue
         if "scripts/bench_check.py" in cmd:
             print(f"-- [skip] {name}: already run in the bench_check stage")

@@ -19,6 +19,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from _common import (add_compile_cache_args, add_health_args,  # noqa: E402
+                     add_resilience_args, install_resilience,
                      add_overlap_args, add_profiler_args,
                      enable_compile_cache, health_obs_kwargs,
                      install_health_recorder, install_sigusr2_profiler,
@@ -64,6 +65,7 @@ def build_parser():
 
     add_overlap_args(ap)
     add_health_args(ap)
+    add_resilience_args(ap)
     add_compile_cache_args(ap)
     add_profiler_args(ap)
     from dalle_tpu.parallel import wrap_arg_parser
@@ -107,6 +109,7 @@ def main(argv=None):
         visual_enc_depth=args.depth, visual_heads=args.heads,
         visual_image_size=args.image_size, visual_patch_size=args.patch_size)
     train_cfg = TrainConfig(
+        runtime_lr_scale=args.breach_actions,
         batch_size=args.batch_size, epochs=args.epochs, seed=args.seed,
         checkpoint_dir=args.output_dir,
         save_every_steps=args.save_every_n_steps,
@@ -143,6 +146,7 @@ def main(argv=None):
         print(f"CLIP: {trainer.num_params / 1e6:.1f}M params; "
               f"mesh {dict(trainer.mesh.shape)}")
     log = print if is_root else (lambda *a, **k: None)
+    install_resilience(args, trainer, log=log)
     trainer.fit(batches, steps=args.steps, log=log)
 
     final = int(trainer.state.step)

@@ -20,6 +20,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from _common import (add_compile_cache_args, add_health_args,  # noqa: E402
+                     add_resilience_args, install_resilience,
                      add_overlap_args, add_profiler_args, add_vae_args,
                      build_vae_from_args, enable_compile_cache,
                      health_obs_kwargs, install_health_recorder,
@@ -99,6 +100,7 @@ def build_parser():
 
     add_overlap_args(ap)
     add_health_args(ap)
+    add_resilience_args(ap)
     add_compile_cache_args(ap)
     add_profiler_args(ap)
 
@@ -160,6 +162,7 @@ def main(argv=None):
         loss_img_weight=args.loss_img_weight, attn_dropout=args.attn_dropout,
         ff_dropout=args.ff_dropout)
     train_cfg = TrainConfig(
+        runtime_lr_scale=args.breach_actions,
         batch_size=args.batch_size, epochs=args.epochs, seed=args.seed,
         checkpoint_dir=args.output_dir,
         save_every_steps=args.save_every_n_steps,
@@ -267,6 +270,7 @@ def main(argv=None):
     steps = args.steps
     if args.flops_profiler:
         steps = 201  # profile at 200 then stop (reference :656-657)
+    install_resilience(args, trainer, log=log)
     trainer.fit(batches, steps=steps, log=log, sample_fn=sample_fn,
                 metrics_writer=metrics_writer)
 

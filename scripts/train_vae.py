@@ -18,6 +18,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from _common import (add_compile_cache_args, add_health_args,  # noqa: E402
+                     add_resilience_args, install_resilience,
                      add_overlap_args, add_profiler_args,
                      enable_compile_cache, health_obs_kwargs,
                      install_health_recorder, install_sigusr2_profiler,
@@ -73,6 +74,7 @@ def build_parser():
 
     add_overlap_args(ap)
     add_health_args(ap)
+    add_resilience_args(ap)
     add_compile_cache_args(ap)
     add_profiler_args(ap)
     from dalle_tpu.parallel import wrap_arg_parser
@@ -104,6 +106,7 @@ def main(argv=None):
         smooth_l1_loss=args.smooth_l1_loss, kl_div_loss_weight=args.kl_loss_weight,
         straight_through=args.straight_through)
     train_cfg = TrainConfig(
+        runtime_lr_scale=args.breach_actions,
         batch_size=args.batch_size, epochs=args.epochs, seed=args.seed,
         checkpoint_dir=args.output_dir, save_every_steps=args.save_every_steps,
         keep_n_checkpoints=args.keep_n_checkpoints,
@@ -172,6 +175,7 @@ def main(argv=None):
             log(f"[step {step}] recon grid → {args.sample_dir}; "
                 f"codebook codes used: {used}/{model_cfg.num_tokens}")
 
+    install_resilience(args, trainer, log=log)
     trainer.fit(batches, steps=args.steps, log=log, sample_fn=sample_fn,
                 metrics_writer=metrics_writer)
     if metrics_writer is not None:
@@ -179,9 +183,9 @@ def main(argv=None):
 
     final = int(trainer.state.step)
     if trainer.ckpt.latest_step() != final:  # avoid re-saving an existing step
-        trainer.ckpt.save(final, trainer.state,
-                          {"hparams": model_cfg.to_dict(), "train": train_cfg.to_dict(),
-                           "model_class": "DiscreteVAE"})
+        # _meta(), not a hand-built dict: extra_meta carries mid-run state
+        # (the gumbel re-anneal rebase) that a resume must see
+        trainer.ckpt.save(final, trainer.state, trainer._meta())
     trainer.ckpt.wait_until_finished()   # final step durable before exit
     if backend.is_root_worker():
         print(f"done at step {final}; checkpoints in {args.output_dir}")

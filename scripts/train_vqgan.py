@@ -20,6 +20,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from _common import (add_compile_cache_args, add_health_args,  # noqa: E402
+                     add_resilience_args, install_resilience,
                      add_overlap_args, add_profiler_args,
                      enable_compile_cache, health_obs_kwargs,
                      install_health_recorder, install_sigusr2_profiler,
@@ -83,6 +84,7 @@ def build_parser():
 
     add_overlap_args(ap)
     add_health_args(ap)
+    add_resilience_args(ap)
     add_compile_cache_args(ap)
     add_profiler_args(ap)
     from dalle_tpu.parallel import wrap_arg_parser
@@ -124,6 +126,7 @@ def main(argv=None):
         disc_loss=args.disc_loss, codebook_weight=args.codebook_weight,
         perceptual_weight=args.perceptual_weight, use_actnorm=args.use_actnorm)
     train_cfg = TrainConfig(
+        runtime_lr_scale=args.breach_actions,
         batch_size=args.batch_size, epochs=args.epochs, seed=args.seed,
         checkpoint_dir=args.output_dir, save_every_steps=args.save_every_steps,
         keep_n_checkpoints=args.keep_n_checkpoints,
@@ -200,6 +203,7 @@ def main(argv=None):
                                           key="reconstructions")
             log(f"[step {step}] recon grid → {args.sample_dir}")
 
+    install_resilience(args, trainer, log=log)
     trainer.fit(batches, steps=args.steps, log=log, sample_fn=sample_fn,
                 metrics_writer=metrics_writer)
     if metrics_writer is not None:

@@ -1,0 +1,873 @@
+"""graftmend chaos-lite tier-1 tests (docs/RESILIENCE.md): the fault classes
+that don't need subprocesses — retry-decorator semantics incl. budget
+exhaustion and obs counters, FaultPlan scripting/scoping/injection,
+checkpoint stale-tmp GC + corruption fallback, breach→action
+edge-triggering for all three policy actions, SIGTERM graceful preemption
+at the fit level, and the elastic membership/heartbeat/agent machinery
+(agent tests drive real — but jax-free — python children). The real
+2-process gloo/DCN recovery scenarios live in scripts/chaos_smoke.py (CI
+stage) and the slow tier below it."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from dalle_tpu import chaos, obs
+from dalle_tpu.chaos import Fault, FaultPlan, InjectedFault
+from dalle_tpu.chaos.faults import corrupt_checkpoint
+from dalle_tpu.config import DVAEConfig, TrainConfig
+from dalle_tpu.obs.anomaly import Breach, HealthSentry, NaNPrecursorDetector
+from dalle_tpu.parallel import elastic
+from dalle_tpu.train.actions import BreachActions
+from dalle_tpu.train.base_trainer import BaseTrainer
+from dalle_tpu.train.checkpoints import CheckpointManager
+from dalle_tpu.train.metrics import ThroughputMeter
+from dalle_tpu.train.train_state import TrainState
+from dalle_tpu.utils.retry import (RetryBudgetExceeded, backoff_delays,
+                                   retry, with_retry)
+
+pytestmark = pytest.mark.recompile_budget(120)
+
+NO_SLEEP = {"sleep": lambda s: None}
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_and_chaos():
+    """Fresh tracer (counters are global) and no leaked FaultPlan/recorder
+    between tests."""
+    obs.disable()
+    obs.configure()
+    yield
+    chaos.uninstall()
+    obs.disable_recorder()
+    obs.disable()
+
+
+def counters():
+    return obs.metrics_snapshot()
+
+
+# ---------------------------------------------------------------------------
+# retry layer
+# ---------------------------------------------------------------------------
+
+def test_retry_absorbs_transient_failures_with_counters():
+    calls = []
+
+    @retry("op_a", attempts=4, sleep=lambda s: calls.append(("sleep", s)),
+           seed=7)
+    def flaky():
+        calls.append(("try",))
+        if sum(1 for c in calls if c[0] == "try") < 3:
+            raise OSError("blip")
+        return "done"
+
+    assert flaky() == "done"
+    assert sum(1 for c in calls if c[0] == "try") == 3
+    # the two backoff sleeps follow the seeded schedule exactly
+    slept = [s for kind, *rest in calls if kind == "sleep" for s in rest]
+    assert slept == backoff_delays(4, seed=7)[:2]
+    snap = counters()
+    assert snap['retry.attempts_total{op="op_a"}'] == 2
+    assert snap['retry.recovered_total{op="op_a"}'] == 1
+    assert 'retry.exhausted_total{op="op_a"}' not in snap
+
+
+def test_retry_budget_exhaustion_chains_the_root_cause():
+    @retry("op_b", attempts=3, **NO_SLEEP)
+    def always():
+        raise ConnectionError("down")
+
+    with pytest.raises(RetryBudgetExceeded) as ei:
+        always()
+    assert isinstance(ei.value.__cause__, ConnectionError)
+    assert ei.value.attempts == 3
+    snap = counters()
+    assert snap['retry.attempts_total{op="op_b"}'] == 3
+    assert snap['retry.exhausted_total{op="op_b"}'] == 1
+
+
+def test_retry_non_transient_propagates_immediately():
+    calls = []
+
+    @retry("op_c", attempts=5, **NO_SLEEP)
+    def broken():
+        calls.append(1)
+        raise ValueError("deterministic bug")
+
+    with pytest.raises(ValueError):
+        broken()
+    assert calls == [1]          # no retry burned hiding a real bug
+    assert 'retry.attempts_total{op="op_c"}' not in counters()
+
+
+def test_backoff_schedule_deterministic_and_bounded():
+    a = backoff_delays(6, base_delay_s=0.05, max_delay_s=0.4, jitter=0.5,
+                       seed=3)
+    assert a == backoff_delays(6, base_delay_s=0.05, max_delay_s=0.4,
+                               jitter=0.5, seed=3)
+    assert len(a) == 5
+    for i, d in enumerate(a):
+        nominal = min(0.05 * 2 ** i, 0.4)
+        assert 0.5 * nominal <= d <= 1.5 * nominal
+
+
+def test_with_retry_call_form():
+    calls = []
+
+    def op(x):
+        calls.append(x)
+        if len(calls) < 2:
+            raise TimeoutError
+        return x * 2
+
+    assert with_retry("op_d", op, 21, retry_kw=dict(NO_SLEEP)) == 42
+    assert calls == [21, 21]
+
+
+# ---------------------------------------------------------------------------
+# fault plan
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_env_roundtrip_with_rank_and_epoch():
+    plan = FaultPlan([Fault(kind="kill", step=3, rank=1, signal="SIGTERM"),
+                      Fault(kind="fail_io", site="ckpt_save", times=2)],
+                     seed=9)
+    env = dict(plan.env())
+    env[chaos.RANK_ENV] = "1"
+    env[chaos.EPOCH_ENV] = "2"
+    installed = chaos.install_from_env(env)
+    assert installed is chaos.active_plan()
+    assert installed.rank == 1 and installed.epoch == 2
+    assert installed.seed == 9
+    assert [f.kind for f in installed.faults] == ["kill", "fail_io"]
+
+
+def test_fail_io_fires_times_then_heals():
+    chaos.install(FaultPlan([Fault(kind="fail_io", site="ckpt_save",
+                                   times=2)]))
+    for _ in range(2):
+        with pytest.raises(InjectedFault):
+            chaos.io_hook("ckpt_save")
+    chaos.io_hook("ckpt_save")           # healed
+    chaos.io_hook("ckpt_restore")        # other sites never affected
+    assert counters()['chaos.faults_injected_total{kind="fail_io"}'] == 2
+
+
+def test_fault_scoping_by_rank_and_epoch():
+    faults = [Fault(kind="fail_io", site="heartbeat", rank=1, times=5),
+              Fault(kind="fail_io", site="ckpt_save", epoch=0, times=5)]
+    # wrong rank: rank-1 fault silent on rank 0
+    chaos.install(FaultPlan(faults, rank=0))
+    chaos.io_hook("heartbeat")
+    # right rank fires
+    chaos.install(FaultPlan(faults, rank=1))
+    with pytest.raises(InjectedFault):
+        chaos.io_hook("heartbeat")
+    # a respawned worker in epoch 1 must NOT re-fire epoch-0 faults
+    chaos.install(FaultPlan(faults, rank=0, epoch=1))
+    chaos.io_hook("ckpt_save")
+
+
+def test_step_faults_slow_and_kill(monkeypatch):
+    sleeps, kills = [], []
+    monkeypatch.setattr(chaos.faults.time, "sleep",
+                        lambda s: sleeps.append(s))
+    monkeypatch.setattr(chaos.faults.os, "kill",
+                        lambda pid, sig: kills.append((pid, sig)))
+    chaos.install(FaultPlan([
+        Fault(kind="slow", step=2, span_steps=2, duration_s=0.5),
+        Fault(kind="kill", step=4, signal="SIGTERM")]))
+    for s in range(6):
+        chaos.step_hook(s)
+    assert sleeps == [0.5, 0.5]          # slowed exactly steps 2 and 3
+    assert kills == [(os.getpid(), signal.SIGTERM)]   # fired once, at 4
+    assert counters()['chaos.faults_injected_total{kind="kill"}'] == 1
+
+
+def test_plan_sample_is_seed_deterministic():
+    a = FaultPlan.sample(5, nproc=3, max_step=10, kinds=("kill", "fail_io"))
+    b = FaultPlan.sample(5, nproc=3, max_step=10, kinds=("kill", "fail_io"))
+    assert a.to_json() == b.to_json()
+    c = FaultPlan.sample(6, nproc=3, max_step=10, kinds=("kill", "fail_io"))
+    assert c.to_json() != a.to_json()
+
+
+def test_corrupt_checkpoint_tmp_litter_and_truncate(tmp_path):
+    d = str(tmp_path)
+    os.makedirs(os.path.join(d, "4"))
+    with open(os.path.join(d, "4", "data.bin"), "wb") as fh:
+        fh.write(b"x" * 64)
+    planted = corrupt_checkpoint(d, mode="tmp_litter", age_s=5000)[0]
+    assert ".orbax-checkpoint-tmp" in planted
+    assert time.time() - os.path.getmtime(planted) > 4000
+    touched = corrupt_checkpoint(d, mode="truncate")
+    assert touched and os.path.getsize(touched[0]) == 0
+
+
+# ---------------------------------------------------------------------------
+# checkpoint hardening (real orbax over tiny trees)
+# ---------------------------------------------------------------------------
+
+def _mgr(tmp_path, **kw):
+    m = CheckpointManager(str(tmp_path), async_save=False, **kw)
+    m.retry_kw = dict(m.retry_kw, sleep=lambda s: None)
+    return m
+
+
+STATE = {"w": jnp.arange(4.0), "b": jnp.zeros(2)}
+
+
+def test_gc_stale_tmp_reclaims_old_keeps_fresh(tmp_path):
+    m = _mgr(tmp_path)
+    stale = corrupt_checkpoint(str(tmp_path), mode="tmp_litter",
+                               age_s=10_000)[0]
+    fresh = os.path.join(str(tmp_path), "8888.orbax-checkpoint-tmp-1")
+    os.makedirs(fresh)
+    reclaimed = m.gc_stale_tmp(log=lambda *a: None)
+    assert reclaimed == [stale]
+    assert not os.path.exists(stale) and os.path.exists(fresh)
+    assert counters()["ckpt.tmp_reclaimed_total"] == 1
+    m.close()
+
+
+def test_gc_runs_on_restore_and_preflight(tmp_path):
+    m = _mgr(tmp_path)
+    m.save(1, STATE)
+    stale = corrupt_checkpoint(str(tmp_path), mode="tmp_litter",
+                               age_s=10_000)[0]
+    m.restore(STATE, log=lambda *a: None)
+    assert not os.path.exists(stale)
+    stale2 = corrupt_checkpoint(str(tmp_path), mode="tmp_litter",
+                                age_s=10_000)[0]
+    m.preflight(STATE)
+    assert not os.path.exists(stale2)
+    m.close()
+
+
+def test_restore_falls_back_past_corrupt_step_and_quarantines(tmp_path):
+    m = _mgr(tmp_path)
+    m.save(1, {"w": jnp.arange(4.0) * 1, "b": jnp.zeros(2)})
+    m.save(2, {"w": jnp.arange(4.0) * 2, "b": jnp.zeros(2)})
+    corrupt_checkpoint(str(tmp_path), mode="truncate")      # newest = 2
+    restored, _meta = m.restore(STATE, log=lambda *a: None)
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.arange(4.0))           # step 1 state
+    assert counters()["ckpt.restore_fallback_total"] >= 1
+    assert os.path.isdir(os.path.join(str(tmp_path), "2.corrupt"))
+    # the quarantined step number is reusable: resumed training re-saves 2
+    m.save(2, STATE)
+    m.close()
+
+
+def test_restore_every_step_failing_raises_and_quarantines_nothing(tmp_path):
+    """Quarantine is deferred until SOME step restores: when every step
+    fails (all-corrupt here, but equally a template↔checkpoint tree
+    mismatch or a broken reader), the error propagates with the on-disk
+    history untouched — a systemic failure must not rename it away."""
+    m = _mgr(tmp_path)
+    m.save(1, STATE)
+    m.save(2, STATE)
+    corrupt_checkpoint(str(tmp_path), mode="truncate")
+    shutil_target = os.path.join(str(tmp_path), "1")
+    corrupt_checkpoint(str(tmp_path), mode="truncate")  # hits newest again
+    for dirpath, _d, files in os.walk(shutil_target):
+        for fn in files:
+            open(os.path.join(dirpath, fn), "wb").close()
+    with pytest.raises(RuntimeError, match="failed to restore"):
+        m.restore(STATE, log=lambda *a: None)
+    assert os.path.isdir(os.path.join(str(tmp_path), "1"))
+    assert os.path.isdir(os.path.join(str(tmp_path), "2"))
+    assert not any(n.endswith(".corrupt") for n in os.listdir(str(tmp_path)))
+    m.close()
+
+
+def test_pinned_restore_does_not_fall_back(tmp_path):
+    m = _mgr(tmp_path)
+    m.save(1, STATE)
+    m.save(2, STATE)
+    corrupt_checkpoint(str(tmp_path), mode="truncate")
+    with pytest.raises(Exception):
+        m.restore(STATE, step=2, log=lambda *a: None)
+    assert os.path.isdir(os.path.join(str(tmp_path), "2"))  # not quarantined
+    m.close()
+
+
+def test_transient_restore_exhaustion_does_not_quarantine(tmp_path):
+    """RetryBudgetExceeded is an INFRASTRUCTURE failure, not corruption:
+    the fallback must re-raise instead of renaming a healthy newest step
+    to .corrupt and silently resuming from older progress."""
+    from dalle_tpu.utils.retry import RetryBudgetExceeded
+    m = _mgr(tmp_path)
+    m.save(1, STATE)
+    m.save(2, STATE)
+    chaos.install(FaultPlan([Fault(kind="fail_io", site="ckpt_restore",
+                                   times=99)]))
+    with pytest.raises(RetryBudgetExceeded):
+        m.restore(STATE, log=lambda *a: None)
+    chaos.uninstall()
+    assert os.path.isdir(os.path.join(str(tmp_path), "2"))
+    assert not os.path.isdir(os.path.join(str(tmp_path), "2.corrupt"))
+    # healed I/O: the same newest step restores fine afterwards
+    restored, _ = m.restore(STATE, log=lambda *a: None)
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.asarray(STATE["w"]))
+    m.close()
+
+
+def test_vanished_step_skipped_without_quarantine(tmp_path):
+    """In a pod every member races the same fallback: a step a PEER
+    already quarantined reads as FileNotFoundError here — skip it (there
+    is nothing to quarantine) and keep falling back, never crash."""
+    from dalle_tpu.utils.retry import RetryBudgetExceeded
+    m = _mgr(tmp_path)
+    m.save(1, STATE)
+    m.save(2, STATE)
+    real = m._restore_step
+
+    def racing(template, step):
+        if step == 2:
+            raise RetryBudgetExceeded(
+                "ckpt_restore", 4, FileNotFoundError("peer renamed it"))
+        return real(template, step)
+
+    m._restore_step = racing
+    restored, _ = m.restore(STATE, log=lambda *a: None)
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.asarray(STATE["w"]))
+    assert not os.path.isdir(os.path.join(str(tmp_path), "2.corrupt"))
+    m.close()
+
+
+def test_injected_ckpt_io_faults_absorbed_by_retry(tmp_path):
+    m = _mgr(tmp_path)
+    chaos.install(FaultPlan([
+        Fault(kind="fail_io", site="ckpt_save", times=2),
+        Fault(kind="fail_io", site="ckpt_restore", times=1)]))
+    m.save(3, STATE)                      # absorbed, not a crash
+    restored, _ = m.restore(STATE, log=lambda *a: None)
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.asarray(STATE["w"]))
+    snap = counters()
+    assert snap['retry.attempts_total{op="ckpt_save"}'] == 2
+    assert snap['retry.recovered_total{op="ckpt_save"}'] == 1
+    assert snap['retry.attempts_total{op="ckpt_restore"}'] == 1
+    m.close()
+
+
+# ---------------------------------------------------------------------------
+# breach→action automation
+# ---------------------------------------------------------------------------
+
+class TinyTrainer(BaseTrainer):
+    """Real TrainState + rollback machinery over a 2-element param tree —
+    no model, no mesh, no compiled step; the action layer under test is
+    pure host code over real jax arrays."""
+
+    model_class = "Tiny"
+
+    def __init__(self, tmp_path):
+        self.train_cfg = TrainConfig(
+            checkpoint_dir=str(tmp_path), preflight_checkpoint=False,
+            rollback_snapshot="host")
+        self.model_cfg = DVAEConfig()
+        self.ckpt = None
+        self.meter = ThroughputMeter(4, 1)
+        self.extra_meta = {}
+        self._host_step = 0
+        self.state = TrainState.create(
+            apply_fn=lambda p, x: x, params={"w": jnp.ones(3)},
+            tx=optax.sgd(0.1), lr_scale=1.0)
+        self.reanneals = []
+
+    def reanneal_gumbel(self, step):
+        self.reanneals.append(step)
+        return 1.0
+
+
+def test_each_policy_action_fires_and_is_recorded(tmp_path):
+    obs.configure_recorder(str(tmp_path / "flight"), min_dump_interval_s=0.0)
+    tr = TinyTrainer(tmp_path)
+    tr._snapshot_good()
+    acts = BreachActions(tr, log=lambda *a: None).attach()
+    assert tr.health_sentry is not None and tr.health_sentry.on_breach is acts
+
+    acts(Breach("nan-precursor", "enc", 1, 0.01, 0.0, "inj"))
+    assert tr._preemptive_good is not None
+
+    tr.state = tr.state.replace(params={"w": jnp.zeros(3)})
+    acts(Breach("grad-explosion", "dec", 2, 99.0, 5.0, "inj"))
+    assert float(jnp.asarray(tr.state.lr_scale)) == pytest.approx(0.5)
+
+    acts(Breach("codebook-collapse", "codebook", 3, 1.0, 4.0, "inj"))
+    assert float(jnp.asarray(tr.state.lr_scale)) == pytest.approx(0.25)
+    assert tr.reanneals == [3]
+
+    assert [a[1] for a in acts.fired] == [
+        "preemptive_snapshot", "rollback_lr_cut", "lr_cut_reanneal"]
+    events = [e for e in obs.get_recorder().events
+              if e.get("kind") == "breach_action"]
+    assert [e["action"] for e in events] == [a[1] for a in acts.fired]
+    snap = counters()
+    for action in ("preemptive_snapshot", "rollback_lr_cut",
+                   "lr_cut_reanneal"):
+        assert snap[f'actions.fired_total{{action="{action}"}}'] == 1
+
+
+def test_exactly_one_action_per_breach_edge(tmp_path):
+    """The sentry is edge-triggered and the action layer coalesces: a
+    sustained nan-precursor breach fires ONE preemptive snapshot, re-armed
+    only after recovery."""
+    tr = TinyTrainer(tmp_path)
+    tr._snapshot_good()
+    tr.health_sentry = HealthSentry([NaNPrecursorDetector()],
+                                    dump_bundles=False)
+    acts = BreachActions(tr, log=lambda *a: None).attach()
+    bad = {"health/nonfinite_frac/enc": 0.3}
+    good = {"health/nonfinite_frac/enc": 0.0}
+    tr.health_sentry.observe(1, dict(bad))
+    tr.health_sentry.observe(2, dict(bad))     # still in breach: no re-fire
+    tr.health_sentry.observe(3, dict(bad))
+    assert len(acts.fired) == 1
+    tr.health_sentry.observe(4, dict(good))    # recovery re-arms
+    tr.health_sentry.observe(5, dict(bad))
+    assert len(acts.fired) == 2
+
+
+def test_same_step_multi_group_breaches_coalesce(tmp_path):
+    tr = TinyTrainer(tmp_path)
+    tr._snapshot_good()
+    acts = BreachActions(tr, log=lambda *a: None)
+    acts(Breach("grad-explosion", "enc", 7, 9.0, 1.0, "inj"))
+    acts(Breach("grad-explosion", "dec", 7, 8.0, 1.0, "inj"))
+    assert len(acts.fired) == 1      # five subtrees exploding ≠ 5 rollbacks
+
+
+def test_lr_cut_clamps_at_min_scale(tmp_path):
+    tr = TinyTrainer(tmp_path)
+    tr._snapshot_good()
+    acts = BreachActions(tr, lr_cut_factor=0.1, min_lr_scale=0.05,
+                         log=lambda *a: None)
+    for step in range(1, 4):
+        acts(Breach("grad-explosion", "enc", step * 2, 9.0, 1.0, "inj"))
+    assert float(jnp.asarray(tr.state.lr_scale)) == pytest.approx(0.05)
+
+
+def test_lr_scale_actually_scales_the_applied_update():
+    st = TrainState.create(apply_fn=None, params={"w": jnp.ones(3)},
+                           tx=optax.sgd(0.1), lr_scale=1.0)
+    grads = {"w": jnp.ones(3)}
+    full = st.apply_gradients(grads)
+    halved = st.replace(lr_scale=jnp.float32(0.5)).apply_gradients(grads)
+    np.testing.assert_allclose(
+        np.asarray(halved.params["w"]) - np.asarray(st.params["w"]),
+        0.5 * (np.asarray(full.params["w"]) - np.asarray(st.params["w"])),
+        rtol=1e-6)
+
+
+def test_lr_scale_is_opt_in_and_absent_by_default():
+    """Default states carry NO lr_scale leaf: the compiled step must stay
+    byte-identical to the scale-less program (the leaf's per-param multiply
+    taxes compile time across every trainer program — measured ~11% on the
+    dalle trainer module), and the graftir goldens pin that. Armed states
+    get the leaf at CREATE time only."""
+    base = TrainState.create(apply_fn=None, params={"w": jnp.ones(3)},
+                             tx=optax.sgd(0.1))
+    assert base.lr_scale is None
+    assert len(jax.tree_util.tree_leaves((base.lr_scale,))) == 0
+    # un-armed apply_gradients is the plain update (no scale multiply)
+    stepped = base.apply_gradients({"w": jnp.ones(3)})
+    np.testing.assert_allclose(np.asarray(stepped.params["w"]),
+                               np.ones(3) - 0.1, rtol=1e-6)
+
+
+def test_preemptive_snapshot_rollback_ladder(tmp_path):
+    """First rollback consumes the precursor rung; a repeat NaN falls
+    through to the durable boundary snapshot — the ladder never loops on a
+    poisoned rung."""
+    tr = TinyTrainer(tmp_path)
+    tr.state = tr.state.replace(params={"w": jnp.ones(3) * 10})
+    tr._snapshot_good()                                   # boundary: 10s
+    tr.state = tr.state.replace(params={"w": jnp.ones(3) * 20})
+    tr.take_preemptive_snapshot()                         # rung: 20s
+    tr.state = tr.state.replace(params={"w": jnp.ones(3) * 30})
+    tr._rollback()
+    np.testing.assert_array_equal(np.asarray(tr.state.params["w"]),
+                                  np.ones(3) * 20)        # rung consumed
+    tr._rollback()
+    np.testing.assert_array_equal(np.asarray(tr.state.params["w"]),
+                                  np.ones(3) * 10)        # boundary snapshot
+
+
+def test_boundary_snapshot_supersedes_preemptive_rung(tmp_path):
+    tr = TinyTrainer(tmp_path)
+    tr.state = tr.state.replace(params={"w": jnp.ones(3) * 5})
+    tr.take_preemptive_snapshot()
+    tr.state = tr.state.replace(params={"w": jnp.ones(3) * 6})
+    tr._snapshot_good()     # newer durable point: the stale rung must die
+    tr._rollback()
+    np.testing.assert_array_equal(np.asarray(tr.state.params["w"]),
+                                  np.ones(3) * 6)
+
+
+def test_lr_cut_skips_states_without_the_field(tmp_path):
+    """GANTrainState (full-GAN VQGAN) has no lr_scale FIELD at all — the
+    cut must degrade to a logged skip, not an AttributeError that eats
+    the action after the rollback already ran."""
+    logs = []
+    tr = TinyTrainer(tmp_path)
+
+    class FieldlessState:
+        params = {"w": jnp.ones(1)}
+        opt_state = {}
+
+    tr.state = FieldlessState()
+    acts = BreachActions(tr, log=logs.append)
+    assert acts._cut_lr() == 1.0
+    assert any("skipped" in l for l in logs)
+
+
+def test_action_failure_degrades_to_log_not_crash(tmp_path):
+    logs = []
+    tr = TinyTrainer(tmp_path)
+    acts = BreachActions(tr, log=logs.append)
+    acts._handlers["rollback_lr_cut"] = lambda b: 1 / 0
+    acts(Breach("grad-explosion", "enc", 1, 9.0, 1.0, "inj"))
+    assert acts.fired == []
+    assert any("failed" in l for l in logs)
+
+
+def test_reanneal_rebase_survives_checkpoint_restore(tmp_path):
+    """The codebook-collapse remediation must survive the preemption/
+    respawn this same PR makes routine: the re-anneal rebase rides
+    checkpoint metadata, so a respawned trainer resumes the re-warmed
+    schedule instead of snapping back to the cold temperature."""
+    from dalle_tpu.config import AnnealConfig, DVAEConfig
+    from dalle_tpu.train.trainer_vae import VAETrainer
+    cfg = DVAEConfig(image_size=16, num_tokens=16, codebook_dim=8,
+                     num_layers=1, num_resnet_blocks=0, hidden_dim=8)
+    tc = TrainConfig(batch_size=2, checkpoint_dir=str(tmp_path),
+                     preflight_checkpoint=False, async_checkpointing=False,
+                     save_every_steps=100)
+    anneal = AnnealConfig(starting_temp=1.0, anneal_rate=0.1, temp_min=0.1)
+    tr = VAETrainer(cfg, tc, anneal_cfg=anneal)
+    tr._host_step = 40
+    warmed = tr.reanneal_gumbel(40)
+    assert warmed == pytest.approx(1.0)          # schedule restarted
+    tr.state = tr.state.replace(step=jnp.asarray(40))
+    tr.ckpt.save(40, tr.state, tr._meta())
+
+    fresh = VAETrainer(cfg, tc, anneal_cfg=anneal)
+    fresh.restore()
+    assert fresh._anneal_step0 == 40
+    assert fresh._temp_at(41) == pytest.approx(tr._temp_at(41))
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM graceful preemption (fit level)
+# ---------------------------------------------------------------------------
+
+class RecordingCkpt:
+    def __init__(self):
+        self.saves = []
+        self.metas = []
+        self.drains = 0
+
+    def preflight(self, state, meta=None):
+        pass
+
+    def save(self, step, state, meta=None):
+        self.saves.append(step)
+        self.metas.append(dict(meta or {}))
+
+    def wait_until_finished(self):
+        self.drains += 1
+
+
+class FakeTrainer(BaseTrainer):
+    model_class = "Fake"
+
+    def __init__(self, tc):
+        self.train_cfg = tc
+        self.model_cfg = DVAEConfig()
+        self.ckpt = RecordingCkpt()
+        self.meter = ThroughputMeter(tc.batch_size, tc.log_every)
+        self.extra_meta = {}
+        self.state = None
+        self._host_step = 0
+        self._obs_dispatch_t0 = None
+        self._obs_last_wait = 0.0
+        self._obs_wait_accum = 0.0
+        self._obs_window_t0 = None
+
+    def train_step(self, x):
+        return self._finish_step({"loss": np.float32(0.5)})
+
+    def _snapshot_good(self):
+        pass
+
+
+def test_sigterm_finishes_step_saves_drains_and_exits_fit(tmp_path):
+    """The k8s/TPU-preemption contract: a real SIGTERM mid-run finishes the
+    in-flight step, forces a synchronous drained save through the
+    signal-latch path, and fit returns early with ``preempted`` set (the
+    CLI then exits 0)."""
+    tc = TrainConfig(checkpoint_dir=str(tmp_path), batch_size=4,
+                     log_every=100, save_every_steps=100,
+                     preflight_checkpoint=False, device_prefetch=0)
+    tr = FakeTrainer(tc)
+    tr.install_preemption_handler(log=lambda *a: None)
+    consumed = []
+
+    def batches():
+        for i in range(10):
+            if i == 3:
+                os.kill(os.getpid(), signal.SIGTERM)   # the real signal
+            consumed.append(i)
+            yield (np.zeros((4, 8), np.float32),)
+
+    tr.fit(batches(), steps=10, log=lambda *a: None)
+    assert tr.preempted
+    # the in-flight step (the one the signal landed in) completed and was
+    # saved synchronously + drained; nothing after it ran
+    assert tr._host_step == 4
+    assert tr.ckpt.saves == [4]
+    assert tr.ckpt.drains >= 1
+    assert consumed == [0, 1, 2, 3]
+
+
+def test_fit_saves_carry_current_extra_meta(tmp_path):
+    """fit must re-evaluate _meta() at each save: extra_meta changes
+    mid-run (the gumbel re-anneal action records its rebase there) and a
+    stale snapshot taken before the loop would strand every later
+    checkpoint's sidecar on the pre-breach values."""
+    tc = TrainConfig(checkpoint_dir=str(tmp_path), batch_size=4,
+                     log_every=100, save_every_steps=2,
+                     preflight_checkpoint=False, device_prefetch=0)
+    tr = FakeTrainer(tc)
+
+    def mutate(step):
+        tr.extra_meta["anneal_step0"] = step
+
+    tr.fit(iter([(np.zeros((4, 8), np.float32),) for _ in range(4)]),
+           steps=4, log=lambda *a: None, on_step=mutate)
+    assert tr.ckpt.saves == [2, 4]
+    assert [m.get("anneal_step0") for m in tr.ckpt.metas] == [2, 4]
+
+
+def test_sigterm_handler_is_idempotent_and_rearmable(tmp_path):
+    tc = TrainConfig(checkpoint_dir=str(tmp_path), batch_size=4,
+                     preflight_checkpoint=False)
+    tr = FakeTrainer(tc)
+    tr.install_preemption_handler(log=lambda *a: None)
+    os.kill(os.getpid(), signal.SIGTERM)
+    os.kill(os.getpid(), signal.SIGTERM)       # second latch: no effect
+    assert tr._preempt and tr._signal_save
+    tr.install_preemption_handler(log=lambda *a: None)   # re-arm for reuse
+    assert not tr._preempt and not tr.preempted
+
+
+# ---------------------------------------------------------------------------
+# elastic runtime units (membership, heartbeats, the agent over jax-free
+# python children)
+# ---------------------------------------------------------------------------
+
+def test_epoch_file_roundtrip_and_process_ids(tmp_path):
+    ef = elastic.EpochFile(str(tmp_path))
+    assert ef.read() is None
+    ep = ef.write(elastic.Epoch(epoch=3, members=[0, 2], port=12345))
+    got = ef.read()
+    assert got == ep
+    assert got.nproc == 2 and got.coordinator_address == "127.0.0.1:12345"
+    assert got.process_id(2) == 1 and got.process_id(1) is None
+
+
+def test_heartbeat_write_read_stale_and_throttle(tmp_path):
+    d = str(tmp_path)
+    hb = elastic.Heartbeat(d, 0, interval_s=30.0)
+    assert hb.beat(step=5, epoch=1)
+    assert not hb.beat(step=6)                  # throttled
+    assert hb.beat(step=6, force=True)
+    beats = elastic.read_heartbeats(d)
+    assert beats[0]["step"] == 6 and beats[0]["pid"] == os.getpid()
+    now = time.time()
+    assert elastic.stale_workers(d, [0, 1], 5.0, now=now) == [1]  # missing
+    assert elastic.stale_workers(d, [0], 5.0, now=now + 60) == [0]
+
+
+def test_hung_workers_progress_and_age_semantics(tmp_path):
+    """hung = provably wedged: fresh beat with a frozen step (live beater,
+    hung main thread) or a stale existing file (frozen process). A missing
+    file or a fresh setup-phase beat (no step yet — first-step compile) is
+    NOT hung."""
+    d = str(tmp_path)
+    now = 1000.0
+
+    def write(wid, t, step, step_time):
+        with open(os.path.join(d, f"hb_{wid}.json"), "w") as fh:
+            json.dump({"worker_id": wid, "pid": 1, "time": t,
+                       "step": step, "step_time": step_time}, fh)
+
+    write(0, now - 0.1, 7, now - 0.2)        # healthy: advancing
+    write(1, now - 0.1, 5, now - 10.0)       # hung main thread
+    write(2, now - 10.0, 3, now - 10.0)      # frozen process
+    write(3, now - 0.1, None, None)          # still compiling/restoring
+    assert elastic.hung_workers(d, [0, 1, 2, 3, 4], 2.0, now=now) == [1, 2]
+    # stale_workers keeps missing-as-stale (agent bootstrap semantics)
+    assert 4 in elastic.stale_workers(d, [0, 4], 2.0, now=now)
+
+
+def test_worker_beater_keeps_file_fresh_while_main_thread_sleeps(tmp_path):
+    ep = elastic.Epoch(epoch=0, members=[0], port=1)
+    w = elastic.ElasticWorker(str(tmp_path), 0, ep, hb_interval_s=0.05)
+    w.start()
+    try:
+        w.on_step(1)
+        t = elastic.read_heartbeats(str(tmp_path))[0]["time"]
+        time.sleep(0.3)       # main thread idle: the beater must publish
+        doc = elastic.read_heartbeats(str(tmp_path))[0]
+        assert doc["time"] > t
+        assert doc["step"] == 1       # progress unchanged, presence fresh
+    finally:
+        w.stop()
+
+
+def test_heartbeat_injected_fault_absorbed_by_retry(tmp_path):
+    chaos.install(FaultPlan([Fault(kind="fail_io", site="heartbeat",
+                                   times=1)]))
+    hb = elastic.Heartbeat(str(tmp_path), 1, interval_s=0.0)
+    assert hb.beat(step=1, force=True)          # retried through the fault
+    assert counters()['retry.attempts_total{op="heartbeat"}'] == 1
+    assert elastic.read_heartbeats(str(tmp_path))[1]["step"] == 1
+
+
+def test_on_step_survives_heartbeat_outage_past_the_budget(tmp_path):
+    """A heartbeat outage longer than the retry budget must not kill the
+    training loop it reports on — the stale file IS the failure signal."""
+    logs = []
+    ep = elastic.Epoch(epoch=0, members=[0], port=1)
+    w = elastic.ElasticWorker(str(tmp_path), 0, ep, log=logs.append)
+    chaos.install(FaultPlan([Fault(kind="fail_io", site="heartbeat",
+                                   times=99)]))
+    w.on_step(3)                                # must not raise
+    assert any("heartbeat beat failed" in l for l in logs)
+
+
+# -- agent over tiny jax-free children ---------------------------------------
+
+CHILD = """
+import json, os, sys, time
+run_dir, wid = sys.argv[1], sys.argv[2]
+mode = sys.argv[3]
+marker = os.path.join(run_dir, f"crashed_{wid}")
+ep = json.load(open(os.path.join(run_dir, "epoch.json")))
+def beat():
+    p = os.path.join(run_dir, f"hb_{wid}.json")
+    tmp = p + ".tmp"
+    json.dump({"worker_id": int(wid), "pid": os.getpid(),
+               "time": time.time()}, open(tmp, "w"))
+    os.replace(tmp, p)
+beat()
+if mode == "crash_once" and wid == "1" and not os.path.exists(marker):
+    open(marker, "w").close()
+    sys.exit(1)
+if mode == "crash_always" and wid == "1":
+    sys.exit(1)
+if mode == "reconfigure_once" and wid == "1" and not os.path.exists(marker):
+    open(marker, "w").close()
+    sys.exit(77)
+if mode == "hang" and wid == "1" and not os.path.exists(marker):
+    open(marker, "w").close()
+    time.sleep(600)
+for _ in range(3):
+    beat(); time.sleep(0.05)
+sys.exit(0)
+"""
+
+
+def _agent(tmp_path, mode, **kw):
+    run_dir = str(tmp_path / "pod")
+    os.makedirs(run_dir, exist_ok=True)
+    script = tmp_path / "child.py"
+    script.write_text(CHILD)
+
+    def spawn(worker_id, epoch):
+        return subprocess.Popen(
+            [sys.executable, str(script), run_dir, str(worker_id), mode])
+
+    return elastic.ElasticAgent(run_dir, spawn, members=[0, 1],
+                                poll_s=0.05, term_grace_s=2.0, **kw)
+
+
+def test_agent_respawns_crashed_worker_and_completes(tmp_path):
+    agent = _agent(tmp_path, "crash_once")
+    events = agent.run(deadline_s=60)
+    kinds = [e["kind"] for e in events]
+    assert kinds.count("epoch_start") == 2
+    assert any(e["kind"] == "worker_lost" and e["worker"] == 1
+               for e in events)
+    assert agent.epoch.members == [0, 1]        # respawn keeps the slot
+    assert kinds[-1] == "pod_done"
+
+
+def test_agent_shrinks_around_a_dead_worker(tmp_path):
+    agent = _agent(tmp_path, "crash_always", policy="shrink",
+                   max_reconfigures=2)
+    events = agent.run(deadline_s=60)
+    assert agent.epoch.members == [0]           # reshaped to the survivor
+    assert agent.reconfigures == 1
+    assert [e["kind"] for e in events][-1] == "pod_done"
+
+
+def test_agent_exit_reconfigure_worker_rejoins_even_under_shrink(tmp_path):
+    agent = _agent(tmp_path, "reconfigure_once", policy="shrink")
+    agent.run(deadline_s=60)
+    # exit 77 is a reshape REQUEST, not a death: the worker keeps its slot
+    assert agent.epoch.members == [0, 1]
+    assert agent.reconfigures == 1
+
+
+def test_agent_detects_hang_via_heartbeat_staleness(tmp_path):
+    agent = _agent(tmp_path, "hang", hb_timeout_s=1.0)
+    events = agent.run(deadline_s=60)
+    assert any(e["kind"] == "worker_hung" and e["worker"] == 1
+               for e in events)
+    assert [e["kind"] for e in events][-1] == "pod_done"
+
+
+def test_agent_gives_up_on_crash_loop(tmp_path):
+    agent = _agent(tmp_path, "crash_always", policy="respawn",
+                   max_reconfigures=2)
+    with pytest.raises(RuntimeError, match="crash loop"):
+        agent.run(deadline_s=60)
+
+
+# ---------------------------------------------------------------------------
+# slow tier: the real multi-process recovery scenarios via chaos_smoke
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_chaos_smoke_kill_respawn_bitwise(tmp_path):
+    """The acceptance scenario end to end: SIGKILL a worker mid-step in a
+    real 2-process gloo/DCN run; recovery must be bitwise-identical to the
+    uninterrupted reference (scripts/chaos_smoke.py asserts it; this runs
+    the real CLI)."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                      "scripts", "chaos_smoke.py"),
+         "--outdir", str(tmp_path), "--scenarios", "kill_respawn"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-2000:]
+    summary = json.load(open(tmp_path / "summary.json"))
+    assert summary["ok"] and summary["scenarios"]["kill_respawn"]

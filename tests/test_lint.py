@@ -888,3 +888,72 @@ def test_unbounded_label_suppression_and_scope():
     """
     assert lint_source("unbounded-metric-label", bare,
                        rel_path="tests/test_fixture.py") == []
+
+
+# ---------------------------------------------------------------------------
+# unguarded-distributed-io (rules_distributed)
+# ---------------------------------------------------------------------------
+
+def test_unguarded_io_flags_bare_distributed_initialize():
+    src = """
+    import jax
+    def connect(coord, n, pid):
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=n, process_id=pid)
+    """
+    found = lint_source("unguarded-distributed-io", src)
+    assert len(found) == 1 and "jax.distributed.initialize" in found[0].message \
+        and "retry layer" in found[0].message
+
+
+def test_unguarded_io_flags_bare_orbax_mgr_calls():
+    src = """
+    class M:
+        def save_it(self, step, args):
+            self._mgr.save(step, args=args)
+        def load_it(self, step, args):
+            return self._mgr.restore(step, args=args)
+    """
+    found = lint_source("unguarded-distributed-io", src)
+    assert len(found) == 2
+    assert all("orbax manager" in f.message for f in found)
+
+
+def test_unguarded_io_clean_when_routed_through_retry():
+    # the two blessed shapes: a closure handed to with_retry (the
+    # checkpoints.py/backend.py idiom) and an @retry-decorated function
+    src = """
+    import jax
+    from dalle_tpu.utils.retry import retry, with_retry
+    class M:
+        def save_it(self, step, args):
+            def _do_save():
+                return self._mgr.save(step, args=args)
+            with_retry("ckpt_save", _do_save)
+    @retry("coordinator_connect", attempts=5)
+    def connect(coord):
+        jax.distributed.initialize(coordinator_address=coord)
+    """
+    assert lint_source("unguarded-distributed-io", src) == []
+
+
+def test_unguarded_io_ignores_unrelated_save_restore():
+    # .save()/.restore() on non-orbax receivers (figures, models) and the
+    # guarded public CheckpointManager wrapper are not this rule's business
+    src = """
+    def f(fig, mgr, step, state):
+        fig.save("out.png")
+        mgr.save(step, state)       # the retried wrapper, not a raw _mgr
+        mgr.restore(state)
+    """
+    assert lint_source("unguarded-distributed-io", src) == []
+
+
+def test_unguarded_io_suppression():
+    src = """
+    import jax
+    def once(coord):
+        # preflight probe: a failure here must fail fast, not back off
+        jax.distributed.initialize(coord)  # graftlint: disable=unguarded-distributed-io
+    """
+    assert lint_source("unguarded-distributed-io", src) == []

@@ -134,25 +134,22 @@ print(f'CHILD_OK {pid} rank={b.get_rank()}')
 
 
 def _run_dcn(tmp_path, nproc, child_code=None, devices_per_proc=2):
+    """Spawn nproc coordinated children over a loopback coordinator. The
+    env machinery lives in parallel/elastic.py (python_worker_env) — the
+    graftmend harness promoted it out of this file so chaos_smoke and the
+    DCN tests build children identically."""
     import os
-    import socket
     import subprocess
     import sys
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
+    from dalle_tpu.parallel.elastic import free_port, python_worker_env
 
+    port = free_port()
     script = tmp_path / "dcn_child.py"
     script.write_text(child_code or _CHILD_CODE)
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    flags = " ".join(f for f in env.get("XLA_FLAGS", "").split()
-                     if "xla_force_host_platform_device_count" not in f)
-    env["XLA_FLAGS"] = (
-        flags + f" --xla_force_host_platform_device_count={devices_per_proc}"
-    ).strip()
-    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = python_worker_env(
+        devices_per_proc=devices_per_proc,
+        repo_root=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
     procs = [subprocess.Popen(
         [sys.executable, str(script), str(i), str(port), str(nproc)],
