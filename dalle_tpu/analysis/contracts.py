@@ -316,6 +316,55 @@ def _build_serve_refill() -> BuiltEntry:
                       donated=_tree_leaves(state), compile=True)
 
 
+@register_entry("serve_refill_shared", "dalle_tpu/serve/engine.py")
+def _build_serve_refill_shared() -> BuiltEntry:
+    # graftloom shared-prefix admission: ONE b=1 text prefill broadcast
+    # into every masked slot of the live cache, per-candidate RNG lanes
+    # seeded independently (DALLE.serve_refill_shared → engine
+    # _refill_shared). The golden pins the amortization claim's static
+    # half: one prefill's worth of matmul/attend primitives — not N — plus
+    # the masked broadcast, with the quantization boundary identical to the
+    # per-row trickle prefill the bits must match.
+    import jax.numpy as jnp
+    eng = _engine()
+    state = eng._init_state()
+    text1 = jnp.zeros((1, eng.text_seq_len), jnp.int32)
+    seeds = jnp.zeros((4,), jnp.int32)
+    n_rows = jnp.full((4,), eng.n_steps, jnp.int32)
+    mask = jnp.ones((4,), bool)
+    return BuiltEntry(fn=eng._refill_shared_fn,
+                      args=(eng.params, state, text1, seeds, n_rows, mask),
+                      donated=_tree_leaves(state), compile=True)
+
+
+@register_entry("clip_rerank", "dalle_tpu/serve/pipeline.py")
+def _build_clip_rerank() -> BuiltEntry:
+    # the /v1/images rerank stage: the jitted batched CLIP scorer the
+    # pipeline dispatches per finished candidate group (CLIP.score_images —
+    # text tower once, N image towers, one matvec). Traced through the
+    # pipeline's own builder so a change to what the product loop actually
+    # runs (e.g. the fused resize) drifts this contract.
+    import jax
+    import jax.numpy as jnp
+    from ..config import ClipConfig
+    from ..models.clip import init_clip
+    from ..serve.pipeline import ImagePipeline
+    cfg = ClipConfig(dim_text=32, dim_image=32, dim_latent=32,
+                     num_text_tokens=64, text_enc_depth=1, text_seq_len=8,
+                     text_heads=2, visual_enc_depth=1, visual_heads=2,
+                     visual_image_size=16, visual_patch_size=8)
+    clip, params = init_clip(cfg, jax.random.PRNGKey(0))
+
+    class _StubVae:     # satisfies the clip-needs-pixels invariant; only
+        def decode(self, ids):  # the scorer program is traced here
+            raise NotImplementedError
+
+    pipe = ImagePipeline(vae=_StubVae(), clip=clip, clip_params=params)
+    text = jnp.zeros((1, 8), jnp.int32)
+    images = jnp.zeros((4, 16, 16, 3), jnp.float32)
+    return BuiltEntry(fn=pipe._scorer, args=(params, text, images))
+
+
 # --------------------------------------------------------------------------
 # attention kernels (trace-only, interpret=True so the pallas kernel body's
 # primitives land in the histogram; vmem snapshot from the PR 1 estimator)

@@ -23,8 +23,9 @@ from .admission import (AdmissionController, Decision, SloEstimator,
                         TenantQuotas, TokenBucket)
 from .aot import (enable_compilation_cache, engine_fingerprint,
                   load_engine_aot, save_engine_aot)
-from .replica import Replica, ReplicaFailure, ResultStream
-from .router import NoReplicaAvailable, ReplicaRouter, RoutedStream
+from .replica import GroupStream, Replica, ReplicaFailure, ResultStream
+from .router import (NoReplicaAvailable, ReplicaRouter, RoutedGroup,
+                     RoutedStream)
 from .server import Gateway
 from .sse import RowPixelDecoder, iter_sse, sse_event
 
@@ -32,6 +33,7 @@ __all__ = [
     "AdmissionController", "Decision", "SloEstimator", "TenantQuotas",
     "TokenBucket", "enable_compilation_cache", "engine_fingerprint",
     "load_engine_aot", "save_engine_aot", "Replica", "ReplicaFailure",
-    "ResultStream", "NoReplicaAvailable", "ReplicaRouter", "RoutedStream",
-    "Gateway", "RowPixelDecoder", "iter_sse", "sse_event",
+    "ResultStream", "GroupStream", "NoReplicaAvailable", "ReplicaRouter",
+    "RoutedStream", "RoutedGroup", "Gateway", "RowPixelDecoder", "iter_sse",
+    "sse_event",
 ]

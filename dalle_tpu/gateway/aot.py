@@ -40,7 +40,7 @@ from typing import Optional
 # so train CLIs don't import the gateway package for it
 from ..utils.misc import enable_compilation_cache  # noqa: F401
 
-PROGRAMS = ("step", "refill", "refill_row")
+PROGRAMS = ("step", "refill", "refill_row", "refill_shared")
 _BUNDLE = "programs.pkl"
 _MANIFEST = "manifest.json"
 
@@ -81,6 +81,13 @@ def engine_fingerprint(engine) -> dict:
         # engine expecting them (and vice versa). Pre-graftpulse bundles
         # lack the key entirely → mismatch → loud jit fallback.
         "decode_health": engine.decode_health,
+        # graftloom: chunked-prefill engines dispatch width-dynamic chunk
+        # programs this module cannot serialize, so only chunk-off bundles
+        # exist and a chunk-on engine refuses them (jit fallback) instead
+        # of claiming a cold-start guarantee its admission path would break.
+        # Pre-graftloom bundles also lack the refill_shared program — this
+        # key makes them mismatch loudly rather than fail at dispatch.
+        "prefill_chunk": engine.prefill_chunk,
         "param_avals": _aval_digest(engine.params),
     }
 
@@ -101,6 +108,8 @@ def _program_args(engine):
         "refill": (params, state, i32(B, T), i32(B),
                    i32(B), jax.ShapeDtypeStruct((B,), jnp.bool_)),
         "refill_row": (params, state, i32(1, T), i32(), i32(), i32()),
+        "refill_shared": (params, state, i32(1, T), i32(B), i32(B),
+                          jax.ShapeDtypeStruct((B,), jnp.bool_)),
     }
 
 
@@ -122,10 +131,19 @@ def save_engine_aot(engine, out_dir: str) -> dict:
         # from a jit engine so the bundle is compiled fresh for this config
         raise ValueError("cannot export from an AOT-loaded engine; build a "
                          "fresh DecodeEngine and export that")
+    if engine.prefill_chunk:
+        # chunk widths are runtime-dynamic (chunk, remainder), so the chunk
+        # program can't be serialized ahead of time — refusing here beats
+        # shipping a bundle whose "zero-compile" claim the first chunked
+        # admission would falsify
+        raise ValueError("cannot export an AOT bundle from a chunked-"
+                         "prefill engine (prefill_chunk > 0); export with "
+                         "chunking off")
     os.makedirs(out_dir, exist_ok=True)
     args = _program_args(engine)
     fns = {"step": engine._step_fn, "refill": engine._refill_fn,
-           "refill_row": engine._refill_row_fn}
+           "refill_row": engine._refill_row_fn,
+           "refill_shared": engine._refill_shared_fn}
     bundle = {}
     for name in PROGRAMS:
         compiled = fns[name].lower(*args[name]).compile()
@@ -186,7 +204,8 @@ def load_engine_aot(engine, aot_dir: str, *, strict: bool = False) -> bool:
         bundle = pickle.load(fh)
     loaded = {name: deserialize_and_load(*bundle[name]) for name in PROGRAMS}
     engine.install_executables(step=loaded["step"], refill=loaded["refill"],
-                               refill_row=loaded["refill_row"])
+                               refill_row=loaded["refill_row"],
+                               refill_shared=loaded["refill_shared"])
     counter_add("gateway.aot_load_total", 1.0)
     return True
 

@@ -75,6 +75,50 @@ class ResultStream:
                 return
 
 
+class _GroupMember:
+    """Per-candidate adapter registered in the replica's stream table: the
+    engine callbacks address candidates by request_id, the consumer reads
+    ONE multiplexed queue of (candidate_index, kind, payload)."""
+
+    def __init__(self, group: "GroupStream", idx: int):
+        self.group = group
+        self.idx = idx
+        self.request: Optional[Request] = None
+
+    def put(self, kind: str, payload=None) -> None:
+        self.group._q.put((self.idx, kind, payload))
+
+
+class GroupStream:
+    """Merged event pipe for all N candidates of one shared-prefix group
+    (a ``/v1/images`` request): yields ``(candidate_index, kind, payload)``
+    until every candidate reached a terminal event — or the replica died,
+    which is GROUP-terminal (the router resubmits the whole group with the
+    same seeds, so exactness survives failover candidate-by-candidate)."""
+
+    def __init__(self, n: int):
+        self.n = int(n)
+        self._q: _queue.Queue = _queue.Queue()
+        self.request_ids: List[int] = []
+
+    def events(self, timeout: Optional[float] = 30.0, still_alive=None):
+        finished = 0
+        while finished < self.n:
+            try:
+                idx, kind, payload = self._q.get(timeout=timeout)
+            except _queue.Empty:
+                if still_alive is not None and still_alive():
+                    continue
+                yield (None, "replica_failed", "event timeout")
+                return
+            yield (idx, kind, payload)
+            if kind == "replica_failed":
+                return                  # group-terminal; siblings' copies
+                                        # of the death event die with us
+            if kind in ResultStream.TERMINAL:
+                finished += 1
+
+
 class Replica:
     """``start()`` → serving; ``submit`` → ResultStream; ``drain()`` →
     graceful stop (finish queued + in-flight work, then the worker exits).
@@ -182,26 +226,75 @@ class Replica:
             raise ReplicaFailure(f"{self.replica_id} is not serving")
         # register the stream BEFORE the request becomes takeable: the
         # engine thread polls every ~20ms, so a post-submit registration
-        # races a fast completion whose events would be dropped. _lock
-        # serializes this replica's submitters, so the reserved id is ours.
+        # races a fast completion whose events would be dropped. _lock is
+        # held across the submit itself — releasing between the id peek and
+        # the enqueue would let a concurrent submitter reserve the same id
+        # (next_request_id only advances at submit) and clobber the table.
         with self._lock:
             rid = self.queue.next_request_id
             stream = ResultStream(None)
             self._streams[rid] = stream
-        try:
-            req = self.queue.submit(text, seed, request_id=rid,
-                                    max_tokens=max_tokens, tenant=tenant,
-                                    priority=priority,
-                                    deadline_at=deadline_at,
-                                    trace_id=trace_id)
-        except BaseException:  # noqa: BLE001 - re-raised; the pre-registered
-            # stream must be unwound for ANY submit failure (incl.
-            # KeyboardInterrupt) or the id leaks a dead stream entry
-            with self._lock:
+            try:
+                req = self.queue.submit(text, seed, request_id=rid,
+                                        max_tokens=max_tokens, tenant=tenant,
+                                        priority=priority,
+                                        deadline_at=deadline_at,
+                                        trace_id=trace_id)
+            except BaseException:  # noqa: BLE001 - re-raised; the
+                # pre-registered stream must be unwound for ANY submit
+                # failure (incl. KeyboardInterrupt) or the id leaks a dead
+                # stream entry
                 self._streams.pop(rid, None)
-            raise
+                raise
         stream.request = req
         return stream
+
+    def submit_group(self, text, seeds, *, max_tokens: Optional[int] = None,
+                     tenant: str = "default", priority: int = 0,
+                     deadline_at: Optional[float] = None,
+                     trace_id: Optional[str] = None,
+                     group_id: Optional[int] = None) -> GroupStream:
+        """Submit all N candidates of one shared-prefix group atomically:
+        consecutive request ids (FIFO keeps them adjacent, so the engine
+        admits them together and pays ONE text prefill), one merged event
+        stream. Capacity is checked up front — a group that would only
+        partially fit raises QueueFull with NOTHING enqueued, because half
+        an admitted group would decode candidates whose results nobody is
+        waiting for."""
+        from ..serve.queue import QueueFull
+        if not self.healthy:
+            raise ReplicaFailure(f"{self.replica_id} is not serving")
+        n = len(seeds)
+        assert n >= 1
+        group = GroupStream(n)
+        with self._lock:
+            if (self.queue.maxsize is not None
+                    and self.queue.maxsize - self.queue.qsize() < n):
+                raise QueueFull(
+                    f"group of {n} exceeds remaining queue capacity")
+            rid0 = self.queue.next_request_id
+            gid = group_id if group_id is not None else rid0
+            members = [_GroupMember(group, i) for i in range(n)]
+            for i, m in enumerate(members):
+                self._streams[rid0 + i] = m
+            try:
+                for i, seed in enumerate(seeds):
+                    members[i].request = self.queue.submit(
+                        text, seed, request_id=rid0 + i,
+                        max_tokens=max_tokens, tenant=tenant,
+                        priority=priority, deadline_at=deadline_at,
+                        trace_id=trace_id, group_id=gid, group_size=n,
+                        group_index=i)
+            except BaseException:  # noqa: BLE001 - re-raised; the capacity
+                # precheck rules out mid-group QueueFull, leaving only a
+                # racing close(). Unwind every registration: already-queued
+                # members then decode unobserved (wasted slots, nothing
+                # dangling) while the caller sees one clean failure
+                for i in range(n):
+                    self._streams.pop(rid0 + i, None)
+                raise
+        group.request_ids = list(range(rid0, rid0 + n))
+        return group
 
     # -- engine callbacks (engine thread) ----------------------------------
     def _stream_for(self, request_id: int,

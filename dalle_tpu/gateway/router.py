@@ -24,7 +24,7 @@ from typing import List, Optional
 from ..obs import counter_add, dump_recorder, gauge_set, record_event
 from ..obs.context import new_trace_id
 from ..serve.queue import QueueFull
-from .replica import Replica, ReplicaFailure, ResultStream
+from .replica import GroupStream, Replica, ReplicaFailure, ResultStream
 
 _gids = itertools.count()
 
@@ -130,6 +130,105 @@ class RoutedStream:
                 return
 
 
+class RoutedGroup:
+    """A multi-candidate (/v1/images) request's merged event stream across
+    failovers. Yields normalized, JSON-ready events:
+
+      ("row",  {"candidate": c, "row": r, "tokens": [...]})
+      ("done", {"candidates": [[tokens]...], "ttft_s": .., "latency_s": ..,
+                "replica": id, "failovers": n})
+      ("error",{"reason": "deadline_shed" | "replica_failed", "detail": ..})
+
+    Failover resubmits the WHOLE group — same text, same per-candidate
+    seeds, same trace_id — so every candidate's regenerated stream is
+    bit-identical; per-candidate row high-water marks suppress repeats, and
+    candidates that already completed before the death keep their first
+    (identical) result."""
+
+    def __init__(self, router: "ReplicaRouter", stream: GroupStream,
+                 replica: Replica, submit_kwargs: dict, gateway_id: int):
+        self.router = router
+        self.gateway_id = gateway_id
+        self._stream = stream
+        self._replica = replica
+        self._kw = submit_kwargs
+        self.failovers = 0
+        self.n = len(submit_kwargs["seeds"])
+
+    @property
+    def replica_id(self) -> str:
+        return self._replica.replica_id
+
+    @property
+    def trace_id(self) -> str:
+        return self._kw["trace_id"]
+
+    def events(self, timeout: Optional[float] = 30.0):
+        next_row = [0] * self.n
+        done: dict = {}
+        while True:
+            for idx, kind, payload in self._stream.events(
+                    timeout=timeout,
+                    still_alive=lambda: self._replica.healthy):
+                if kind == "row":
+                    row, tokens = payload
+                    if row < next_row[idx]:
+                        continue           # already delivered pre-failover
+                    next_row[idx] = row + 1
+                    yield ("row", {"candidate": idx, "row": row,
+                                   "tokens": tokens})
+                elif kind == "done":
+                    # post-failover regeneration of an already-finished
+                    # candidate is bitwise the first result — keep the first
+                    done.setdefault(idx, payload)
+                    if len(done) == self.n:
+                        crs = [done[i] for i in range(self.n)]
+                        yield ("done", {
+                            "candidates": [[int(t) for t in cr.tokens]
+                                           for cr in crs],
+                            "ttft_s": min(cr.ttft_s for cr in crs),
+                            "latency_s": max(cr.latency_s for cr in crs),
+                            "replica": self._replica.replica_id,
+                            "failovers": self.failovers})
+                        return
+                elif kind == "shed":
+                    yield ("error", {"reason": "deadline_shed",
+                                     "detail": "deadline passed while "
+                                               "queued; request shed"})
+                    return
+                else:                      # replica_failed → group failover
+                    counter_add("gateway.failovers_total", 1.0)
+                    self.failovers += 1
+                    record_event("failover", trace_id=self._kw["trace_id"],
+                                 from_replica=self._replica.replica_id,
+                                 failovers=self.failovers, group=True,
+                                 detail=payload)
+                    if self.failovers > len(self.router.replicas):
+                        yield ("error", {"reason": "replica_failed",
+                                         "detail": "failover budget "
+                                                   "exhausted"})
+                        return
+                    try:
+                        # the WHOLE group resubmits with self._kw VERBATIM —
+                        # same text, same seeds, same trace_id — so the
+                        # shared prefill happens once on the new replica and
+                        # every candidate regenerates bit-identically
+                        self._replica, self._stream = \
+                            self.router._dispatch_group(**self._kw)
+                    except (NoReplicaAvailable, QueueFull) as exc:
+                        yield ("error", {"reason": "replica_failed",
+                                         "detail": f"no failover target: "
+                                                   f"{exc}"})
+                        return
+                    dump_recorder("failover", extra={
+                        "trace_id": self._kw["trace_id"],
+                        "group": True,
+                        "resubmitted_to": self._replica.replica_id})
+                    break                  # re-enter on the new stream
+            else:
+                return
+
+
 class ReplicaRouter:
     def __init__(self, replicas: List[Replica]):
         assert replicas
@@ -192,6 +291,44 @@ class ReplicaRouter:
                   trace_id=trace_id)
         replica, stream = self._dispatch(**kw)
         return RoutedStream(self, stream, replica, kw, next(_gids))
+
+    def _dispatch_group(self, **submit_kwargs):
+        """(replica, GroupStream) on the least-loaded healthy replica that
+        can take the WHOLE group — candidates must land on one replica to
+        share their prefix prefill (and a split group would rank against
+        half its candidates)."""
+        candidates = sorted(self.healthy_replicas(), key=lambda r: r.load)
+        if not candidates:
+            raise NoReplicaAvailable("no healthy replicas")
+        last: Optional[BaseException] = None
+        for replica in candidates:
+            try:
+                return replica, replica.submit_group(**submit_kwargs)
+            except RuntimeError as exc:
+                last = exc
+        raise last if isinstance(last, QueueFull) else \
+            NoReplicaAvailable(repr(last))
+
+    def submit_images(self, text, seeds, *,
+                      max_tokens: Optional[int] = None,
+                      tenant: str = "default", priority: int = 0,
+                      deadline_s: Optional[float] = None,
+                      trace_id: Optional[str] = None) -> "RoutedGroup":
+        """Dispatch one multi-candidate request (the /v1/images fan-out):
+        ``seeds`` fixes every candidate's sampling stream, so the group —
+        including its failover resubmission — is deterministic end to
+        end."""
+        if self.draining:
+            raise NoReplicaAvailable("gateway is draining")
+        if trace_id is None:
+            trace_id = new_trace_id()
+        deadline_at = (time.perf_counter() + deadline_s
+                       if deadline_s is not None else None)
+        kw = dict(text=text, seeds=list(seeds), max_tokens=max_tokens,
+                  tenant=tenant, priority=priority, deadline_at=deadline_at,
+                  trace_id=trace_id)
+        replica, stream = self._dispatch_group(**kw)
+        return RoutedGroup(self, stream, replica, kw, next(_gids))
 
     # -- shutdown ----------------------------------------------------------
     def drain(self, timeout: Optional[float] = None) -> None:

@@ -70,7 +70,8 @@ class Gateway:
     def __init__(self, router: ReplicaRouter,
                  admission: Optional[AdmissionController] = None, *,
                  host: str = "127.0.0.1", port: int = 0,
-                 vae=None, image_fmap_size: Optional[int] = None,
+                 vae=None, clip=None, pipeline=None,
+                 image_fmap_size: Optional[int] = None,
                  image_seq_len: Optional[int] = None,
                  slo_sentry: Optional[BurnRateSentry] = None):
         self.router = router
@@ -87,6 +88,26 @@ class Gateway:
                               else eng.n_steps)
         if self.image_fmap_size is None:
             self.image_fmap_size = eng.row_len
+        # /v1/images product loop (graftloom): candidates of one request
+        # fan into engine slots, so the slot count caps n_candidates — a
+        # larger fan-out could never share a prefill window and would
+        # deadlock a single-replica fleet's admission
+        self.max_candidates = eng.slots
+        # a pipeline passed in stays the caller's to close (the smoke shares
+        # one across gateway phases so its jitted programs stay warm)
+        self._owns_pipeline = pipeline is None
+        if pipeline is None:
+            # post-decode stage graph (serve/pipeline.py): built even
+            # without a vae/clip so /v1/images always serves — token-only
+            # with zero scores at minimum (rerank needs pixels, so clip is
+            # only honored alongside a vae)
+            from ..serve.pipeline import ImagePipeline
+            clip_model, clip_params = clip if clip else (None, None)
+            if vae is None:
+                clip_model = clip_params = None
+            pipeline = ImagePipeline(vae=vae, clip=clip_model,
+                                     clip_params=clip_params)
+        self.pipeline = pipeline
         self._inflight = 0
         self._lock = threading.Lock()
         handler = _make_handler(self)
@@ -119,6 +140,8 @@ class Gateway:
         self.httpd.server_close()
         if self._serve_thread is not None:
             self._serve_thread.join(timeout=5)
+        if self._owns_pipeline:
+            self.pipeline.close(timeout=5)
 
     # -- accounting --------------------------------------------------------
     def _enter(self):
@@ -185,7 +208,7 @@ def _make_handler(gw: Gateway):
                 self._json(404, {"error": "not_found", "path": self.path})
 
         def do_POST(self):
-            if self.path != "/v1/generate":
+            if self.path not in ("/v1/generate", "/v1/images"):
                 self._json(404, {"error": "not_found", "path": self.path})
                 return
             counter_add("gateway.requests_total", 1.0)
@@ -195,7 +218,10 @@ def _make_handler(gw: Gateway):
             # the same id the engine threads tag via Request.trace_id
             tid = self._trace_id = new_trace_id()
             with trace_context(tid), span("gateway/request"):
-                self._generate(tid)
+                if self.path == "/v1/images":
+                    self._images(tid)
+                else:
+                    self._generate(tid)
 
         def _generate(self, tid: str):
             try:
@@ -236,39 +262,18 @@ def _make_handler(gw: Gateway):
                 queued_tokens=gw.router.total_backlog * gw.image_seq_len,
                 deadline_s=deadline_s)
             if not decision.admit:
-                gw.slo_sentry.record(False, decision.reason)
-                record_event("request_rejected", trace_id=tid,
-                             tenant=tenant, reason=decision.reason)
-                headers = []
-                if decision.retry_after_s is not None:
-                    headers.append(("Retry-After",
-                                    f"{decision.retry_after_s:.3f}"))
-                self._json(429, {"error": decision.reason,
-                                 "tenant": tenant,
-                                 "predicted_completion_s":
-                                     decision.predicted_completion_s},
-                           headers)
+                self._reject(tenant, tid, decision)
                 return
 
             gw._enter()
             try:
-                try:
-                    routed = gw.router.submit(
+                routed = self._submit_or_reject(
+                    tenant,
+                    lambda: gw.router.submit(
                         text, seed, max_tokens=max_tokens, tenant=tenant,
                         priority=int(body.get("priority", 0)),
-                        deadline_s=deadline_s, trace_id=tid)
-                except QueueFull as exc:
-                    gw.admission.reject(tenant, "queue_full")
-                    gw.slo_sentry.record(False, "queue_full")
-                    self._json(429, {"error": "queue_full",
-                                     "detail": str(exc)},
-                               [("Retry-After", "0.5")])
-                    return
-                except NoReplicaAvailable as exc:
-                    reason = ("draining" if gw.router.draining
-                              else "no_replica")
-                    gw.slo_sentry.record(False, reason)
-                    self._json(503, {"error": reason, "detail": str(exc)})
+                        deadline_s=deadline_s, trace_id=tid))
+                if routed is None:
                     return
                 record_event("request_submitted", trace_id=tid,
                              tenant=tenant,
@@ -280,6 +285,43 @@ def _make_handler(gw: Gateway):
                     self._blocking(routed, deadline_s)
             finally:
                 gw._exit()
+
+        def _reject(self, tenant: str, tid, decision) -> None:
+            """Render an admission rejection (shared by /v1/generate and
+            /v1/images): one SLO bad event + labeled reject bookkeeping +
+            429 with Retry-After when the estimator can predict one."""
+            gw.slo_sentry.record(False, decision.reason)
+            record_event("request_rejected", trace_id=tid, tenant=tenant,
+                         reason=decision.reason)
+            headers = []
+            if decision.retry_after_s is not None:
+                headers.append(("Retry-After",
+                                f"{decision.retry_after_s:.3f}"))
+            self._json(429, {"error": decision.reason,
+                             "tenant": tenant,
+                             "predicted_completion_s":
+                                 decision.predicted_completion_s},
+                       headers)
+
+        def _submit_or_reject(self, tenant: str, submit):
+            """Run a router submission, mapping its failures to the shared
+            HTTP verdicts: full replica queues → quota-booked 429, an empty
+            /draining fleet → 503. Returns the routed stream, or None with
+            the response already sent."""
+            try:
+                return submit()
+            except QueueFull as exc:
+                gw.admission.reject(tenant, "queue_full")
+                gw.slo_sentry.record(False, "queue_full")
+                self._json(429, {"error": "queue_full",
+                                 "detail": str(exc)},
+                           [("Retry-After", "0.5")])
+            except NoReplicaAvailable as exc:
+                reason = ("draining" if gw.router.draining
+                          else "no_replica")
+                gw.slo_sentry.record(False, reason)
+                self._json(503, {"error": reason, "detail": str(exc)})
+            return None
 
         def _record_outcome(self, kind: str, payload: dict,
                             deadline_s) -> None:
@@ -343,5 +385,189 @@ def _make_handler(gw: Gateway):
             finally:
                 if decoder is not None:
                     decoder.finish(rid)
+
+        # -- /v1/images: the shared-prefix product loop (graftloom) --------
+        def _images(self, tid: str):
+            """text → N candidate token sequences (ONE shared prompt
+            prefill engine-side) → dVAE pixels → CLIP rerank → top-k.
+            Validation happens HERE, before admission: a bad n_candidates/
+            top_k must come back 400 — never an engine-thread kill that
+            fleet failover would replay."""
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                text = np.asarray(body["text"], np.int32)
+                if text.ndim != 1:
+                    raise ValueError(f"text must be a flat list of token "
+                                     f"ids, got shape {text.shape}")
+                seed = int(body["seed"])
+                n_cand = int(body.get("n_candidates", 1))
+                if not (1 <= n_cand <= gw.max_candidates):
+                    raise ValueError(
+                        f"n_candidates must be in [1, {gw.max_candidates}] "
+                        f"(the per-replica slot budget), got {n_cand}")
+                top_k = int(body.get("top_k", n_cand))
+                if not (1 <= top_k <= n_cand):
+                    raise ValueError(f"top_k must be in [1, n_candidates="
+                                     f"{n_cand}], got {top_k}")
+                # candidate i samples under seed+i — the whole fan must fit
+                # int32 so no candidate's PRNGKey silently wraps
+                if not (-2**31 <= seed and seed + n_cand - 1 < 2**31):
+                    raise ValueError(f"seeds [{seed}, {seed + n_cand - 1}] "
+                                     "must fit int32")
+                max_tokens = body.get("max_tokens")
+                if max_tokens is not None:
+                    max_tokens = int(max_tokens)
+                    if max_tokens < 1:
+                        raise ValueError(
+                            f"max_tokens must be >= 1, got {max_tokens}")
+                deadline_s = body.get("deadline_s")
+                if deadline_s is not None:
+                    deadline_s = float(deadline_s)
+            except (KeyError, TypeError, ValueError, OverflowError) as exc:
+                self._json(400, {"error": "bad_request",
+                                 "detail": repr(exc)})
+                return
+            tenant = str(body.get("tenant", "default"))
+            seeds = [seed + i for i in range(n_cand)]
+            per_cand = (int(max_tokens) if max_tokens
+                        else gw.image_seq_len)
+
+            counter_add("gateway.images_requests_total", 1.0)
+            counter_add("gateway.images_candidates_total", float(n_cand))
+            # quota/SLO charge is n_candidates-aware: a 8-candidate request
+            # consumes 8 requests' worth of slot time
+            decision = gw.admission.decide(
+                tenant, request_tokens=n_cand * per_cand,
+                queued_tokens=gw.router.total_backlog * gw.image_seq_len,
+                deadline_s=deadline_s)
+            if not decision.admit:
+                self._reject(tenant, tid, decision)
+                return
+
+            gw._enter()
+            try:
+                routed = self._submit_or_reject(
+                    tenant,
+                    lambda: gw.router.submit_images(
+                        text, seeds, max_tokens=max_tokens, tenant=tenant,
+                        priority=int(body.get("priority", 0)),
+                        deadline_s=deadline_s, trace_id=tid))
+                if routed is None:
+                    return
+                record_event("images_submitted", trace_id=tid,
+                             tenant=tenant, candidates=n_cand,
+                             replica=routed.replica_id)
+                if body.get("stream", False):
+                    self._images_stream(routed, text, seeds, top_k,
+                                        bool(body.get("pixels", False)),
+                                        deadline_s)
+                else:
+                    self._images_blocking(routed, text, seeds, top_k,
+                                          deadline_s)
+            finally:
+                gw._exit()
+
+        def _ranked_payload(self, routed, text, seeds, top_k, done):
+            """Run the finished group through the post-decode pipeline and
+            shape the response: top-k entries (pixels when a vae is
+            attached), every candidate's token grid, scores, timings."""
+            from ..serve.pipeline import CandidateGroup
+            group = CandidateGroup(
+                group_id=routed.gateway_id, text=text,
+                tokens=np.asarray(done["candidates"], np.int32),
+                seeds=seeds, top_k=top_k, trace_id=routed.trace_id)
+            try:
+                ranked = gw.pipeline.submit(group).result(timeout=120.0)
+            except (TimeoutError, RuntimeError) as exc:
+                # backlogged/closed pipeline or a wedged stage: the client
+                # must still get a status line and the SLO books an outcome
+                # (both callers map this to 500 / an SSE error event)
+                return None, {"reason": "pipeline_failed",
+                              "detail": repr(exc)}
+            if ranked.error is not None:
+                return None, {"reason": "pipeline_failed",
+                              "detail": ranked.error}
+            return {"request_id": routed.gateway_id,
+                    "trace_id": routed.trace_id,
+                    "n_candidates": len(seeds), "seeds": seeds,
+                    "reranked": ranked.reranked,
+                    "scores": ranked.scores, "order": ranked.order,
+                    "top_k": ranked.top_k,
+                    "candidates": done["candidates"],
+                    "ttft_s": done["ttft_s"],
+                    "latency_s": done["latency_s"],
+                    "replica": done["replica"],
+                    "failovers": done["failovers"]}, None
+
+        def _images_blocking(self, routed, text, seeds, top_k, deadline_s):
+            for kind, payload in routed.events():
+                if kind == "done":
+                    ranked, err = self._ranked_payload(routed, text, seeds,
+                                                       top_k, payload)
+                    if err is not None:
+                        self._record_outcome("error", err, deadline_s)
+                        self._json(500, err)
+                        return
+                    self._record_outcome(kind, payload, deadline_s)
+                    self._json(200, ranked)
+                    return
+                if kind == "error":
+                    self._record_outcome(kind, payload, deadline_s)
+                    code = 504 if payload["reason"] == "deadline_shed" \
+                        else 503
+                    self._json(code, payload)
+                    return
+            self._json(500, {"error": "stream_ended_without_result"})
+
+        def _images_stream(self, routed, text, seeds, top_k, pixels: bool,
+                           deadline_s):
+            """SSE: per-candidate ``row`` events (with preview pixel bands
+            over the PR7 plumbing when requested), then one final ``ranked``
+            event carrying the pipeline's product."""
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            if self._trace_id is not None:
+                self.send_header("X-Request-Id", self._trace_id)
+            self.end_headers()
+            decoder = None
+            if pixels and gw.vae is not None:
+                decoder = RowPixelDecoder(gw.vae, gw.image_fmap_size)
+            rid = routed.gateway_id
+            try:
+                for kind, payload in routed.events():
+                    data = {"request_id": rid,
+                            "trace_id": routed.trace_id, **payload}
+                    if kind == "row" and decoder is not None:
+                        # per-candidate preview band, decoded on the
+                        # connection thread; keyed (request, candidate) so
+                        # candidates' committed prefixes stay separate
+                        data.update(decoder.row_event(
+                            (rid, payload["candidate"]), payload["row"],
+                            payload["tokens"]))
+                    if kind == "done":
+                        ranked, err = self._ranked_payload(
+                            routed, text, seeds, top_k, payload)
+                        if err is not None:
+                            kind, data = "error", {
+                                "request_id": rid,
+                                "trace_id": routed.trace_id, **err}
+                            self._record_outcome("error", err, deadline_s)
+                        else:
+                            kind, data = "ranked", ranked
+                            self._record_outcome("done", payload,
+                                                 deadline_s)
+                    elif kind == "error":
+                        self._record_outcome(kind, payload, deadline_s)
+                    with span("gateway/sse_flush", event=kind):
+                        self.wfile.write(sse_event(kind, data))
+                        self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                counter_add("gateway.client_disconnects_total", 1.0)
+            finally:
+                if decoder is not None:
+                    for i in range(len(seeds)):
+                        decoder.finish((rid, i))
 
     return Handler

@@ -82,6 +82,20 @@ class CLIP(nn.Module):
         lat = self.to_visual_latent(x)
         return lat / jnp.linalg.norm(lat, axis=-1, keepdims=True)
 
+    def score_images(self, text, images):
+        """Serving rerank: ONE prompt against N candidate images — ``text``
+        (1, text_seq_len) ids, ``images`` (n, H, W, C) → (n,) similarity
+        scores. The text tower runs once per group instead of once per
+        candidate (``__call__`` with a repeated text row pays it n times);
+        per-candidate scores are the same per-pair similarities the
+        reference's generate_images rerank computes (:553-555). This is the
+        program the ``clip_rerank`` graftir entry pins and the
+        serve-pipeline rerank stage (serve/pipeline.py) dispatches per
+        finished candidate group."""
+        t = self.embed_text(text)[0]                 # (d,)
+        v = self.embed_image(images)                 # (n, d)
+        return jnp.einsum("nd,d->n", v, t) * jnp.exp(self.temperature)
+
     def __call__(self, text, image, return_loss: bool = False):
         """return_loss=False → per-pair similarity scores (the rerank path,
         reference :553-555); True → symmetric InfoNCE loss (:329-332)."""
@@ -103,4 +117,35 @@ def init_clip(cfg: ClipConfig, key: jax.Array, batch: int = 1):
     img = jnp.zeros((batch, cfg.visual_image_size, cfg.visual_image_size,
                      cfg.channels), jnp.float32)
     params = model.init(key, text, img, return_loss=True)
+    return model, params
+
+
+def load_clip(ckpt_dir: str, step: Optional[int] = None):
+    """Restore a ``scripts/train_clip.py`` checkpoint as (CLIP, params)
+    WITHOUT training imports: the serve path (attaching a reranker to
+    ``DalleWithVae`` / the gateway pipeline) must not drag in
+    TrainState/optimizer construction just to read frozen weights. The
+    checkpointed tree is a TrainState pytree; orbax restores it
+    template-free (raw arrays) and only the ``params`` subtree is
+    materialized on device — opt_state bytes never leave host."""
+    import os
+
+    import orbax.checkpoint as ocp
+
+    mgr = ocp.CheckpointManager(os.path.abspath(ckpt_dir))
+    try:
+        step = step if step is not None else mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint steps under {ckpt_dir}")
+        restored = mgr.restore(step, args=ocp.args.Composite(
+            state=ocp.args.PyTreeRestore(),
+            metadata=ocp.args.JsonRestore()))
+    finally:
+        mgr.close()
+    meta = restored.get("metadata") or {}
+    if meta.get("model_class") != "CLIP":
+        raise ValueError(f"{ckpt_dir} is not a CLIP checkpoint "
+                         f"(model_class={meta.get('model_class')!r})")
+    model = CLIP(ClipConfig.from_dict(meta["hparams"]))
+    params = jax.tree_util.tree_map(jnp.asarray, restored["state"]["params"])
     return model, params

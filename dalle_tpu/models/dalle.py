@@ -521,6 +521,59 @@ class DALLE(nn.Module):
                                                   use_kernel=use_kernel)
         return self.serve_img_logits(y[:, -1]), cache
 
+    def serve_refill_shared(self, text1, cache, refill_mask,
+                            cache_dtype=jnp.float32):
+        """Shared-prefix admission (graftloom): ONE b=1 text prefill —
+        bitwise the sequential ``_prefill``, exactly ``serve_prefill_row`` —
+        broadcast into every ``refill_mask`` row of the live multi-slot
+        cache. N candidates of one prompt (a ``/v1/images`` fan-out) pay ONE
+        prompt prefill instead of N: the prefix KV depends only on the text,
+        never the seed, so copying the same bits into each sibling row is
+        exact by construction — each candidate then decodes under its own
+        RNG lane and stays bitwise identical to an independent
+        single-candidate request (the PR4 bar, (N−1) prefills cheaper).
+        Returns (logits (1, V) for the shared first image token, cache)."""
+        logits1, cache1 = self.serve_prefill_row(text1,
+                                                 cache_dtype=cache_dtype)
+        cache = dict(cache)
+        m2 = refill_mask[:, None, None]
+        for name, small in cache1.items():
+            big = cache[name]
+            # (1, S, 2hd) broadcasts over the slot axis; unmasked rows keep
+            # their occupant's cache bit-identically
+            kv = jnp.where(m2, small.kv, big.kv)
+            if big.scale is not None:
+                sc = jnp.where(m2, small.scale, big.scale)
+                cache[name] = big.replace(kv=kv, scale=sc)
+            else:
+                cache[name] = big.replace(kv=kv)
+        return logits1, cache
+
+    def serve_refill_window(self, ids, cache, refill_mask, start,
+                            use_kernel=None):
+        """Chunked-prefill admission: one bounded window of an already
+        remapped+bos'd prompt (``ids`` (b, w), full-vocab token ids — the
+        engine host-applies ``remap_and_bos`` and slices) written at
+        absolute positions [start, start+w) of each ``refill_mask`` row.
+        Dispatching the prompt as ceil(prefix/w) of these windows
+        interleaved with decode iterations bounds how long one fat
+        admission can stall its neighbors' tokens (p95 TTFT isolation);
+        causality makes the chunked prefix bitwise identical to the one-shot
+        ``serve_refill`` window — each chunk token attends exactly the cache
+        prefix the full window would have shown it, at the same reduce
+        widths. Returns (logits (b, V) from the window's LAST position —
+        meaningful only on the final chunk — and the cache)."""
+        S = cache["kv_0"].kv.shape[1]   # max_seq == the park offset
+        n = ids.shape[1]
+        tok = self._embed_text_ids(ids)
+        if not self.cfg.rotary_emb:
+            tok = tok + self.text_pos_emb(start + jnp.arange(n))
+        tokens = self._stabilize(tok)
+        offsets = jnp.where(refill_mask, start, S)
+        y, cache = self.transformer.decode_window(tokens, cache, offsets,
+                                                  use_kernel=use_kernel)
+        return self.serve_img_logits(y[:, -1]), cache
+
     def serve_prefill_row(self, text, cache_dtype=jnp.float32):
         """Single-request prefill for the engine's per-row admission path:
         (1, text_seq_len) text → (logits (1, V), fresh b=1 cache sized

@@ -81,6 +81,29 @@ class DalleWithVae:
     model: DALLE
     params: Any
     vae: VAEAdapter
+    # optional CLIP reranker: (CLIP module, params). Attached once (ctor or
+    # ``attach_rerank``), consumed by ``generate_images(clip=...)`` callers
+    # and by the serving product loop (``image_pipeline`` — the /v1/images
+    # rerank stage). Kept as data, not a submodule: the reranker is frozen
+    # at serve time exactly like the vae.
+    clip: Any = None
+
+    def attach_rerank(self, clip_model, clip_params) -> "DalleWithVae":
+        """Attach a CLIP reranker after construction (e.g. loaded from a
+        checkpoint via ``models.clip.load_clip`` — no training imports
+        needed). Returns self for chaining."""
+        object.__setattr__(self, "clip", (clip_model, clip_params))
+        return self
+
+    def image_pipeline(self, *, top_k: Optional[int] = None, **kw):
+        """The post-decode product pipeline (serve/pipeline.py): batched
+        dVAE pixel decode + batched CLIP rerank + top-k ordering over
+        finished candidate groups. Built from this wrapper's vae and
+        attached reranker; the gateway's /v1/images endpoint drives it."""
+        from ..serve.pipeline import ImagePipeline
+        clip_model, clip_params = self.clip if self.clip else (None, None)
+        return ImagePipeline(vae=self.vae, clip=clip_model,
+                             clip_params=clip_params, top_k=top_k, **kw)
 
     def loss(self, text, images, key=None, null_cond_prob: float = 0.0,
              deterministic: bool = True):
@@ -133,7 +156,8 @@ class DalleWithVae:
     def serve_engine(self, *, slots: int, precision: str = "int8w",
                      filter_thres: float = 0.5, temperature: float = 1.0,
                      topk_approx: bool = False, steps_per_sync: int = 1,
-                     use_kernel=None, decode_health: bool = False):
+                     use_kernel=None, decode_health: bool = False,
+                     prefill_chunk: int = 0):
         """Continuous-batching decode engine over this wrapper's model —
         the serving-side sibling of ``generate_images``. ``slots`` is the
         fixed device batch; precision modes are the same fast paths
@@ -165,7 +189,8 @@ class DalleWithVae:
                             topk_approx=topk_approx,
                             steps_per_sync=steps_per_sync,
                             use_kernel=use_kernel,
-                            decode_health=decode_health)
+                            decode_health=decode_health,
+                            prefill_chunk=prefill_chunk)
 
     def generate_images(self, text, key, *, filter_thres: float = 0.5,
                         temperature: float = 1.0, cond_scale: float = 1.0,

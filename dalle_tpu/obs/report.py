@@ -321,6 +321,40 @@ def gateway_accounting(metrics: List[dict],
     }
 
 
+def images_accounting(metrics: List[dict],
+                      spans: List[dict]) -> Optional[dict]:
+    """graftloom /v1/images product-loop health from the
+    ``gateway.images_*`` counters plus the pipeline stage spans. ``None``
+    when no record carries an images counter — token-only serving keeps its
+    report unchanged. The verdict names whether the rerank stage actually
+    ran: candidates decoded but never scored usually means the operator
+    forgot ``--clip_path``."""
+    img_rows = [r for r in metrics
+                if any(k.startswith("gateway.images_") for k in r)]
+    if not img_rows:
+        return None
+    last = img_rows[-1]
+    shared = [s for s in spans
+              if s.get("name") == "pipeline/prefill_shared"]
+    saved = sum(max(int((s.get("args") or {}).get("candidates", 1)) - 1, 0)
+                for s in shared)
+    dec = sorted(float(s["dur_s"]) for s in spans
+                 if s.get("name") == "pipeline/decode_pixels")
+    rer = sorted(float(s["dur_s"]) for s in spans
+                 if s.get("name") == "pipeline/rerank")
+    reranked = float(last.get("gateway.images_reranked_total", 0))
+    return {
+        "requests": float(last.get("gateway.images_requests_total", 0)),
+        "candidates": float(last.get("gateway.images_candidates_total", 0)),
+        "reranked": reranked,
+        "shared_prefills": len(shared),
+        "prefills_saved": saved,
+        "decode_p50_s": percentile(dec, 0.5) if dec else None,
+        "rerank_p50_s": percentile(rer, 0.5) if rer else None,
+        "verdict": ("RERANKING" if reranked > 0 else "tokens-only"),
+    }
+
+
 def format_report(rows: List[dict], *, topk: int = 10) -> str:
     spans, metrics = split_rows(rows)
     lines: List[str] = []
@@ -414,6 +448,23 @@ def format_report(rows: List[dict], *, topk: int = 10) -> str:
                 + f"; queue wait p50={fmt_num(gw['qwait_p50_s'], suffix='s')}"
                   f" p95={fmt_num(gw['qwait_p95_s'], suffix='s')}"
                 + f" → {gw['verdict']}")
+        im = images_accounting(metrics, spans)
+        if im is not None:
+            parts = [f"{im['requests']:.0f} requests, "
+                     f"{im['candidates']:.0f} candidates"]
+            if im["shared_prefills"]:
+                parts.append(f"shared prefills {im['shared_prefills']} "
+                             f"(saved {im['prefills_saved']})")
+            if im["decode_p50_s"] is not None:
+                parts.append("decode p50="
+                             + fmt_num(im["decode_p50_s"], suffix="s"))
+            if im["rerank_p50_s"] is not None:
+                parts.append("rerank p50="
+                             + fmt_num(im["rerank_p50_s"], suffix="s"))
+            verdict = ("IMAGES: RERANKING" if im["verdict"] == "RERANKING"
+                       else "IMAGES: tokens-only (no reranker scored)")
+            lines.append("== images product loop (graftloom): "
+                         + ", ".join(parts) + f" → {verdict}")
         slo = slo_accounting(metrics)
         if slo is not None:
             wtxt = " ".join(f"{w['window']}={w['burn']:.3g}x"

@@ -10,10 +10,14 @@ scripts/serve_smoke.py.
 """
 
 from .engine import DecodeEngine, EngineStats
+from .pipeline import (CandidateGroup, ImagePipeline, PendingResult,
+                       RankedGroup, prepare_clip_text)
 from .queue import CompletedRequest, QueueFull, Request, RequestQueue
 from .scheduler import (FifoPolicy, PolicyQueue, PriorityDeadlinePolicy,
                         SchedulingPolicy, SlotScheduler)
 
 __all__ = ["DecodeEngine", "EngineStats", "CompletedRequest", "QueueFull",
            "Request", "RequestQueue", "SlotScheduler", "SchedulingPolicy",
-           "FifoPolicy", "PriorityDeadlinePolicy", "PolicyQueue"]
+           "FifoPolicy", "PriorityDeadlinePolicy", "PolicyQueue",
+           "CandidateGroup", "ImagePipeline", "PendingResult", "RankedGroup",
+           "prepare_clip_text"]

@@ -52,6 +52,15 @@ from .scheduler import SlotScheduler
 class EngineStats:
     steps: int = 0
     refills: int = 0
+    # shared-prefix admissions (graftloom): cohorts of one group admitted
+    # together pay ONE text prefill; ``shared_prefills_saved`` counts the
+    # (N−1) per cohort the independent path would have paid — the
+    # amortization ledger serve_bench reports against
+    shared_refills: int = 0
+    shared_prefills_saved: int = 0
+    # chunked-prefill dispatches (prefill_chunk > 0): windows split into
+    # bounded chunks interleaved with decode iterations
+    prefill_chunks: int = 0
     # running mean of occupancy at iterations where the queue still held
     # work — the ≥90% serving bar only means something while there IS work.
     # Sum/count (not a sample list) so a long-lived serve loop stays O(1).
@@ -114,10 +123,30 @@ def _shared_programs(eng: "DecodeEngine") -> tuple:
                        donate_argnums=(1,)),
                jax.jit(DecodeEngine._refill_row.__get__(standin),
                        donate_argnums=(1,)),
+               jax.jit(DecodeEngine._refill_shared.__get__(standin),
+                       donate_argnums=(1,)),
+               jax.jit(DecodeEngine._refill_chunk.__get__(standin),
+                       donate_argnums=(1,)),
                jax.jit(DecodeEngine._multi_step.__get__(standin),
                        donate_argnums=(1,)))
         per_model[key] = fns
     return fns
+
+
+@dataclasses.dataclass
+class _ChunkJob:
+    """One in-flight chunked-prefill admission (prefill_chunk > 0): the
+    remapped prompt ids of the rows admitted together, dispatched one
+    bounded window per engine iteration so neighbors' decode steps
+    interleave — a fat admission can no longer stall every other row for
+    its full prompt length."""
+    ids: np.ndarray        # (B, prefix_len) remapped+bos'd full-vocab ids
+    seeds: np.ndarray      # (B,)
+    n_rows: np.ndarray     # (B,)
+    mask: np.ndarray       # (B,) bool
+    pairs: list            # [(slot, Request)]
+    t0: float              # admission wall-clock (serve/prefill span start)
+    start: int = 0         # next chunk's first position
 
 
 class DecodeEngine:
@@ -144,7 +173,7 @@ class DecodeEngine:
                  cache_dtype=jnp.float32, filter_thres: float = 0.5,
                  temperature: float = 1.0, topk_approx: bool = False,
                  steps_per_sync: int = 1, use_kernel=None,
-                 decode_health: bool = False):
+                 decode_health: bool = False, prefill_chunk: int = 0):
         c = model.cfg
         attn_types = tuple(c.attn_types) or ("full",)
         if any(t != "full" for t in attn_types) or c.shift_tokens:
@@ -191,28 +220,46 @@ class DecodeEngine:
         # the image token grid = one fmap row
         self.row_len = c.image_fmap_size
 
-        self._refill_fn, self._refill_row_fn, self._step_fn = \
-            _shared_programs(self)
+        # chunked prefill (graftloom): window AND trickle admissions of
+        # prompts longer than ``prefill_chunk`` positions dispatch as
+        # bounded chunks with decode iterations interleaved — TTFT isolation
+        # for the neighbors (a trickle admission becomes a one-row-masked
+        # window job). Shared-prefix COHORT prefills stay one-shot: their
+        # b=1 prefill is already 1/B of a window's compute, the bound
+        # chunking enforces. 0 (the default) keeps the one-shot programs:
+        # host loop and compiled programs are byte-identical to the
+        # pre-chunking engine. Chunked tokens are bitwise ≡ unchunked
+        # (tests/test_serve.py): each chunk token attends exactly the cache
+        # prefix the full window would have shown it, at the same reduce
+        # widths.
+        assert prefill_chunk >= 0
+        self.prefill_chunk = int(prefill_chunk)
+
+        (self._refill_fn, self._refill_row_fn, self._refill_shared_fn,
+         self._refill_chunk_fn, self._step_fn) = _shared_programs(self)
         self.aot_loaded = False
         self.stats = EngineStats()
 
     def install_executables(self, *, step=None, refill=None,
-                            refill_row=None) -> None:
+                            refill_row=None, refill_shared=None) -> None:
         """Swap the engine's jitted programs for AOT-compiled executables
         (gateway/aot.py): a cold replica then serves without retracing or
         recompiling any device program. Executables must have been lowered
         from THIS engine configuration — the aot module's fingerprint check
         enforces that; calling one with mismatched shapes/dtypes fails loudly
         at dispatch, never silently."""
-        if step is None or refill is None or refill_row is None:
+        if (step is None or refill is None or refill_row is None
+                or refill_shared is None):
             # a partial install would leave some programs on jit while
             # health/smoke report aot_loaded=true — the flag must mean
             # "the WHOLE cold-start path is executable-backed"
-            raise ValueError("install_executables requires all three "
-                             "programs (step, refill, refill_row)")
+            raise ValueError("install_executables requires all four "
+                             "programs (step, refill, refill_row, "
+                             "refill_shared)")
         self._step_fn = step
         self._refill_fn = refill
         self._refill_row_fn = refill_row
+        self._refill_shared_fn = refill_shared
         self.aot_loaded = True
 
     # -- device programs ---------------------------------------------------
@@ -292,6 +339,61 @@ class DecodeEngine:
             "t_idx": state["t_idx"].at[row].set(0),
             "n_row": state["n_row"].at[row].set(n_tok),
             "active": state["active"].at[row].set(True),
+        }
+
+    # graftir: allow=precision -- the shared-prefix refill is an
+    # admission-only program: it WRITES the broadcast b=1 prefill into the
+    # multi-slot int8 cache but never attends over it, so the incoming
+    # rows' KV scales legitimately pass through as moved data without a
+    # dequantizing multiply (graftnum orphaned-scale); the scales are
+    # consumed by the very next serve_decode step, whose entry pins the
+    # dequant sites.
+    def _refill_shared(self, params, state, text1, seeds, n_rows, mask):
+        """Shared-prefix admission (graftloom): N candidates of ONE prompt
+        (masked rows) pay a single b=1 text prefill, broadcast into every
+        sibling row (``DALLE.serve_refill_shared``), with per-candidate RNG
+        lanes seeded independently — each candidate's tokens stay BITWISE
+        identical to an independent single-candidate request, (N−1) prompt
+        prefills cheaper."""
+        new_keys = jax.vmap(jax.random.PRNGKey)(seeds)       # (B, 2) u32
+        logits1, cache = self.model.apply(
+            params, text1, state["cache"], mask, self.cache_dtype,
+            method=DALLE.serve_refill_shared)
+        m1 = mask[:, None]
+        return {
+            "cache": cache,
+            "logits": jnp.where(m1, logits1.astype(state["logits"].dtype),
+                                state["logits"]),
+            "cur_key": jnp.where(m1, new_keys, state["cur_key"]),
+            "orig_key": jnp.where(m1, new_keys, state["orig_key"]),
+            "t_idx": jnp.where(mask, 0, state["t_idx"]),
+            "n_row": jnp.where(mask, n_rows, state["n_row"]),
+            "active": state["active"] | mask,
+        }
+
+    def _refill_chunk(self, params, state, ids_chunk, start, seeds, n_rows,
+                      mask, last):
+        """One bounded window of a chunked prefill: ``ids_chunk`` (B, w)
+        already remapped+bos'd prompt ids written at positions
+        [start, start+w) of the masked rows. Rows only turn active — and
+        only then consume keys/logits — on the FINAL chunk (``last``, a
+        traced scalar so one program serves every chunk of a given
+        width)."""
+        logits_r, cache = self.model.apply(
+            params, ids_chunk, state["cache"], mask, start, self.use_kernel,
+            method=DALLE.serve_refill_window)
+        new_keys = jax.vmap(jax.random.PRNGKey)(seeds)
+        lm = mask & last
+        m1 = lm[:, None]
+        return {
+            "cache": cache,
+            "logits": jnp.where(m1, logits_r.astype(state["logits"].dtype),
+                                state["logits"]),
+            "cur_key": jnp.where(m1, new_keys, state["cur_key"]),
+            "orig_key": jnp.where(m1, new_keys, state["orig_key"]),
+            "t_idx": jnp.where(lm, 0, state["t_idx"]),
+            "n_row": jnp.where(lm, n_rows, state["n_row"]),
+            "active": state["active"] | lm,
         }
 
     def _step(self, params, state):
@@ -375,6 +477,44 @@ class DecodeEngine:
             return self.n_steps
         return int(np.clip(req.max_tokens, 1, self.n_steps))
 
+    def _remap_bos_host(self, texts: np.ndarray) -> np.ndarray:
+        """Host-side ``remap_and_bos`` for the chunked-prefill path: 0-pads
+        → unique per-position pad ids, <bos>=0 prepended. Integer-exact vs
+        the device remap, so every chunk gathers the same embedding rows the
+        one-shot window would."""
+        B, T = texts.shape
+        pad_ids = (np.arange(T, dtype=np.int32)
+                   + np.int32(self.num_text_tokens - self.text_seq_len))
+        out = np.where(texts == 0, pad_ids[None, :], texts).astype(np.int32)
+        return np.concatenate([np.zeros((B, 1), np.int32), out], axis=1)
+
+    @staticmethod
+    def _split_cohorts(pairs):
+        """Partition one admission pass into shared-prefix cohorts (≥2
+        members of one group with identical text — the /v1/images fan-out)
+        and singles. A group split across admission passes still shares
+        within each pass; a lone straggler rides the single paths. Group
+        members with mismatched text (a misuse the gateway never produces)
+        are demoted to singles rather than silently prefilled with the
+        first member's prompt."""
+        by_gid: Dict[int, list] = {}
+        singles = []
+        for slot, req in pairs:
+            if req.group_id is not None:
+                by_gid.setdefault(req.group_id, []).append((slot, req))
+            else:
+                singles.append((slot, req))
+        cohorts = []
+        for members in by_gid.values():
+            text0 = members[0][1].text
+            if len(members) >= 2 and all(
+                    np.array_equal(r.text, text0) for _, r in members[1:]):
+                cohorts.append(members)
+            else:
+                singles.extend(members)
+        singles.sort(key=lambda p: p[0])
+        return cohorts, singles
+
     def run(self, queue: RequestQueue, *, max_steps: Optional[int] = None,
             poll_s: float = 0.02,
             on_complete=None, on_rows=None) -> List[CompletedRequest]:
@@ -443,9 +583,74 @@ class DecodeEngine:
         finally:
             unregister_state_provider(provider)
 
+    def _admit_shared(self, state, members, row_t0):
+        """One shared-prefix cohort: a single b=1 prefill broadcast into
+        every member's slot, per-candidate RNG lanes from each member's own
+        seed."""
+        B = self.slots
+        seeds = np.zeros((B,), np.int32)
+        n_rows = np.full((B,), self.n_steps, np.int32)
+        mask = np.zeros((B,), bool)
+        for slot, req in members:
+            seeds[slot] = req.seed
+            n_rows[slot] = self._n_tokens(req)
+            mask[slot] = True
+        text1 = self._pad_text(members[0][1].text)[None]
+        t0 = time.perf_counter()
+        state = self._refill_shared_fn(self.params, state, text1, seeds,
+                                       n_rows, mask)
+        t1 = time.perf_counter()
+        self.stats.refills += 1
+        self.stats.shared_refills += 1
+        self.stats.shared_prefills_saved += len(members) - 1
+        record_span("pipeline/prefill_shared", t0, t1 - t0,
+                    group_id=members[0][1].group_id,
+                    candidates=len(members),
+                    trace_id=members[0][1].trace_id)
+        for slot, req in members:
+            record_span("serve/prefill", t0, t1 - t0,
+                        request_id=req.request_id, trace_id=req.trace_id,
+                        mode="shared")
+            row_t0[slot] = t1
+        return state
+
+    def _dispatch_chunk(self, state, chunk_jobs, pending, row_t0):
+        """Advance the oldest pending chunked prefill by ONE bounded window
+        (the per-iteration budget that keeps neighbors' decode interleaved);
+        on the final chunk the rows turn active and their prefill spans
+        close."""
+        job = chunk_jobs[0]
+        prefix = job.ids.shape[1]
+        w = min(self.prefill_chunk, prefix - job.start)
+        last = job.start + w >= prefix
+        t0 = time.perf_counter()
+        state = self._refill_chunk_fn(
+            self.params, state, job.ids[:, job.start:job.start + w],
+            np.int32(job.start), job.seeds, job.n_rows, job.mask,
+            np.bool_(last))
+        t1 = time.perf_counter()
+        self.stats.prefill_chunks += 1
+        record_span("serve/prefill_chunk", t0, t1 - t0,
+                    start=job.start, width=w,
+                    step=self.stats.steps,
+                    trace_id=job.pairs[0][1].trace_id)
+        job.start += w
+        if last:
+            chunk_jobs.pop(0)
+            self.stats.refills += 1
+            for slot, req in job.pairs:
+                pending.discard(slot)
+                record_span("serve/prefill", job.t0, t1 - job.t0,
+                            request_id=req.request_id,
+                            trace_id=req.trace_id, mode="chunked")
+                row_t0[slot] = t1
+        return state
+
     def _run(self, queue, sched, state, buffers, row_t0, qual, completed, *,
              max_steps, poll_s, on_complete, on_rows):
         B = self.slots
+        chunk_jobs: List[_ChunkJob] = []
+        pending: set = set()       # slots admitted but mid-chunked-prefill
         while not (queue.drained and not sched.any_active):
             if max_steps is not None and self.stats.steps >= max_steps:
                 break
@@ -475,35 +680,59 @@ class DecodeEngine:
                         record_event("request_admitted", slot=slot,
                                      request_id=req.request_id,
                                      trace_id=req.trace_id)
-                    if 2 * len(pairs) >= B:
-                        # bulk admission: one multi-row refill window
+                    # shared-prefix cohorts first (one prefill per group),
+                    # then singles through the classic window/trickle split
+                    cohorts, singles = self._split_cohorts(pairs)
+                    for members in cohorts:
+                        state = self._admit_shared(state, members, row_t0)
+                    chunk_on = 0 < self.prefill_chunk < self.prefix_len
+                    if singles and (2 * len(singles) >= B or chunk_on):
+                        # bulk admission: one multi-row refill window —
+                        # chunked into bounded, decode-interleaved pieces
+                        # when prefill_chunk caps the per-dispatch width.
+                        # chunk-on also routes TRICKLE-size admissions here
+                        # (a one-row-masked window): a fat single admission
+                        # must obey the same per-dispatch bound, else the
+                        # staggered-completion steady state reintroduces
+                        # exactly the TTFT stall the knob exists to cap
                         texts = np.zeros((B, self.text_seq_len), np.int32)
                         seeds = np.zeros((B,), np.int32)
                         n_rows = np.full((B,), self.n_steps, np.int32)
                         mask = np.zeros((B,), bool)
-                        for slot, req in pairs:
+                        for slot, req in singles:
                             texts[slot] = self._pad_text(req.text)
                             seeds[slot] = req.seed
                             n_rows[slot] = self._n_tokens(req)
                             mask[slot] = True
-                        t0 = time.perf_counter()
-                        state = self._refill_fn(self.params, state, texts,
-                                                seeds, n_rows, mask)
-                        t1 = time.perf_counter()
-                        self.stats.refills += 1
-                        # one shared prefill window, one span per admitted
-                        # request (each request's timeline owns its prefill
-                        # segment; dur is the host dispatch wall)
-                        for slot, req in pairs:
-                            record_span("serve/prefill", t0, t1 - t0,
-                                        request_id=req.request_id,
-                                        trace_id=req.trace_id,
-                                        mode="window")
-                            row_t0[slot] = t1
-                    else:
-                        # trickle admission (staggered completions): per-row
-                        # scatter-prefill, 1/B the window's compute
-                        for slot, req in pairs:
+                        if 0 < self.prefill_chunk < self.prefix_len:
+                            chunk_jobs.append(_ChunkJob(
+                                ids=self._remap_bos_host(texts),
+                                seeds=seeds, n_rows=n_rows, mask=mask,
+                                pairs=list(singles),
+                                t0=time.perf_counter()))
+                            pending.update(s for s, _ in singles)
+                        else:
+                            t0 = time.perf_counter()
+                            state = self._refill_fn(self.params, state,
+                                                    texts, seeds, n_rows,
+                                                    mask)
+                            t1 = time.perf_counter()
+                            self.stats.refills += 1
+                            # one shared prefill window, one span per
+                            # admitted request (each request's timeline owns
+                            # its prefill segment; dur is the host dispatch
+                            # wall)
+                            for slot, req in singles:
+                                record_span("serve/prefill", t0, t1 - t0,
+                                            request_id=req.request_id,
+                                            trace_id=req.trace_id,
+                                            mode="window")
+                                row_t0[slot] = t1
+                    elif singles:
+                        # trickle admission (staggered completions, chunking
+                        # off): per-row scatter-prefill, 1/B the window's
+                        # compute
+                        for slot, req in singles:
                             t0 = time.perf_counter()
                             state = self._refill_row_fn(
                                 self.params, state,
@@ -527,7 +756,15 @@ class DecodeEngine:
             gauge_set("serve.queue_depth", float(queue.qsize()))
             gauge_set("serve.slot_occupancy", sched.occupancy)
 
-            if not sched.any_active:
+            if chunk_jobs:
+                # one bounded prefill window per iteration, so the decode
+                # step below keeps interleaving — the TTFT-isolation bar
+                state = self._dispatch_chunk(state, chunk_jobs, pending,
+                                             row_t0)
+
+            if not any(s not in pending for s in sched.active_slots()):
+                if chunk_jobs:
+                    continue          # keep driving the pending prefill
                 if queue.drained:
                     break
                 queue.wait_nonempty(timeout=poll_s)
@@ -545,7 +782,8 @@ class DecodeEngine:
             q_mass = np.asarray(qstats["topk_mass"]) if qstats else None
             now = time.perf_counter()
             for k in range(toks.shape[0]):
-                active = sched.active_slots()
+                active = [s for s in sched.active_slots()
+                          if s not in pending]
                 if not active:
                     break
                 for slot in active:

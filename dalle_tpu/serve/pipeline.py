@@ -1,0 +1,288 @@
+"""graftloom post-decode product pipeline: candidate groups → pixels → rank.
+
+The paper's actual user flow is text → MANY candidate image-token sequences
+→ dVAE pixel decode → CLIP rerank → top-k images (PAPER.md; the reference's
+``generate_images`` at dalle_pytorch.py:490-557). The decode engine ends at
+tokens; this module is the rest of the product: a small stage-graph runtime
+that takes FINISHED candidate groups (all N candidates of one
+``/v1/images`` request, collected by the gateway) and batches each group
+through
+
+  * ``decode_pixels`` — one jitted dVAE decode of the (N, image_seq_len)
+    token grids → (N, H, W, C) pixels (the vae stays off the per-token
+    critical path — it only ever sees whole finished groups);
+  * ``rerank`` — one jitted batched CLIP score (``CLIP.score_images``: the
+    text tower runs once per group, not once per candidate; pinned as the
+    ``clip_rerank`` graftir entry); without an attached reranker the stage
+    passes through with zero scores (candidate order = submission order);
+  * ``rank`` — order candidates by score (descending, ties by candidate
+    index — deterministic), emit the top-k with base64 pixel payloads.
+
+Each stage runs on its own worker thread behind a bounded queue, so a slow
+stage backs pressure up instead of buffering without bound, and the stages
+of DIFFERENT groups overlap (group A reranks while group B pixel-decodes).
+Per-stage spans (``pipeline/decode_pixels``, ``pipeline/rerank``) and
+queue-depth gauges (``pipeline.queue_depth{stage=...}`` — stage names only,
+bounded cardinality) feed ``obs_report``'s IMAGES verdict.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import queue as _queue
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..obs import counter_add, gauge_set, record_span
+
+_STAGES = ("decode_pixels", "rerank")
+
+
+def prepare_clip_text(text: np.ndarray, clip_cfg) -> np.ndarray:
+    """DALLE prompt ids → CLIP text-tower ids (the same sanitization
+    ``DalleWithVae.generate_images`` applies): ids at or above CLIP's text
+    vocab (DALLE's per-position pad remaps) zero back to pad, and the
+    context is cropped/0-padded to CLIP's ``text_seq_len`` (an out-of-range
+    position gather would fill with garbage)."""
+    text = np.asarray(text, np.int32).reshape(1, -1)
+    text = np.where(text >= clip_cfg.num_text_tokens, 0, text)
+    n = clip_cfg.text_seq_len
+    if text.shape[1] > n:
+        text = text[:, :n]
+    elif text.shape[1] < n:
+        text = np.pad(text, ((0, 0), (0, n - text.shape[1])))
+    return text
+
+
+@dataclasses.dataclass
+class CandidateGroup:
+    """All N finished candidates of one multi-candidate request, in
+    candidate order. ``tokens`` rows are the exact per-candidate grids the
+    engine produced (bitwise single-request generation under each seed)."""
+    group_id: int
+    text: np.ndarray            # (text_seq_len,) int32 prompt ids
+    tokens: np.ndarray          # (N, n_tokens) int32
+    seeds: List[int]
+    top_k: int
+    trace_id: Optional[str] = None
+
+
+@dataclasses.dataclass
+class RankedGroup:
+    """The pipeline's product: candidates ordered best-first."""
+    group_id: int
+    scores: List[float]         # per candidate, submission order
+    order: List[int]            # candidate indices, best first
+    top_k: List[dict]           # [{candidate, score, tokens[, pixels_b64,
+                                #   pixels_shape]}]
+    tokens: np.ndarray          # (N, n_tokens) all candidate grids
+    reranked: bool              # CLIP actually scored (vs zero passthrough)
+    trace_id: Optional[str] = None
+    error: Optional[str] = None
+
+
+class PendingResult:
+    """Handle for one submitted group: ``result(timeout)`` blocks until the
+    rank stage (or a stage failure) completes it."""
+
+    def __init__(self):
+        self._done = threading.Event()
+        self._result: Optional[RankedGroup] = None
+
+    def set(self, result: RankedGroup) -> None:
+        self._result = result
+        self._done.set()
+
+    def result(self, timeout: Optional[float] = None) -> RankedGroup:
+        if not self._done.wait(timeout):
+            raise TimeoutError("pipeline result not ready")
+        return self._result
+
+
+class ImagePipeline:
+    """``submit(CandidateGroup) -> PendingResult``; ``close()`` drains.
+
+    ``vae`` (a VAEAdapter) enables the pixel stage; ``clip``/``clip_params``
+    enable rerank (requires the vae — CLIP scores pixels, not tokens).
+    Without either, groups pass straight to the rank stage token-only with
+    zero scores. ``encode_pixels`` controls whether top-k entries carry
+    base64 uint8 RGB payloads (the gateway wants them; benches don't).
+    """
+
+    def __init__(self, vae=None, clip=None, clip_params=None, *,
+                 top_k: Optional[int] = None, maxsize: int = 64,
+                 encode_pixels: bool = True):
+        self.vae = vae
+        self.clip = clip
+        self.clip_params = clip_params
+        self.default_top_k = top_k
+        self.encode_pixels = bool(encode_pixels)
+        self._scorer = None
+        if clip is not None:
+            if vae is None:
+                raise ValueError("CLIP rerank needs a vae: the scorer "
+                                 "consumes decoded pixels, not token ids")
+            self._scorer = self._build_scorer(clip)
+        self._qs = {s: _queue.Queue(maxsize=max(1, int(maxsize)))
+                    for s in _STAGES}
+        self._threads: List[threading.Thread] = []
+        self._closed = False
+        self._lock = threading.Lock()
+
+    # -- jitted programs ---------------------------------------------------
+    def _build_scorer(self, clip):
+        """The batched rerank program (the ``clip_rerank`` graftir entry):
+        (1, T) text × (N, H, W, C) images → (N,) scores, with a resize to
+        CLIP's visual resolution fused in when the dVAE decodes at a
+        different size."""
+        import jax
+
+        from ..models.clip import CLIP
+        cfg = clip.cfg
+
+        def score(params, text, images):
+            vs = cfg.visual_image_size
+            if images.shape[1] != vs or images.shape[2] != vs:
+                images = jax.image.resize(
+                    images, (images.shape[0], vs, vs, images.shape[3]),
+                    "bilinear")
+            return clip.apply(params, text, images,
+                              method=CLIP.score_images)
+
+        return jax.jit(score)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "ImagePipeline":
+        with self._lock:
+            if self._closed:
+                # checked under the lock: a submit racing close() must not
+                # spawn workers that will never see the drain sentinel
+                raise RuntimeError("pipeline is closed")
+            if self._threads:
+                return self
+            for stage in _STAGES:
+                t = threading.Thread(target=self._work, args=(stage,),
+                                     name=f"pipeline-{stage}", daemon=True)
+                t.start()
+                self._threads.append(t)
+        return self
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Drain: queued groups finish, then the workers exit. Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            threads = list(self._threads)
+        if threads:
+            self._qs[_STAGES[0]].put(None)      # sentinel cascades forward
+        for t in threads:
+            t.join(timeout)
+
+    # -- submission --------------------------------------------------------
+    def submit(self, group: CandidateGroup, *,
+               timeout: float = 30.0) -> PendingResult:
+        self.start()                        # raises if closed (lock-checked)
+        pending = PendingResult()
+        # bounded put: a wedged stage must surface as an error to THIS
+        # caller, not park the connection thread forever on a full queue
+        self._put("decode_pixels", (group, pending), timeout=timeout)
+        return pending
+
+    def process(self, group: CandidateGroup) -> RankedGroup:
+        """Synchronous convenience (benches, tests): run every stage inline
+        on the caller's thread — identical math, no queue hops."""
+        images = self._decode_stage(group)
+        scores, reranked = self._rerank_stage(group, images)
+        return self._rank_stage(group, images, scores, reranked)
+
+    # -- stage workers -----------------------------------------------------
+    def _put(self, stage: str, item, timeout: Optional[float] = None) -> None:
+        q = self._qs[stage]
+        try:
+            q.put(item, timeout=timeout)
+        except _queue.Full:
+            raise RuntimeError(
+                f"pipeline backlogged: stage {stage!r} queue full "
+                f"for {timeout}s") from None
+        gauge_set("pipeline.queue_depth", float(q.qsize()),
+                  labels={"stage": stage})
+
+    def _work(self, stage: str) -> None:
+        q = self._qs[stage]
+        while True:
+            item = q.get()
+            gauge_set("pipeline.queue_depth", float(q.qsize()),
+                      labels={"stage": stage})
+            if item is None:                    # drain sentinel: pass on
+                nxt = _STAGES.index(stage) + 1
+                if nxt < len(_STAGES):
+                    self._qs[_STAGES[nxt]].put(None)
+                return
+            group, pending = item[0], item[1]
+            try:
+                if stage == "decode_pixels":
+                    images = self._decode_stage(group)
+                    self._put("rerank", (group, pending, images))
+                else:
+                    images = item[2]
+                    scores, reranked = self._rerank_stage(group, images)
+                    pending.set(self._rank_stage(group, images, scores,
+                                                 reranked))
+            except Exception as exc:  # noqa: BLE001 - a stage failure must
+                # complete the waiting request with an error, never strand
+                # the connection thread on an event that will never fire
+                # (the group is dropped; the worker keeps serving others)
+                pending.set(RankedGroup(
+                    group_id=group.group_id, scores=[], order=[], top_k=[],
+                    tokens=group.tokens, reranked=False,
+                    trace_id=group.trace_id, error=repr(exc)))
+
+    def _decode_stage(self, group: CandidateGroup):
+        if self.vae is None:
+            return None
+        t0 = time.perf_counter()
+        images = np.asarray(self.vae.decode(group.tokens))
+        record_span("pipeline/decode_pixels", t0, time.perf_counter() - t0,
+                    group_id=group.group_id,
+                    candidates=int(group.tokens.shape[0]),
+                    trace_id=group.trace_id)
+        return images
+
+    def _rerank_stage(self, group: CandidateGroup, images):
+        n = int(group.tokens.shape[0])
+        if self._scorer is None or images is None:
+            return [0.0] * n, False
+        t0 = time.perf_counter()
+        text = prepare_clip_text(group.text, self.clip.cfg)
+        scores = np.asarray(self._scorer(self.clip_params, text, images))
+        record_span("pipeline/rerank", t0, time.perf_counter() - t0,
+                    group_id=group.group_id, candidates=n,
+                    trace_id=group.trace_id)
+        counter_add("gateway.images_reranked_total", float(n))
+        return [float(s) for s in scores], True
+
+    def _rank_stage(self, group: CandidateGroup, images, scores,
+                    reranked: bool) -> RankedGroup:
+        n = int(group.tokens.shape[0])
+        # best score first; equal scores (and the rerank-off zeros) keep
+        # submission order — ranking is deterministic either way
+        order = sorted(range(n), key=lambda i: (-scores[i], i))
+        k = group.top_k if group.top_k else (self.default_top_k or n)
+        top = []
+        for i in order[:k]:
+            entry = {"candidate": i, "score": scores[i],
+                     "tokens": [int(t) for t in group.tokens[i]]}
+            if images is not None and self.encode_pixels:
+                band8 = (np.clip(images[i], 0.0, 1.0) * 255).astype(np.uint8)
+                entry["pixels_b64"] = base64.b64encode(
+                    band8.tobytes()).decode()
+                entry["pixels_shape"] = list(band8.shape)
+            top.append(entry)
+        return RankedGroup(group_id=group.group_id, scores=scores,
+                           order=order, top_k=top, tokens=group.tokens,
+                           reranked=reranked, trace_id=group.trace_id)

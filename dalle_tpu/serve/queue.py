@@ -56,6 +56,15 @@ class Request:
     # HTTP door (gateway/server.py) or by ``submit`` for CLI/bench
     # producers; every span the request touches is tagged with it.
     trace_id: Optional[str] = None
+    # shared-prefix candidate groups (graftloom): candidates of ONE
+    # ``/v1/images`` request carry the same ``group_id`` and identical text;
+    # members of a group admitted in the same engine pass share one text
+    # prefill (DALLE.serve_refill_shared) instead of paying N. Per-candidate
+    # seeds keep every candidate's sampling stream independent — tokens stay
+    # bitwise what N separate single-candidate requests would produce.
+    group_id: Optional[int] = None
+    group_size: int = 1
+    group_index: int = 0
     # stamped by the engine
     admitted_at: Optional[float] = None
     first_token_at: Optional[float] = None
@@ -105,7 +114,10 @@ class RequestQueue:
                max_tokens: Optional[int] = None,
                tenant: str = "default", priority: int = 0,
                deadline_at: Optional[float] = None,
-               trace_id: Optional[str] = None) -> Request:
+               trace_id: Optional[str] = None,
+               group_id: Optional[int] = None,
+               group_size: int = 1,
+               group_index: int = 0) -> Request:
         """Enqueue a request; returns it (with its assigned id). An explicit
         ``request_id`` must be fresh: ids at or below the high-water mark of
         previously issued ids are rejected rather than tracked individually,
@@ -140,7 +152,8 @@ class RequestQueue:
             req = Request(request_id=request_id, text=text, seed=seed,
                           max_tokens=max_tokens, tenant=tenant,
                           priority=priority, deadline_at=deadline_at,
-                          trace_id=trace_id)
+                          trace_id=trace_id, group_id=group_id,
+                          group_size=group_size, group_index=group_index)
             self._q.append(req)
             self._cond.notify_all()
         return req

@@ -24,12 +24,22 @@ contracts end-to-end over a real socket:
     flight recorder dumps a post-mortem bundle (a CI artifact, under
     ``<outdir>/flight/``) holding the replica_failed + failover lifecycle
     events and the dying worker's last decode-row spans;
+  * /v1/images product loop (graftloom) — a multi-candidate request over
+    the real socket: N candidates share ONE engine prefill
+    (``DALLE.serve_refill_shared``), the post-decode pipeline batches them
+    through dVAE pixels and the CLIP rerank stage, and every candidate's
+    tokens come back BITWISE equal to independent single-request
+    generation; SSE streams per-candidate rows with preview pixel bands
+    then a final ``ranked`` event; bad n_candidates/top_k → 400 before
+    admission; ``obs_report`` prints the IMAGES verdict line;
   * AOT cold start — a replica whose engine loaded the serialized
     executables serves its FIRST requests with ZERO backend compiles
     (asserted via the compile counter; phase A warms every eager op in the
     process through a jit replica first, so the zero is exactly "no
     retrace, no program compile on the cold replica" — a fresh jit engine
-    in the same position pays its step/refill compiles).
+    in the same position pays its step/refill compiles). The widened
+    graftloom bundle (4 programs incl. refill_shared) serves a cold
+    /v1/images request inside the same zero-compile window.
 
 Artifacts (smoke.json, gateway_spans.jsonl, gateway_trace.json,
 metrics.jsonl, flight/) land in ``--outdir`` — the dir ci.yml uploads
@@ -49,11 +59,12 @@ import threading
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _post(address: str, payload: dict, timeout: float = 120.0):
+def _post(address: str, payload: dict, timeout: float = 120.0,
+          path: str = "/v1/generate"):
     import http.client
     host, port = address.split("//")[1].rsplit(":", 1)
     conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
-    conn.request("POST", "/v1/generate", json.dumps(payload),
+    conn.request("POST", path, json.dumps(payload),
                  {"Content-Type": "application/json"})
     return conn, conn.getresponse()
 
@@ -88,6 +99,33 @@ def main(argv=None):
         params, np.asarray(t[None]), jax.random.PRNGKey(1000 + i),
         method=DALLE.generate_images_tokens)[0]).tolist()
         for i, t in enumerate(texts)}
+    # /v1/images references: candidate i of a seed-s request samples under
+    # seed s+i — texts[0] with base seed 1000 reuses refs[0]/refs[1], and a
+    # second base (4000) pins the independence of the fan-out seeds
+    img_refs = {s: np.asarray(model.apply(
+        params, np.asarray(texts[0][None]), jax.random.PRNGKey(s),
+        method=DALLE.generate_images_tokens)[0]).tolist()
+        for s in (1000, 1001, 4000, 4001)}
+
+    # the product loop's other two models: a tiny dVAE for pixel decode and
+    # a tiny CLIP reranker, shared by every gateway phase through ONE
+    # pipeline so phase B's zero-compile window inherits warm programs
+    from dalle_tpu.config import ClipConfig, DVAEConfig
+    from dalle_tpu.models.clip import init_clip
+    from dalle_tpu.models.dvae import init_dvae
+    from dalle_tpu.models.wrapper import DiscreteVAEAdapter
+    from dalle_tpu.serve import ImagePipeline
+    vcfg = DVAEConfig(image_size=16, num_tokens=24, codebook_dim=16,
+                      num_layers=2, num_resnet_blocks=0, hidden_dim=8)
+    vmodel, vparams = init_dvae(vcfg, jax.random.PRNGKey(args.seed + 1))
+    vae = DiscreteVAEAdapter(vmodel, vparams)
+    ccfg = ClipConfig(dim_text=32, dim_image=32, dim_latent=32,
+                      num_text_tokens=32, text_enc_depth=1, text_seq_len=6,
+                      text_heads=2, visual_enc_depth=1, visual_heads=2,
+                      visual_image_size=16, visual_patch_size=8)
+    clip_model, clip_params = init_clip(ccfg, jax.random.PRNGKey(args.seed))
+    pipeline = ImagePipeline(vae=vae, clip=clip_model,
+                             clip_params=clip_params)
 
     tracer = obs.configure()
     counter = obs.install_compile_counter()
@@ -116,16 +154,17 @@ def main(argv=None):
     aot_dir = os.path.join(tempfile.mkdtemp(prefix="gateway_smoke_"), "aot")
     manifest = save_engine_aot(make_engine(), aot_dir)
     check(all(manifest["payload_bytes"][p] > 0
-              for p in ("step", "refill", "refill_row")),
-          "AOT export serialized all three engine programs")
+              for p in ("step", "refill", "refill_row", "refill_shared")),
+          "AOT export serialized all four engine programs (incl. the "
+          "graftloom shared-prefix refill)")
 
     # phase A: a jit replica serves the SSE + quota checks (and warms every
     # eager op in the process, so phase B's zero is the cold-start claim)
     jit_rep = Replica(make_engine(), replica_id="jit-0", maxsize=16).start()
     admission = AdmissionController(TenantQuotas(
         rate_per_s=200.0, burst=200.0, overrides={"capped": (0.02, 1)}))
-    gw = Gateway(ReplicaRouter([jit_rep]), admission,
-                 slo_sentry=sentry).start()
+    gw = Gateway(ReplicaRouter([jit_rep]), admission, vae=vae,
+                 pipeline=pipeline, slo_sentry=sentry).start()
 
     conn, resp = _post(gw.address, {"text": texts[0].tolist(), "seed": 1000,
                                     "stream": True})
@@ -201,6 +240,72 @@ def main(argv=None):
         t.join()
     check(all(results.get(i) == refs[i] for i in range(1, n_req)),
           f"{n_req - 1} concurrent multi-tenant requests all token-exact")
+
+    # /v1/images: the graftloom product loop over the real socket ---------
+    conn, resp = _post(gw.address, {"text": texts[0].tolist(), "seed": 1,
+                                    "n_candidates": 3},
+                       path="/v1/images")
+    body = json.loads(resp.read())
+    conn.close()
+    check(resp.status == 400 and body["error"] == "bad_request",
+          "images validation: n_candidates over the slot budget → 400 "
+          "before admission")
+    conn, resp = _post(gw.address, {"text": texts[0].tolist(), "seed": 1,
+                                    "n_candidates": 2, "top_k": 3},
+                       path="/v1/images")
+    code = resp.status
+    resp.read(), conn.close()
+    check(code == 400, "images validation: top_k > n_candidates → 400")
+
+    conn, resp = _post(gw.address, {"text": texts[0].tolist(), "seed": 1000,
+                                    "n_candidates": 2, "top_k": 1},
+                       path="/v1/images")
+    ib = json.loads(resp.read())
+    conn.close()
+    check(resp.status == 200
+          and ib["candidates"] == [img_refs[1000], img_refs[1001]],
+          "/v1/images blocking: both candidates bitwise = independent "
+          "single-request generation (seed, seed+1)")
+    check(ib.get("reranked") is True and len(ib["scores"]) == 2
+          and len(ib["top_k"]) == 1
+          and ib["top_k"][0]["candidate"] == ib["order"][0]
+          and "pixels_b64" in ib["top_k"][0],
+          "/v1/images blocking: CLIP rerank applied, top-k entry carries "
+          "decoded pixels")
+    shared_n = jit_rep.engine.stats.shared_refills
+    check(shared_n >= 1,
+          f"engine paid shared prefills for the candidate group "
+          f"(shared_refills={shared_n})")
+
+    conn, resp = _post(gw.address, {"text": texts[0].tolist(), "seed": 4000,
+                                    "n_candidates": 2, "top_k": 2,
+                                    "stream": True, "pixels": True},
+                       path="/v1/images")
+    img_tid = resp.getheader("X-Request-Id")
+    irows, ranked = [], None
+    for event, data in iter_sse(resp):
+        if event == "row":
+            irows.append(data)
+        elif event == "ranked":
+            ranked = data
+    conn.close()
+    percand = {}
+    for d in irows:
+        percand.setdefault(d["candidate"], []).extend(d["tokens"])
+    check(sorted(percand) == [0, 1]
+          and percand[0] == img_refs[4000] and percand[1] == img_refs[4001],
+          "/v1/images SSE: per-candidate rows concat to the exact "
+          "per-seed generations")
+    check(all("pixels_b64" in d for d in irows),
+          "/v1/images SSE: every candidate row carries a preview pixel "
+          "band")
+    check(ranked is not None and ranked.get("reranked") is True
+          and ranked["candidates"] == [img_refs[4000], img_refs[4001]]
+          and len(ranked["top_k"]) == 2,
+          "/v1/images SSE: final ranked event carries scores + all "
+          "candidate grids")
+    check(bool(img_tid) and ranked.get("trace_id") == img_tid,
+          "/v1/images SSE: ranked event joins the request's trace_id")
 
     # quota: burst-1 tenant's second immediate request is rejected
     conn1, r1 = _post(gw.address, {"text": texts[0].tolist(), "seed": 2000,
@@ -298,7 +403,8 @@ def main(argv=None):
     check(aot_rep.aot_loaded and aot_engine.aot_loaded,
           "AOT bundle fingerprint-matched and loaded")
     gw2 = Gateway(ReplicaRouter([aot_rep.start()]),
-                  AdmissionController(), slo_sentry=sentry).start()
+                  AdmissionController(), vae=vae, pipeline=pipeline,
+                  slo_sentry=sentry).start()
     before = counter.count
     cold = {}
     for i in range(2):
@@ -306,12 +412,24 @@ def main(argv=None):
                                          "seed": 1000 + i})
         cold[i] = json.loads(resp.read())["tokens"]
         conn.close()
+    # the widened bundle's refill_shared executable serves a cold
+    # multi-candidate request inside the same zero-compile window (the
+    # shared pipeline's dVAE/CLIP programs were warmed in phase A)
+    conn, resp = _post(gw2.address, {"text": texts[0].tolist(),
+                                     "seed": 1000, "n_candidates": 2,
+                                     "top_k": 1}, path="/v1/images")
+    cold_img = json.loads(resp.read())
+    conn.close()
     compiles = counter.count - before
     check(compiles == 0,
-          f"AOT cold-start served first requests with {compiles} backend "
-          "compiles (retrace-free)")
+          f"AOT cold-start served first requests (incl. /v1/images) with "
+          f"{compiles} backend compiles (retrace-free)")
     check(all(cold[i] == refs[i] for i in range(2)),
           "AOT-served tokens bit-exact vs jit reference")
+    check(resp.status == 200
+          and cold_img["candidates"] == [img_refs[1000], img_refs[1001]]
+          and cold_img.get("reranked") is True,
+          "AOT-served /v1/images candidates bit-exact + reranked")
     gw2.shutdown(drain=True, timeout=60)
 
     spans = tracer.snapshot_spans()
@@ -364,9 +482,17 @@ def main(argv=None):
         env=dict(os.environ, JAX_PLATFORMS="cpu"))
     check("slo burn rate" in rep2.stdout and "BURNING" in rep2.stdout,
           "obs_report prints the slo burn-rate verdict (BURNING)")
+    check("images product loop" in rep2.stdout
+          and "IMAGES: RERANKING" in rep2.stdout,
+          "obs_report prints the graftloom IMAGES verdict (RERANKING)")
 
     summary = {
         "requests": n_req, "slots": args.slots,
+        "images_requests": snapshot.get("gateway.images_requests_total", 0),
+        "images_candidates": snapshot.get(
+            "gateway.images_candidates_total", 0),
+        "images_reranked": snapshot.get("gateway.images_reranked_total", 0),
+        "shared_refills": shared_n,
         "aot_payload_bytes": manifest["payload_bytes"],
         "aot_cold_start_compiles": compiles,
         "rejected_total": snapshot.get("gateway.rejected_total", 0),

@@ -69,6 +69,168 @@ def run_static(gen, params, cfg, queue, n_requests, slots):
     return done
 
 
+def bench_candidates(args):
+    """Shared-prefix amortization sweep (graftloom): the SAME workload — G
+    groups × N candidates of one prompt, Poisson group arrivals — served
+    twice through one engine: as N·G INDEPENDENT requests (every candidate
+    pays its own prompt prefill) vs as G candidate GROUPS
+    (``Request.group_id`` → ``DALLE.serve_refill_shared``: one prefill per
+    group, broadcast). Completed images/s is the headline; per-candidate
+    tokens are asserted BITWISE identical to independent single-request
+    generation in both modes — the speedup buys nothing if the bits move."""
+    import jax
+    import numpy as np
+
+    from dalle_tpu.config import DalleConfig
+    from dalle_tpu.models.dalle import DALLE, init_dalle
+    from dalle_tpu.serve import DecodeEngine, RequestQueue
+
+    if args.small:
+        # text-heavy on purpose: prefix sharing amortizes the PROMPT
+        # prefill, so the measured regime is long prompt / modest grid —
+        # the product shape (users write sentences, previews are small).
+        # At this shape the measured program costs are window≈12.6ms vs
+        # shared≈1.5ms vs 2×step8≈5.4ms → ~2.6× service-rate headroom
+        cfg = DalleConfig(num_text_tokens=256, text_seq_len=96, dim=64,
+                          depth=2, heads=2, dim_head=32, image_size=16,
+                          image_vocab_size=32, image_fmap_size=4)
+    else:
+        cfg = DalleConfig(num_text_tokens=1000, text_seq_len=64, dim=256,
+                          depth=4, heads=4, dim_head=64, image_size=32,
+                          image_vocab_size=512, image_fmap_size=8)
+    model, params = init_dalle(cfg, jax.random.PRNGKey(0), batch=2)
+    N = args.candidates
+    G = args.n_groups
+    slots = max(args.slots, N)
+    eng = DecodeEngine(model, params, slots=slots,
+                       steps_per_sync=args.steps_per_sync)
+    rng = np.random.RandomState(args.seed)
+    texts = [rng.randint(1, cfg.num_text_tokens,
+                         (cfg.text_seq_len,)).astype(np.int32)
+             for _ in range(G)]
+
+    def group_seed(g, i):
+        return args.seed_base + g * N + i
+
+    # bitwise bar: sampled groups against single-request generation
+    check_groups = list(range(min(2, G)))
+    refs = {}
+    for g in check_groups:
+        for i in range(N):
+            ids = model.apply(params, np.asarray(texts[g][None]),
+                              jax.random.PRNGKey(group_seed(g, i)),
+                              method=DALLE.generate_images_tokens)
+            refs[(g, i)] = np.asarray(ids[0])
+
+    def submit_group(q, g, grouped):
+        for i in range(N):
+            q.submit(texts[g], seed=group_seed(g, i),
+                     group_id=(g if grouped else None),
+                     group_size=N, group_index=i)
+
+    def run_one(grouped, groups):
+        q = RequestQueue()
+        for g in groups:
+            submit_group(q, g, grouped)
+        q.close()
+        return eng.run(q)
+
+    # warm both admission paths + the step program, then calibrate the
+    # arrival process off the GROUPED (faster) mode's STEADY-STATE service
+    # time: at load > 1 relative to the fast mode, BOTH modes stay
+    # backlogged, so the measured ratio is service-bound throughput —
+    # calibrating off the slow mode would leave the fast one
+    # arrival-starved and compress the speedup toward 1 regardless of the
+    # prefill savings. Amortizing over several closed-queue groups keeps
+    # run()'s per-call setup (state init + an eval_shape trace) out of the
+    # per-group estimate, which would otherwise inflate inter-arrivals the
+    # same way.
+    run_one(True, range(min(4, G)))
+    run_one(False, range(min(4, G)))
+
+    def timed(groups):
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            run_one(True, groups)
+            best = min(best, time.perf_counter() - t0)
+        return best
+    # difference calibration: a run() pays a fixed setup (state init + an
+    # eval_shape trace) that would otherwise inflate the per-group estimate
+    # and leave both replay modes arrival-bound; (t_G − t_1)/(G−1) cancels
+    # it exactly
+    cal_n = min(8, G)
+    t_group = (timed(range(cal_n)) - timed(range(1))) / (cal_n - 1)
+    t_group = max(t_group, 1e-4)
+    inter_arrival = t_group / args.load
+    print(json.dumps({"calibration": {
+        "t_group_s": round(t_group, 4),
+        "inter_arrival_s": round(inter_arrival, 4),
+        "slots": slots, "candidates": N, "groups": G}}), flush=True)
+
+    gaps = rng.exponential(inter_arrival, size=G)
+    gaps[0] = 0.0
+
+    def one_trial(grouped):
+        q = RequestQueue()
+
+        def producer():
+            for g, gap in enumerate(gaps):
+                time.sleep(gap)
+                submit_group(q, g, grouped)
+            q.close()
+
+        th = threading.Thread(target=producer)
+        eng.stats = type(eng.stats)()       # fresh counters per trial
+        t0 = time.perf_counter()
+        th.start()
+        done = eng.run(q)
+        wall = time.perf_counter() - t0
+        th.join()
+        by_id = {c.request_id: c for c in done}
+        exact = True
+        for g in check_groups:
+            for i in range(N):
+                c = by_id[g * N + i]
+                exact &= bool(np.array_equal(c.tokens, refs[(g, i)]))
+        assert exact, "tokens diverged from single-request refs"
+        lat = sorted(c.latency_s for c in done)
+        return {"images": len(done), "wall_s": round(wall, 3),
+                "images_per_s": round(len(done) / wall, 3),
+                "p50_latency_s": round(percentile(lat, 0.5), 4),
+                "p95_latency_s": round(percentile(lat, 0.95), 4),
+                "refills": eng.stats.refills,
+                "shared_refills": eng.stats.shared_refills,
+                "prefills_saved": eng.stats.shared_prefills_saved,
+                "tokens_bitwise_exact": exact}
+
+    # best-of-2 per mode, trials interleaved so slow background-load drift
+    # on the shared 1-core box hits both modes symmetrically (the same
+    # min-of-trials convention the classic calibration uses)
+    results = {}
+    for trial in range(2):
+        for mode, grouped in (("independent", False), ("grouped", True)):
+            row = one_trial(grouped)
+            best = results.get(mode)
+            if best is None or row["images_per_s"] > best["images_per_s"]:
+                results[mode] = {"mode": mode, **row}
+    for mode in ("independent", "grouped"):
+        print(json.dumps(results[mode]), flush=True)
+
+    speedup = (results["grouped"]["images_per_s"]
+               / results["independent"]["images_per_s"])
+    verdict = {"metric": "serve_bench_candidates_images_per_s_speedup",
+               "value": round(speedup, 3), "unit": "x",
+               "candidates": N, "load": args.load,
+               "grouped_images_per_s": results["grouped"]["images_per_s"],
+               "independent_images_per_s":
+                   results["independent"]["images_per_s"],
+               "prefills_saved": results["grouped"]["prefills_saved"],
+               "tokens_bitwise_exact": True}
+    print(json.dumps(verdict), flush=True)
+    return 0 if (not args.assert_win or speedup >= 1.3) else 1
+
+
 def bench(args):
     import jax
     import jax.numpy as jnp
@@ -212,8 +374,18 @@ def main(argv=None):
                     help="tiny config for the CPU mesh")
     ap.add_argument("--assert_win", dest="assert_win", action="store_true",
                     help="exit 1 unless continuous beats static on "
-                         "completed requests/s")
+                         "completed requests/s (candidates mode: unless "
+                         "grouped ≥ 1.3× independent images/s)")
+    ap.add_argument("--candidates", type=int, default=0,
+                    help="shared-prefix sweep: serve G groups × N "
+                         "candidates grouped (one prefill per group) vs as "
+                         "independent requests; reports completed images/s "
+                         "+ the amortization ledger (graftloom)")
+    ap.add_argument("--n_groups", type=int, default=16,
+                    help="candidate-mode group count")
     args = ap.parse_args(argv)
+    if args.candidates and args.candidates > 1:
+        return bench_candidates(args)
     return bench(args)
 
 

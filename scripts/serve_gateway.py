@@ -38,6 +38,11 @@ def build_parser():
                      help="DALLE checkpoint dir (scripts/train_dalle.py)")
     src.add_argument("--untrained", action="store_true",
                      help="tiny random model (loopback smoke/demo)")
+    src.add_argument("--clip_path", type=str, default=None,
+                     help="CLIP checkpoint dir (scripts/train_clip.py) to "
+                          "attach as the /v1/images reranker — restored "
+                          "params-only, no training imports "
+                          "(models/clip.py load_clip)")
     src.add_argument("--precision", type=str, default="int8w",
                      choices=["float32", "bfloat16", "bf16_int8kv", "int8w"],
                      help="serve-engine precision (int8w = the audited "
@@ -51,6 +56,13 @@ def build_parser():
                             "dispatch; a freed slot waits up to K-1 steps)")
     fleet.add_argument("--queue_maxsize", type=int, default=64,
                        help="bounded per-replica backlog; overflow → 429")
+    fleet.add_argument("--prefill_chunk", type=int, default=0,
+                       help="split window and trickle prefills into chunks "
+                            "of this many positions, interleaved with decode "
+                            "iterations (p95 TTFT isolation for long "
+                            "prompts; shared-prefix cohort prefills stay "
+                            "one-shot; 0 = one-shot prefills, the default — "
+                            "required for --aot_dir/--aot_export)")
     fleet.add_argument("--policy", type=str, default="fifo",
                        choices=["fifo", "priority_deadline"],
                        help="take-order policy (fifo = pinned default; "
@@ -115,6 +127,15 @@ def build_wrapper(args):
     return DalleWithVae(model, params, vae)
 
 
+def attach_clip(dv, args):
+    if not args.clip_path:
+        return dv
+    from dalle_tpu.models.clip import load_clip
+    clip_model, clip_params = load_clip(args.clip_path)
+    print(f"rerank: CLIP attached from {args.clip_path}")
+    return dv.attach_rerank(clip_model, clip_params)
+
+
 def main(argv=None):
     args = build_parser().parse_args(argv)
     enable_compile_cache(args)
@@ -133,12 +154,13 @@ def main(argv=None):
         # breach / watchdog stall / SIGQUIT (docs/OBSERVABILITY.md)
         obs.configure_recorder(args.flight_dir, sample_interval_s=1.0)
         obs.install_signal_dump()
-    dv = build_wrapper(args)
+    dv = attach_clip(build_wrapper(args), args)
 
     def make_engine():
         return dv.serve_engine(slots=args.slots, precision=args.precision,
                                steps_per_sync=args.steps_per_sync,
-                               decode_health=args.decode_health)
+                               decode_health=args.decode_health,
+                               prefill_chunk=args.prefill_chunk)
 
     if args.aot_export:
         manifest = save_engine_aot(make_engine(), args.aot_export)
@@ -181,7 +203,7 @@ def main(argv=None):
               + (f"; bundle {path}" if path else ""), flush=True)
 
     gw = Gateway(ReplicaRouter(replicas), admission,
-                 host=args.host, port=args.port, vae=dv.vae,
+                 host=args.host, port=args.port, vae=dv.vae, clip=dv.clip,
                  slo_sentry=obs.BurnRateSentry(
                      objective=args.slo_objective, on_breach=on_breach))
     gw.start()

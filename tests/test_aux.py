@@ -140,6 +140,9 @@ def test_backend_name_aliases():
     assert type(b).__name__ == "JaxBackend"
 
 
+@pytest.mark.slow  # ~14s; fast tier still builds + steps a CLIPTrainer
+# through the real train_clip CLI (test_cli rerank roundtrip) and covers the
+# serving-side CLIP via test_pipeline — multi-step descent rides slow
 def test_clip_trainer_descends(tmp_path):
     from dalle_tpu.config import ClipConfig
     from dalle_tpu.train.trainer_clip import CLIPTrainer

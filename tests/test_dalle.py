@@ -125,6 +125,9 @@ class TestGeneration:
         assert toks.shape == (1, CFG.image_seq_len)
         np.testing.assert_array_equal(np.asarray(toks[:, :7]), np.asarray(prime))
 
+    @pytest.mark.slow  # ~12s; the bf16 decode path runs fast-tier through
+    # the generate CLI (--bf16 rerank roundtrip) and the serve-engine bf16
+    # exactness tests — the statistical f32-agreement check rides slow
     def test_bf16_decode_tracks_f32_greedy(self, dalle):
         """The bf16 weights+cache decode path (DalleWithVae precision=
         'bfloat16') must produce mostly the same greedy tokens as f32 — it is

@@ -16,10 +16,13 @@ import numpy as np
 import pytest
 
 # ceiling = measured cold full-run total (309 with the shared module model:
-# ~7 engine instances × refill/step(+row) pairs + the AOT export's three
-# .compile() calls + references) + ~15% cross-jax-version slack (the
-# test_serve convention). A gateway change that recompiles per request or
-# per replica restart would blow straight through this.
+# ~7 engine instances × refill/step(+row) pairs + the AOT export's —
+# now four — .compile() calls + references) + ~15% cross-jax-version slack
+# (the test_serve convention). Re-measured after graftloom (group streams,
+# group failover, /v1/images validation, 4-program AOT bundle): well under
+# the ceiling, which is kept at the PR7 calibration. A gateway change that
+# recompiles per request or per replica restart would blow straight
+# through this.
 pytestmark = pytest.mark.recompile_budget(355)
 
 CFG = dict(num_text_tokens=32, text_seq_len=6, dim=32, depth=2, heads=2,
@@ -383,6 +386,123 @@ def test_gateway_loopback_stream_quota_health(model_params, refs):
 
 
 # ---------------------------------------------------------------------------
+# shared-prefix candidate groups (graftloom /v1/images plumbing)
+# ---------------------------------------------------------------------------
+
+def test_replica_group_stream_merged_and_exact(model_params):
+    """submit_group: N candidates enqueue atomically with consecutive ids
+    (one engine admission → ONE shared prefill) and the merged GroupStream
+    yields per-candidate rows + dones whose tokens are bitwise the
+    per-seed single-request references."""
+    import jax
+    from dalle_tpu.gateway import Replica
+    from dalle_tpu.models.dalle import DALLE
+    model, params = model_params
+    g_refs = [np.asarray(model.apply(
+        params, np.asarray(TEXTS[0][None]), jax.random.PRNGKey(s),
+        method=DALLE.generate_images_tokens)[0]) for s in (200, 201)]
+    rep = Replica(_engine(model_params), replica_id="grp").start()
+    group = rep.submit_group(TEXTS[0], [200, 201])
+    assert group.request_ids == [0, 1]        # consecutive → one admission
+    rows = {0: [], 1: []}
+    done = {}
+    for idx, kind, payload in group.events(timeout=60):
+        if kind == "row":
+            rows[idx].extend(payload[1])
+        elif kind == "done":
+            done[idx] = payload
+    assert sorted(done) == [0, 1]
+    for i in (0, 1):
+        assert rows[i] == g_refs[i].tolist()
+        np.testing.assert_array_equal(done[i].tokens, g_refs[i])
+    assert rep.engine.stats.shared_refills == 1
+    rep.drain(timeout=30)
+
+
+def test_replica_group_capacity_precheck_atomic(model_params):
+    """A group that would only partially fit raises QueueFull with NOTHING
+    enqueued — half an admitted group would decode candidates nobody is
+    waiting for."""
+    from dalle_tpu.gateway import Replica
+    from dalle_tpu.serve import QueueFull
+    rep = Replica(_engine(model_params), replica_id="cap",
+                  maxsize=1).start()
+    with pytest.raises(QueueFull):
+        rep.submit_group(TEXTS[0], [1, 2])
+    assert rep.queue.qsize() == 0
+    assert rep._streams == {}
+    rep.drain(timeout=30)
+
+
+def test_group_failover_midstream_resubmits_whole_group(model_params):
+    """Replica death mid-group: the router resubmits the WHOLE group —
+    same text, same per-candidate seeds — so every candidate regenerates
+    bit-identically on the standby; per-candidate row high-water marks
+    keep each row delivered exactly once."""
+    import jax
+    from dalle_tpu.gateway import Replica, ReplicaRouter
+    from dalle_tpu.models.dalle import DALLE
+    model, params = model_params
+    g_refs = [np.asarray(model.apply(
+        params, np.asarray(TEXTS[1][None]), jax.random.PRNGKey(s),
+        method=DALLE.generate_images_tokens)[0]) for s in (300, 301)]
+    ra = Replica(_engine(model_params), replica_id="ga2").start()
+    rb = Replica(_engine(model_params), replica_id="gb2").start()
+    router = ReplicaRouter([ra, rb])
+    ra.fail_after_rows(3)
+    routed = router.submit_images(TEXTS[1], [300, 301])
+    rows = {0: [], 1: []}
+    done = None
+    for kind, payload in routed.events(timeout=60):
+        if kind == "row":
+            rows[payload["candidate"]].append(payload["row"])
+        elif kind == "done":
+            done = payload
+    fmap = CFG["image_fmap_size"]
+    for i in (0, 1):                          # every row exactly once
+        assert rows[i] == list(range(fmap))
+    assert done is not None and done["failovers"] == 1
+    assert done["replica"] == "gb2"
+    assert done["candidates"] == [r.tolist() for r in g_refs]
+    assert not ra.healthy and rb.healthy
+    router.drain(timeout=30)
+
+
+def test_gateway_images_validation_rejects_before_admission(model_params):
+    """/v1/images input bounds come back 400 at the HTTP door — never an
+    engine-thread kill that fleet failover would replay. No request below
+    reaches a slot, so this costs no decode."""
+    import http.client
+    from dalle_tpu.gateway import AdmissionController, Gateway, Replica, \
+        ReplicaRouter
+    rep = Replica(_engine(model_params), maxsize=8).start()
+    gw = Gateway(ReplicaRouter([rep]), AdmissionController()).start()
+    host, port = gw.httpd.server_address[:2]
+    assert gw.max_candidates == 2             # capped by the slot budget
+
+    def post(payload):
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        conn.request("POST", "/v1/images", json.dumps(payload))
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        conn.close()
+        return resp.status, body
+
+    base = {"text": TEXTS[0].tolist(), "seed": 1}
+    for bad in ({**base, "n_candidates": 3},          # > slot budget
+                {**base, "n_candidates": 0},
+                {**base, "n_candidates": 2, "top_k": 3},
+                {**base, "top_k": 0},
+                {**base, "n_candidates": 2, "seed": 2**31 - 1},  # seed wrap
+                {**base, "text": [TEXTS[0].tolist()]},           # 2-D text
+                {**base, "max_tokens": 0},
+                {"seed": 1}):                                    # no text
+        status, body = post(bad)
+        assert status == 400 and body["error"] == "bad_request", bad
+    gw.shutdown(drain=True, timeout=30)
+
+
+# ---------------------------------------------------------------------------
 # AOT cold start (jax)
 # ---------------------------------------------------------------------------
 
@@ -403,7 +523,7 @@ def test_aot_roundtrip_equality_and_fingerprint(model_params, refs,
     manifest = save_engine_aot(exporter, aot_dir)
     assert manifest["fingerprint"] == engine_fingerprint(exporter)
     assert set(manifest["payload_bytes"]) == {"step", "refill",
-                                              "refill_row"}
+                                              "refill_row", "refill_shared"}
 
     # jit-traced execution of the SAME programs, for the equality bar
     q = RequestQueue()

@@ -7,6 +7,7 @@ in test_ring_attention.py::test_kernel_ring_memory_scales."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from dalle_tpu.config import (DalleConfig, MeshConfig, OptimConfig,
                               PrecisionConfig, TrainConfig)
@@ -35,6 +36,9 @@ def _loss_bwd_temp(loss_chunk: int) -> int:
     return c.memory_analysis().temp_size_in_bytes
 
 
+@pytest.mark.slow  # ~14s (two big-vocab fwd+bwd compiles); chunked-loss
+# EXACTNESS stays fast-tier in test_dalle::test_chunked_loss_matches_full —
+# the bwd-temp-bytes ledger assertion rides the slow tier
 def test_loss_chunk_caps_logits_memory():
     """Chunked vocab-head CE must shrink the backward's temp footprint by at
     least 0.6x of one full (b, n, vocab) logits materialization (~471MB f32

@@ -168,6 +168,8 @@ def test_stack_batches_ragged_group_falls_back_to_singles():
     assert out[2][1][0].shape == (2, 8, 4)
 
 
+@pytest.mark.slow  # ~8s; the scan≡singles invariant stays fast-tier on the
+# vae and dalle(+rng) trainers — clip joins the vqgan variant in the slow tier
 def test_clip_train_steps_matches_singles(tmp_path):
     from dalle_tpu.train.trainer_clip import CLIPTrainer
 

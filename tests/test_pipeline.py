@@ -1,0 +1,300 @@
+"""graftloom post-decode product pipeline (dalle_tpu/serve/pipeline.py):
+stage ordering / drain / error-isolation semantics, deterministic ranking,
+the batched CLIP rerank stage (``CLIP.score_images`` parity with the
+reference's per-pair similarities + bitwise determinism), and the
+serve-side CLIP checkpoint loader (``models/clip.load_clip`` — no training
+imports on the restore path)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dalle_tpu.serve.pipeline import (CandidateGroup, ImagePipeline,
+                                      prepare_clip_text)
+
+# ceiling = measured cold full-run total (152: the jitted rerank scorer +
+# the tiny CLIP init + the eager parity/score applies) + ~15%
+# cross-jax-version slack (the test_serve convention). A pipeline change
+# that re-jits the scorer per group would blow straight through this.
+pytestmark = pytest.mark.recompile_budget(175)
+
+CLIP_CFG = dict(dim_text=32, dim_image=32, dim_latent=32,
+                num_text_tokens=64, text_enc_depth=1, text_seq_len=8,
+                text_heads=2, visual_enc_depth=1, visual_heads=2,
+                visual_image_size=16, visual_patch_size=8)
+
+
+class RecordingVae:
+    """Stub pixel decoder: candidate i's image is a constant plane encoding
+    its FIRST token, so rank order is checkable without a real dVAE."""
+
+    def __init__(self, fail_on_first_token=None):
+        self.calls = []                     # group leading tokens, in order
+        self.fail_on = fail_on_first_token
+
+    def decode(self, ids):
+        ids = np.asarray(ids)
+        self.calls.append(int(ids[0, 0]))
+        if self.fail_on is not None and int(ids[0, 0]) == self.fail_on:
+            raise RuntimeError("injected decode failure")
+        return np.stack([np.full((16, 16, 3), float(ids[i, 0]) / 100.0,
+                                 np.float32) for i in range(ids.shape[0])])
+
+
+def _group(gid, first_tokens, *, n_tokens=4, top_k=None, text=None):
+    toks = np.zeros((len(first_tokens), n_tokens), np.int32)
+    toks[:, 0] = first_tokens
+    return CandidateGroup(
+        group_id=gid,
+        text=text if text is not None else np.zeros(8, np.int32),
+        tokens=toks, seeds=list(range(len(first_tokens))),
+        top_k=top_k if top_k is not None else len(first_tokens))
+
+
+@pytest.fixture(scope="module")
+def tiny_clip():
+    import jax
+    from dalle_tpu.config import ClipConfig
+    from dalle_tpu.models.clip import init_clip
+    return init_clip(ClipConfig(**CLIP_CFG), jax.random.PRNGKey(0))
+
+
+# ---------------------------------------------------------------------------
+# host-only semantics (no jax)
+# ---------------------------------------------------------------------------
+
+def test_rank_without_models_keeps_submission_order():
+    """No vae, no clip: /v1/images still serves — token-only, zero scores,
+    candidate order = submission order (the deterministic tie-break), and
+    top_k truncates."""
+    pipe = ImagePipeline()
+    ranked = pipe.submit(_group(1, [7, 5, 9], top_k=2)).result(timeout=30)
+    assert ranked.error is None and ranked.reranked is False
+    assert ranked.scores == [0.0, 0.0, 0.0]
+    assert ranked.order == [0, 1, 2]
+    assert [e["candidate"] for e in ranked.top_k] == [0, 1]
+    assert all("pixels_b64" not in e for e in ranked.top_k)
+    assert ranked.top_k[0]["tokens"][0] == 7
+    pipe.close(timeout=10)
+
+
+def test_stage_ordering_drain_and_gauges():
+    """Groups flow through the decode stage in submission order (one
+    worker per stage → FIFO), close() drains every queued group before the
+    workers exit, submit-after-close raises, close is idempotent — and the
+    stage queue-depth gauges use ONLY the bounded stage name as a label
+    (the unbounded-metric-label rule)."""
+    from dalle_tpu import obs
+    vae = RecordingVae()
+    pipe = ImagePipeline(vae=vae, encode_pixels=False)
+    tracer = obs.configure()
+    try:
+        pending = [pipe.submit(_group(g, [g * 10, g * 10 + 1]))
+                   for g in range(3)]
+        pipe.close(timeout=30)              # drains, then stops
+        results = [p.result(timeout=1) for p in pending]
+        spans = [s for s in tracer.snapshot_spans()
+                 if s[0] == "pipeline/decode_pixels"]
+        m = obs.metrics_snapshot()
+    finally:
+        obs.disable()
+    assert vae.calls == [0, 10, 20]         # submission order
+    assert [r.group_id for r in results] == [0, 1, 2]
+    assert all(r.error is None for r in results)
+    # every candidate grid rode one batched decode per group
+    assert len(spans) == 3
+    assert all(s[5]["candidates"] == 2 for s in spans)
+    assert 'pipeline.queue_depth{stage="decode_pixels"}' in m
+    assert 'pipeline.queue_depth{stage="rerank"}' in m
+    assert not any("group_id" in k for k in m if "{" in k)
+    with pytest.raises(RuntimeError):
+        pipe.submit(_group(9, [1]))
+    pipe.close(timeout=5)                   # idempotent
+
+
+def test_stage_failure_completes_with_error_and_worker_survives():
+    """A stage exception completes THAT group with ``error`` set (the
+    gateway's 500) instead of stranding its waiter, and the worker keeps
+    serving later groups."""
+    pipe = ImagePipeline(vae=RecordingVae(fail_on_first_token=50))
+    bad = pipe.submit(_group(1, [50, 51]))
+    good = pipe.submit(_group(2, [60, 61]))
+    r_bad = bad.result(timeout=30)
+    r_good = good.result(timeout=30)
+    assert r_bad.error is not None and "injected" in r_bad.error
+    assert r_bad.top_k == [] and np.array_equal(r_bad.tokens[:, 0], [50, 51])
+    assert r_good.error is None and len(r_good.top_k) == 2
+    pipe.close(timeout=10)
+
+
+def test_pending_result_timeout():
+    pipe = ImagePipeline()                  # never started
+    from dalle_tpu.serve.pipeline import PendingResult
+    with pytest.raises(TimeoutError):
+        PendingResult().result(timeout=0.05)
+    pipe.close()
+
+
+def test_prepare_clip_text_crop_pad_remap(tiny_clip):
+    """DALLE prompt ids → CLIP text ids: ids at/above CLIP's text vocab
+    (DALLE's per-position pad remaps) zero to pad; length crops or
+    0-pads to CLIP's context."""
+    clip, _ = tiny_clip
+    cfg = clip.cfg                          # vocab 64, seq 8
+    long = np.arange(60, 72, dtype=np.int32)        # len 12, ids 60..71
+    out = prepare_clip_text(long, cfg)
+    assert out.shape == (1, 8) and out.dtype == np.int32
+    np.testing.assert_array_equal(out[0], [60, 61, 62, 63, 0, 0, 0, 0])
+    short = np.array([5, 6], np.int32)
+    np.testing.assert_array_equal(prepare_clip_text(short, cfg)[0],
+                                  [5, 6, 0, 0, 0, 0, 0, 0])
+
+
+# ---------------------------------------------------------------------------
+# the rerank stage (jax)
+# ---------------------------------------------------------------------------
+
+def test_score_images_parity_with_call_and_determinism(tiny_clip):
+    """CLIP.score_images (text tower ONCE per group) computes the same
+    per-pair similarities as __call__ with the text row repeated — the
+    reference's rerank — and the jitted scorer is bitwise deterministic
+    across calls."""
+    import jax
+    clip, params = tiny_clip
+    rng = np.random.RandomState(0)
+    text = rng.randint(1, 64, (1, 8)).astype(np.int32)
+    images = rng.rand(3, 16, 16, 3).astype(np.float32)
+    grouped = np.asarray(clip.apply(params, text, images,
+                                    method=type(clip).score_images))
+    pairwise = np.asarray(clip.apply(params, np.repeat(text, 3, axis=0),
+                                     images))
+    np.testing.assert_allclose(grouped, pairwise, rtol=2e-5, atol=1e-6)
+
+    pipe = ImagePipeline(vae=RecordingVae(), clip=clip, clip_params=params)
+    a = np.asarray(pipe._scorer(params, jax.numpy.asarray(text), images))
+    b = np.asarray(pipe._scorer(params, jax.numpy.asarray(text), images))
+    np.testing.assert_array_equal(a, b)     # bitwise: same program, no rng
+    pipe.close()
+
+
+def test_pipeline_rerank_orders_by_clip_score(tiny_clip):
+    """End-to-end through submit(): candidates are ordered by descending
+    CLIP score with index tie-break; rerun of the same group reproduces
+    scores and order bitwise; process() (the synchronous path benches use)
+    is identical math."""
+    clip, params = tiny_clip
+    vae = RecordingVae()
+    pipe = ImagePipeline(vae=vae, clip=clip, clip_params=params)
+    text = np.array([9, 8, 7, 0, 0, 0, 0, 0], np.int32)
+    g = _group(1, [10, 90, 40], top_k=3, text=text)
+    r1 = pipe.submit(g).result(timeout=60)
+    assert r1.error is None and r1.reranked is True
+    assert r1.order == sorted(range(3), key=lambda i: (-r1.scores[i], i))
+    assert [e["candidate"] for e in r1.top_k] == r1.order
+    assert all("pixels_b64" in e and e["pixels_shape"] == [16, 16, 3]
+               for e in r1.top_k)
+    r2 = pipe.submit(g).result(timeout=60)
+    assert r2.scores == r1.scores and r2.order == r1.order
+    r3 = pipe.process(g)
+    assert r3.scores == r1.scores and r3.order == r1.order
+    pipe.close(timeout=10)
+
+
+def test_clip_requires_vae():
+    clip = object()
+    with pytest.raises(ValueError, match="needs a vae"):
+        ImagePipeline(clip=clip, clip_params={})
+
+
+def test_wrapper_attach_rerank_builds_pipeline(tiny_clip):
+    """DalleWithVae.attach_rerank + image_pipeline: the serving hook that
+    turns a wrapper into the /v1/images product loop — reranker carried as
+    frozen data, no training imports."""
+    from dalle_tpu.models.wrapper import DalleWithVae
+    clip, params = tiny_clip
+    dv = DalleWithVae(None, None, RecordingVae())
+    p0 = dv.image_pipeline()
+    assert p0._scorer is None               # no reranker attached yet
+    p0.close()
+    assert dv.attach_rerank(clip, params) is dv
+    pipe = dv.image_pipeline(top_k=1)
+    assert pipe._scorer is not None and pipe.default_top_k == 1
+    ranked = pipe.submit(_group(3, [5, 25], top_k=0)).result(timeout=60)
+    assert ranked.reranked is True and len(ranked.top_k) == 1
+    pipe.close(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# serve-side CLIP checkpoint loading (no training imports)
+# ---------------------------------------------------------------------------
+
+def test_load_clip_roundtrip_and_identity_check(tiny_clip, tmp_path):
+    """models/clip.load_clip restores (CLIP, params) from a train_clip
+    checkpoint layout — composite state+metadata, params subtree only —
+    and refuses a non-CLIP checkpoint by its embedded model_class."""
+    import jax
+    from dalle_tpu.config import ClipConfig
+    from dalle_tpu.models.clip import load_clip
+    from dalle_tpu.train.checkpoints import CheckpointManager
+    clip, params = tiny_clip
+    state = {"step": 0, "params": params["params"], "opt": {"m": np.zeros(2)}}
+    # the trainer nests model params under "params" exactly like this
+    ck = CheckpointManager(str(tmp_path / "clip_ckpt"))
+    ck.save(3, {"params": params},
+            metadata={"model_class": "CLIP",
+                      "hparams": ClipConfig(**CLIP_CFG).to_dict()})
+    ck.close()
+    loaded, lparams = load_clip(str(tmp_path / "clip_ckpt"))
+    assert loaded.cfg == ClipConfig(**CLIP_CFG)
+    ref_leaves = jax.tree_util.tree_leaves(params)
+    got_leaves = jax.tree_util.tree_leaves(lparams)
+    assert len(ref_leaves) == len(got_leaves)
+    for a, b in zip(ref_leaves, got_leaves):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    ck = CheckpointManager(str(tmp_path / "vae_ckpt"))
+    ck.save(1, state, metadata={"model_class": "DiscreteVAE", "hparams": {}})
+    ck.close()
+    with pytest.raises(ValueError, match="not a CLIP checkpoint"):
+        load_clip(str(tmp_path / "vae_ckpt"))
+    with pytest.raises(FileNotFoundError):
+        load_clip(str(tmp_path / "empty_ckpt"))
+
+
+# ---------------------------------------------------------------------------
+# stage overlap (different groups in different stages concurrently)
+# ---------------------------------------------------------------------------
+
+def test_stages_overlap_across_groups():
+    """Group B pixel-decodes while group A reranks: with a slow decode
+    stage, submitting two groups takes ~max(stage walls), not their sum —
+    the stage-graph actually pipelines."""
+    class SlowVae(RecordingVae):
+        def decode(self, ids):
+            time.sleep(0.05)
+            return super().decode(ids)
+
+    events = []
+    ev_lock = threading.Lock()
+
+    class TracingPipe(ImagePipeline):
+        def _rerank_stage(self, group, images):
+            with ev_lock:
+                events.append(("rerank_start", group.group_id,
+                               time.perf_counter()))
+            return super()._rerank_stage(group, images)
+
+    pipe = TracingPipe(vae=SlowVae(), encode_pixels=False)
+    t0 = time.perf_counter()
+    pending = [pipe.submit(_group(g, [g])) for g in range(2)]
+    for p in pending:
+        assert p.result(timeout=30).error is None
+    pipe.close(timeout=10)
+    # group 0's rerank started before group 1's decode finished would be
+    # timing-flaky to assert directly; the robust invariant is ordering:
+    # rerank(0) fired before rerank(1), both completed, and the decode
+    # stage saw the groups in submission order
+    assert [e[1] for e in events] == [0, 1]
+    assert time.perf_counter() - t0 < 10.0

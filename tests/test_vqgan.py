@@ -114,6 +114,8 @@ class TestGANLosses:
         assert float(adopt_weight(1.0, jnp.int32(5), threshold=10)) == 0.0
         assert float(adopt_weight(1.0, jnp.int32(15), threshold=10)) == 1.0
 
+    @pytest.mark.slow  # ~14s (VGG compile); LPIPS parity keeps its stronger
+    # fast-tier check against the torch oracle in test_golden_import
     def test_lpips_zero_for_identical_inputs(self):
         model, params = init_lpips(jax.random.PRNGKey(0), 32)
         x = jax.random.uniform(jax.random.PRNGKey(1), (2, 32, 32, 3)) * 2 - 1
@@ -174,6 +176,10 @@ class TestTrainer:
             m = tr.train_step(imgs)
         assert m["nll_loss"] < first
 
+    @pytest.mark.slow  # ~37s (two-optimizer GAN step compile); the gate
+    # math keeps fast-tier units (adopt_weight, disc forward/actnorm) and
+    # test_perceptual still compiles+steps a VQGANTrainer fast-tier — the
+    # disc-updates integration rides the slow tier with loss_decreases
     def test_disc_trains_after_start(self, tmp_path):
         tc = TrainConfig(batch_size=8, log_every=1000, save_every_steps=10_000,
                          checkpoint_dir=str(tmp_path / "ckpt"),
