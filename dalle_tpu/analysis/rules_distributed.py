@@ -8,9 +8,11 @@ call sites that actually route through it. This rule makes a bare edge a
 lint finding instead of a 3 a.m. page:
 
   * ``unguarded-distributed-io`` — a ``jax.distributed.initialize(...)``
-    call, or a ``save``/``restore`` call on an orbax manager handle (the
-    ``_mgr`` naming convention set by ``train/checkpoints.py``), that is
-    not executed under the retry layer. "Under the retry layer" is
+    call, a ``save``/``restore`` call on an orbax manager handle (the
+    ``_mgr`` naming convention set by ``train/checkpoints.py``), or a raw
+    ``socket.create_connection(...)`` RPC dial (the graftfleet transport
+    edge — ``fleet/transport.py`` sets the guarded-dial convention), that
+    is not executed under the retry layer. "Under the retry layer" is
     recognized syntactically (the rules_jit trade): the call sits inside a
     function decorated with ``@retry(...)``, or inside a function whose
     name is passed to ``with_retry(...)``/``retry(...)(...)`` in the same
@@ -85,6 +87,21 @@ def _is_distributed_init(node: ast.Call) -> bool:
     return name.endswith("distributed.initialize")
 
 
+def _is_socket_dial(node: ast.Call) -> bool:
+    """``socket.create_connection(...)`` (or the bare name after a
+    ``from socket import create_connection``) — the raw TCP dial every
+    fleet RPC edge starts from. A single-attempt dial turns a replica
+    mid-restart or a briefly full accept queue into a failed request; the
+    graftfleet transport wraps its one raw dial in ``retry(...)`` and
+    everything else goes through that wrapper."""
+    name = dotted_name(node.func) or ""
+    # exactly the stdlib spellings: ``socket.create_connection(...)`` or
+    # the bare name after a from-import. Other APIs that happen to carry
+    # the method name (asyncio's loop.create_connection, a pool's) manage
+    # their own retries and are not this rule's business.
+    return name in ("create_connection", "socket.create_connection")
+
+
 def _is_mgr_io(node: ast.Call) -> bool:
     fn = node.func
     if not (isinstance(fn, ast.Attribute) and fn.attr in _MGR_METHODS):
@@ -105,10 +122,11 @@ def _is_mgr_io(node: ast.Call) -> bool:
 class UnguardedDistributedIO(Rule):
     name = "unguarded-distributed-io"
     description = (
-        "jax.distributed.initialize or an orbax manager save/restore "
-        "call outside the retry layer (utils/retry.py) — a transient "
-        "coordinator/filesystem blip becomes a dead worker instead of a "
-        "few ms of jittered backoff; wrap the call in @retry/with_retry "
+        "jax.distributed.initialize, an orbax manager save/restore call, "
+        "or a raw socket.create_connection RPC dial outside the retry "
+        "layer (utils/retry.py) — a transient coordinator/filesystem/"
+        "connect blip becomes a dead worker or failed request instead of "
+        "a few ms of jittered backoff; wrap the call in @retry/with_retry "
         "or suppress with the why")
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:
@@ -120,6 +138,8 @@ class UnguardedDistributedIO(Rule):
             if isinstance(node, ast.Call):
                 kind = ("jax.distributed.initialize"
                         if _is_distributed_init(node)
+                        else "socket.create_connection"
+                        if _is_socket_dial(node)
                         else f"orbax manager .{node.func.attr}()"
                         if _is_mgr_io(node) else None)
                 if kind is not None and not any(

@@ -1,0 +1,32 @@
+"""graftfleet — cross-host replica fleet with an SLO-driven control loop.
+
+The ROADMAP's "millions of users" item: replicas move out of the gateway
+process onto a socket RPC boundary (``transport``: length-prefixed JSON
+frames, retry-guarded dials, ``RemoteReplica`` speaking the router's exact
+duck type), each replica a standalone ``scripts/serve_replica.py`` process
+that AOT-loads its engine programs so spawn→serving pays zero compiles
+(``manager``: process spawn, warm pool, kill), and a control loop that
+grows, shrinks, drains and heals the fleet off the signals PRs 8–9 built
+(``controller``: burn-rate + backlog scale-up, idle scale-down,
+degradation/heartbeat drains — hysteresis-guarded, min/max-bounded, every
+decision a ``fleet_action`` event + labeled counter).
+
+Mid-stream hand-offs stay bitwise-invisible: a drained or crashed remote
+replica's requests resubmit with the same seed and the router's row
+high-water dedup splices the streams — the PR 7 failover contract,
+extended across process and host boundaries. See docs/SERVING.md
+"Deployment topology".
+"""
+
+from .controller import FleetController
+from .manager import FleetManager, ReplicaProcess, SpawnError
+from .transport import (RemoteCompletion, RemoteGroupStream, RemoteReplica,
+                        RemoteResultStream, ReplicaServer, TransportError,
+                        call, dial, recv_frame, send_frame)
+
+__all__ = [
+    "FleetController", "FleetManager", "ReplicaProcess", "SpawnError",
+    "RemoteCompletion", "RemoteGroupStream", "RemoteReplica",
+    "RemoteResultStream", "ReplicaServer", "TransportError", "call",
+    "dial", "recv_frame", "send_frame",
+]

@@ -22,7 +22,7 @@ queue and WHERE. See docs/SERVING.md.
 from .admission import (AdmissionController, Decision, SloEstimator,
                         TenantQuotas, TokenBucket)
 from .aot import (enable_compilation_cache, engine_fingerprint,
-                  load_engine_aot, save_engine_aot)
+                  fingerprint_mismatch, load_engine_aot, save_engine_aot)
 from .replica import GroupStream, Replica, ReplicaFailure, ResultStream
 from .router import (NoReplicaAvailable, ReplicaRouter, RoutedGroup,
                      RoutedStream)
@@ -32,7 +32,8 @@ from .sse import RowPixelDecoder, iter_sse, sse_event
 __all__ = [
     "AdmissionController", "Decision", "SloEstimator", "TenantQuotas",
     "TokenBucket", "enable_compilation_cache", "engine_fingerprint",
-    "load_engine_aot", "save_engine_aot", "Replica", "ReplicaFailure",
+    "fingerprint_mismatch", "load_engine_aot", "save_engine_aot",
+    "Replica", "ReplicaFailure",
     "ResultStream", "GroupStream", "NoReplicaAvailable", "ReplicaRouter",
     "RoutedStream", "RoutedGroup", "Gateway", "RowPixelDecoder", "iter_sse",
     "sse_event",

@@ -101,6 +101,16 @@ class SloEstimator:
         self.parallelism = max(int(parallelism), 1)
         self._lock = threading.Lock()
 
+    def set_parallelism(self, parallelism: int) -> None:
+        """Re-point the fluid model at the CURRENT fleet width (slots ×
+        replicas). The graftfleet controller calls this on every scale/
+        drain so backlog predictions track capacity instead of the boot-
+        time fleet size — a scaled-up fleet would otherwise keep shedding
+        traffic it can now comfortably serve."""
+        with self._lock:
+            self.parallelism = max(int(parallelism), 1)
+            gauge_set("gateway.slo_parallelism", float(self.parallelism))
+
     def observe(self, tokens: int, seconds: float) -> None:
         if seconds <= 0 or tokens <= 0:
             return

@@ -39,6 +39,23 @@ class ReplicaFailure(RuntimeError):
     like any other crash — unhealthy replica, failover events."""
 
 
+def classify_failure(payload) -> str:
+    """The ONE payload→failover-reason mapping, shared by the router's
+    labeled counter (in-process failures) and the fleet transport's wire
+    frames (remote failures) so the same failure gets the same
+    ``gateway.failover_total{reason=}`` label on both topologies. Dict
+    payloads carry their reason explicitly (``conn_reset``/``conn_timeout``
+    from the fleet transport, ``drain``/``health_page``/``decode_degraded``
+    from a migrate); the stream's bare "event timeout" string means an
+    unhealthy replica went quiet; any other string is a worker-thread
+    death (repr of the killing exception)."""
+    if isinstance(payload, dict):
+        return str(payload.get("reason", "worker_death"))
+    if payload == "event timeout":
+        return "unhealthy_timeout"
+    return "worker_death"
+
+
 class ResultStream:
     """Per-request event pipe: engine thread puts, consumer thread gets.
     Terminal events: ``done``, ``shed``, ``replica_failed``."""
@@ -143,6 +160,7 @@ class Replica:
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self.failed: Optional[BaseException] = None
+        self.migrated = False
         self._fail_after_rows: Optional[int] = None
 
     # -- lifecycle ---------------------------------------------------------
@@ -153,6 +171,20 @@ class Replica:
         self._thread.start()
         return self
 
+    def _take_all_streams(self) -> list:
+        """Shared teardown core for worker death AND migrate: stop
+        accepting, then claim every queued + in-flight stream (cleared
+        from the table so late engine callbacks drop harmlessly). The
+        caller terminates each claimed stream with its own payload."""
+        try:
+            self.queue.close()
+        except Exception:  # noqa: BLE001 - already-closed race is fine
+            pass
+        with self._lock:
+            streams = list(self._streams.values())
+            self._streams.clear()
+        return streams
+
     def _work(self):
         try:
             self.engine.run(self.queue, on_complete=self._on_complete,
@@ -162,13 +194,7 @@ class Replica:
             # recoverable, so classify nothing here and fail the streams
             self.failed = exc
             counter_add("gateway.replica_failures_total", 1.0)
-            try:
-                self.queue.close()
-            except Exception:  # noqa: BLE001 - already-closed race is fine
-                pass
-            with self._lock:
-                streams = list(self._streams.values())
-                self._streams.clear()
+            streams = self._take_all_streams()
             # black box first, THEN fail the streams: the bundle freezes
             # the dying worker's last spans and in-flight ids before the
             # router starts resubmitting (obs/recorder.py; no-op unless a
@@ -186,7 +212,7 @@ class Replica:
     @property
     def healthy(self) -> bool:
         return (self._thread is not None and self._thread.is_alive()
-                and self.failed is None)
+                and self.failed is None and not self.migrated)
 
     @property
     def draining(self) -> bool:
@@ -198,6 +224,28 @@ class Replica:
         self.queue.close()
         if self._thread is not None:
             self._thread.join(timeout)
+
+    def migrate(self, reason: str = "drain") -> int:
+        """Fast hand-off (graftfleet): stop accepting, then terminate EVERY
+        queued + in-flight request's stream NOW with a dict
+        ``replica_failed`` payload carrying ``reason`` — the router
+        resubmits each elsewhere (same text, same seed), and its row
+        high-water dedup makes the splice bitwise-invisible to clients.
+        Unlike :meth:`drain`, nothing waits for in-flight decode: the slots
+        keep decoding unobserved until the queue drains and the worker
+        exits, which is fine because a migrated replica is about to be
+        killed anyway (controller drain-on-degradation / preemption).
+        Returns the number of streams migrated."""
+        self.migrated = True               # healthy → False: no new dispatch
+        streams = self._take_all_streams()
+        counter_add("gateway.migrated_streams_total", float(len(streams)))
+        record_event("replica_migrate", replica_id=self.replica_id,
+                     reason=reason, streams=len(streams))
+        for s in streams:
+            s.put("replica_failed",
+                  {"reason": reason,
+                   "detail": f"{self.replica_id} draining; resubmit"})
+        return len(streams)
 
     # -- load --------------------------------------------------------------
     @property
@@ -342,4 +390,9 @@ class Replica:
                 "draining": self.draining, "queue_depth": self.queue_depth,
                 "inflight": self.inflight, "aot_loaded": self.aot_loaded,
                 "shed_total": self.queue.shed_total,
+                # engine shape facts a REMOTE consumer (gateway over
+                # RemoteReplica, fleet controller) can't read off .engine
+                "slots": self.engine.slots,
+                "image_seq_len": self.engine.n_steps,
+                "image_fmap_size": self.engine.row_len,
                 "error": repr(self.failed) if self.failed else None}

@@ -18,19 +18,38 @@ the workers.
 from __future__ import annotations
 
 import itertools
+import threading
 import time
 from typing import List, Optional
 
 from ..obs import counter_add, dump_recorder, gauge_set, record_event
 from ..obs.context import new_trace_id
 from ..serve.queue import QueueFull
-from .replica import GroupStream, Replica, ReplicaFailure, ResultStream
+from .replica import (GroupStream, Replica, ReplicaFailure, ResultStream,
+                      classify_failure)
 
 _gids = itertools.count()
 
 
 class NoReplicaAvailable(RuntimeError):
     """No healthy replica could accept the request (all dead or all full)."""
+
+
+def _count_failover(trace_id: str, replica_id: str, failovers: int,
+                    payload, group: bool = False) -> str:
+    """Shared failover bookkeeping for single and group streams: the
+    stable unlabeled total (pre-fleet dashboards), the reason-labeled
+    family (``classify_failure`` — the one mapping, shared with the fleet
+    transport), and the lifecycle event — all BEFORE the resubmission
+    attempt so a post-mortem bundle holds the classification next to the
+    death."""
+    reason = classify_failure(payload)
+    counter_add("gateway.failovers_total", 1.0)
+    counter_add("gateway.failover_total", 1.0, labels={"reason": reason})
+    record_event("failover", trace_id=trace_id, from_replica=replica_id,
+                 failovers=failovers, reason=reason,
+                 **({"group": True} if group else {}), detail=payload)
+    return reason
 
 
 class RoutedStream:
@@ -92,15 +111,14 @@ class RoutedStream:
                                                "queued; request shed"})
                     return
                 else:                      # replica_failed
-                    counter_add("gateway.failovers_total", 1.0)
                     self.failovers += 1
                     # lifecycle event BEFORE the resubmission attempt, then
                     # a post-mortem bundle: the bundle's event ring holds
                     # this failover next to the replica_failed event, and
                     # its trace still holds the dead worker's last spans
-                    record_event("failover", trace_id=self._kw["trace_id"],
-                                 from_replica=self._replica.replica_id,
-                                 failovers=self.failovers, detail=payload)
+                    _count_failover(self._kw["trace_id"],
+                                    self._replica.replica_id,
+                                    self.failovers, payload)
                     if self.failovers > len(self.router.replicas):
                         # failover budget: a request that has killed (or
                         # been failed by) more replicas than the fleet has
@@ -197,12 +215,10 @@ class RoutedGroup:
                                                "queued; request shed"})
                     return
                 else:                      # replica_failed → group failover
-                    counter_add("gateway.failovers_total", 1.0)
                     self.failovers += 1
-                    record_event("failover", trace_id=self._kw["trace_id"],
-                                 from_replica=self._replica.replica_id,
-                                 failovers=self.failovers, group=True,
-                                 detail=payload)
+                    _count_failover(self._kw["trace_id"],
+                                    self._replica.replica_id,
+                                    self.failovers, payload, group=True)
                     if self.failovers > len(self.router.replicas):
                         yield ("error", {"reason": "replica_failed",
                                          "detail": "failover budget "
@@ -230,10 +246,46 @@ class RoutedGroup:
 
 
 class ReplicaRouter:
+    """Replicas may be in-process :class:`~.replica.Replica` threads or
+    :class:`~dalle_tpu.fleet.transport.RemoteReplica` processes — the
+    router dispatches to both uniformly (the graftfleet contract).
+    Membership is dynamic: the fleet controller adds/removes replicas
+    while requests are in flight, so the list is snapshotted under a lock
+    at every read."""
+
     def __init__(self, replicas: List[Replica]):
         assert replicas
-        self.replicas = list(replicas)
+        self._replicas = list(replicas)
+        self._members_lock = threading.Lock()
         self.draining = False
+
+    @property
+    def replicas(self) -> List[Replica]:
+        with self._members_lock:
+            return list(self._replicas)
+
+    # -- fleet membership (graftfleet controller) --------------------------
+    def add_replica(self, replica) -> None:
+        with self._members_lock:
+            self._replicas.append(replica)
+        gauge_set("gateway.replicas", float(len(self.replicas)))
+
+    def remove_replica(self, replica_or_id) -> Optional[Replica]:
+        """Take a replica out of dispatch (by object or replica_id).
+        In-flight streams on it are NOT touched here — the caller drains,
+        migrates or lets failover handle them. Returns the removed replica
+        (None when not present — removing twice is a no-op, not an
+        error)."""
+        removed = None
+        with self._members_lock:
+            for r in self._replicas:
+                if r is replica_or_id or r.replica_id == replica_or_id:
+                    removed = r
+                    break
+            if removed is not None:
+                self._replicas.remove(removed)
+        gauge_set("gateway.replicas", float(len(self.replicas)))
+        return removed
 
     # -- fleet state -------------------------------------------------------
     def healthy_replicas(self) -> List[Replica]:

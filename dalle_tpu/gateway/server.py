@@ -82,17 +82,25 @@ class Gateway:
         self.vae = vae
         self.image_fmap_size = image_fmap_size
         # per-request token demand for SLO math: the full grid unless the
-        # request caps max_tokens
-        eng = router.replicas[0].engine
-        self.image_seq_len = (image_seq_len if image_seq_len is not None
-                              else eng.n_steps)
+        # request caps max_tokens. A cross-host fleet's replicas carry no
+        # local .engine (graftfleet RemoteReplica) — the same shape facts
+        # then come from the replica's health dict, which the fleet
+        # transport forwards from the remote engine.
+        eng = getattr(router.replicas[0], "engine", None)
+        shape = {} if eng is not None else router.replicas[0].health()
+        self.image_seq_len = (
+            image_seq_len if image_seq_len is not None
+            else eng.n_steps if eng is not None
+            else int(shape["image_seq_len"]))
         if self.image_fmap_size is None:
-            self.image_fmap_size = eng.row_len
+            self.image_fmap_size = (eng.row_len if eng is not None
+                                    else int(shape["image_fmap_size"]))
         # /v1/images product loop (graftloom): candidates of one request
         # fan into engine slots, so the slot count caps n_candidates — a
         # larger fan-out could never share a prefill window and would
         # deadlock a single-replica fleet's admission
-        self.max_candidates = eng.slots
+        self.max_candidates = (eng.slots if eng is not None
+                               else int(shape["slots"]))
         # a pipeline passed in stays the caller's to close (the smoke shares
         # one across gateway phases so its jitted programs stay warm)
         self._owns_pipeline = pipeline is None

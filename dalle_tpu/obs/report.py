@@ -191,6 +191,9 @@ def format_request_timeline(rows: List[dict], request: str) -> str:
 _LABELED_REJECT_RE = re.compile(
     r'^gateway\.rejected_by_total\{(?P<labels>.*)\}$')
 _LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+_FAILOVER_REASON_RE = re.compile(
+    r'^gateway\.failover_total\{reason="([^"]+)"\}$')
+_FLEET_ACTION_RE = re.compile(r'^fleet\.actions_total\{action="([^"]+)"\}$')
 
 
 _SLO_BURN_RE = re.compile(r'^slo\.burn_rate\{window="([^"]+)"\}$')
@@ -308,17 +311,52 @@ def gateway_accounting(metrics: List[dict],
                     if s.get("name") == "serve/request_queue_wait")
     rejected = float(last.get("gateway.rejected_total", 0))
     shed = float(last.get("gateway.shed_total", 0))
+    # failover attribution (graftfleet): the labeled
+    # gateway.failover_total{reason=} family names WHY each failover
+    # happened — worker_death / unhealthy_timeout / conn_reset / drain /
+    # health_page / decode_degraded /
+    # conn_timeout — alongside the stable unlabeled total
+    failover_reasons = {}
+    for key, val in last.items():
+        m = _FAILOVER_REASON_RE.match(key)
+        if m:
+            failover_reasons[m.group(1)] = int(val)
     return {
         "inflight": float(last.get("gateway.inflight", 0)),
         "rejected": rejected,
         "by_tenant": by_tenant,
         "shed": shed,
         "failovers": float(last.get("gateway.failovers_total", 0)),
+        "failover_reasons": failover_reasons,
         "qwait_p50_s": percentile(qwaits, 0.5) if qwaits else None,
         "qwait_p95_s": percentile(qwaits, 0.95) if qwaits else None,
         "verdict": ("ADMISSION-LIMITED" if rejected + shed > 0
                     else "admitting"),
     }
+
+
+def fleet_accounting(metrics: List[dict]) -> Optional[dict]:
+    """graftfleet verdict inputs from the gauges/counters the controller
+    publishes every tick (fleet/controller.py): fleet size, warm pool,
+    the ``fleet.actions_total{action=}`` decision counters and the
+    ``fleet.state`` posture gauge (0 steady / 1 scaling / 2 draining).
+    ``None`` when no record carries a fleet key — single-process serving
+    keeps its report unchanged."""
+    rows = [r for r in metrics if any(k.startswith("fleet.") for k in r)]
+    if not rows:
+        return None
+    last = rows[-1]
+    actions = {}
+    for key, val in last.items():
+        m = _FLEET_ACTION_RE.match(key)
+        if m:
+            actions[m.group(1)] = int(val)
+    state = float(last.get("fleet.state", 0.0))
+    verdict = ("draining" if state == 2.0 else
+               "scaling" if state == 1.0 else "steady")
+    return {"size": last.get("fleet.size"),
+            "warm": last.get("fleet.warm_pool"),
+            "actions": actions, "verdict": verdict}
 
 
 def images_accounting(metrics: List[dict],
@@ -445,6 +483,8 @@ def format_report(rows: List[dict], *, topk: int = 10) -> str:
                 + (f" shed={gw['shed']:.0f}" if gw["shed"] else "")
                 + (f" failovers={gw['failovers']:.0f}" if gw["failovers"]
                    else "")
+                + (f" (by reason: {gw['failover_reasons']})"
+                   if gw["failover_reasons"] else "")
                 + f"; queue wait p50={fmt_num(gw['qwait_p50_s'], suffix='s')}"
                   f" p95={fmt_num(gw['qwait_p95_s'], suffix='s')}"
                 + f" → {gw['verdict']}")
@@ -465,6 +505,17 @@ def format_report(rows: List[dict], *, topk: int = 10) -> str:
                        else "IMAGES: tokens-only (no reranker scored)")
             lines.append("== images product loop (graftloom): "
                          + ", ".join(parts) + f" → {verdict}")
+        fl = fleet_accounting(metrics)
+        if fl is not None:
+            parts = []
+            if fl["size"] is not None:
+                parts.append(f"size={fl['size']:.0f}")
+            if fl["warm"] is not None:
+                parts.append(f"warm={fl['warm']:.0f}")
+            if fl["actions"]:
+                parts.append(f"actions {fl['actions']}")
+            lines.append("== fleet (graftfleet): " + ", ".join(parts)
+                         + f" → FLEET: {fl['verdict']}")
         slo = slo_accounting(metrics)
         if slo is not None:
             wtxt = " ".join(f"{w['window']}={w['burn']:.3g}x"

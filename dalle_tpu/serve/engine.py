@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..chaos.faults import step_hook as chaos_step_hook
 from ..models.dalle import DALLE
 from ..obs import (counter_add, gauge_set, record_event, record_span,
                    register_state_provider, unregister_state_provider)
@@ -772,6 +773,14 @@ class DecodeEngine:
 
             if backlog:
                 self.stats.sample_occupancy(sched.occupancy)
+
+            # chaos hook (graftfleet): an env-installed FaultPlan can
+            # kill/hang/slow a REPLICA PROCESS at decode-iteration
+            # granularity — mid-stream, between row commits — which is
+            # what the fleet smoke's drain/kill scenarios script. One
+            # module-global None check when chaos is off (the
+            # BaseTrainer.fit precedent, serve-side).
+            chaos_step_hook(self.stats.steps)
 
             toks, fins, qstats, state = self._step_fn(self.params, state)
             toks = np.asarray(toks)               # (K, B)

@@ -122,6 +122,26 @@ def run_gateway_smoke_stage() -> int:
     return subprocess.run(cmd, cwd=ROOT, env=env).returncode
 
 
+def run_fleet_smoke_stage() -> int:
+    """The graftfleet stage: a real cross-process replica fleet on
+    loopback (scripts/fleet_smoke.py; docs/SERVING.md "Deployment
+    topology") — an overload burst breaches the burn-rate sentry and the
+    controller attaches a warm AOT-prespawned replica process with ZERO
+    backend compiles while goodput recovers; a health-page drain migrates
+    a mid-stream request bitwise-invisibly; a chaos-SIGKILLed replica
+    process fails over (reason-labeled) and is replaced off missed
+    heartbeats; hysteresis/cooldown hold the fleet still under oscillating
+    load; and the episode lands as fleet_action events + the obs_report
+    FLEET verdict. Artifacts (controller decision log, metrics, flight
+    bundles, replica logs) land in ./fleet_artifacts — the dir ci.yml
+    uploads (the workflow's matching step is skipped below)."""
+    cmd = [sys.executable, os.path.join(ROOT, "scripts", "fleet_smoke.py"),
+           "--outdir", os.path.join(ROOT, "fleet_artifacts")]
+    print(f"== [fleet] {' '.join(cmd[1:])}")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(cmd, cwd=ROOT, env=env).returncode
+
+
 def run_chaos_smoke_stage() -> int:
     """The graftmend chaos stage: scripted fault scenarios over the real
     2-process gloo/DCN path (scripts/chaos_smoke.py; docs/RESILIENCE.md)
@@ -194,6 +214,10 @@ def main():
         print("ci_local: FAILED (gateway smoke) — test tiers not run")
         return 1
 
+    if run_fleet_smoke_stage() != 0:
+        print("ci_local: FAILED (fleet smoke) — test tiers not run")
+        return 1
+
     if run_chaos_smoke_stage() != 0:
         print("ci_local: FAILED (chaos smoke) — test tiers not run")
         return 1
@@ -227,6 +251,9 @@ def main():
         if "scripts/gateway_smoke.py" in cmd:
             print(f"-- [skip] {name}: already run in the gateway smoke "
                   "stage")
+            continue
+        if "scripts/fleet_smoke.py" in cmd:
+            print(f"-- [skip] {name}: already run in the fleet smoke stage")
             continue
         if "scripts/chaos_smoke.py" in cmd:
             print(f"-- [skip] {name}: already run in the chaos smoke stage")

@@ -1,0 +1,466 @@
+#!/usr/bin/env python
+"""Fleet smoke — the CI gate for dalle_tpu/fleet (docs/SERVING.md
+"Deployment topology").
+
+A REAL cross-process fleet on loopback: replica processes
+(scripts/serve_replica.py) behind the socket RPC transport, the HTTP
+gateway dispatching to them through RemoteReplica, and the SLO-driven
+controller closing the loop. Asserts, end to end over real processes:
+
+  * **burst → scale up, zero compiles** — an overload burst breaches the
+    burn-rate sentry (queue_full rejects burn the error budget); the
+    controller, after its sustain window, attaches a WARM AOT-prespawned
+    replica process; goodput (completed/offered) recovers to 1.0 on the
+    follow-up burst and the warm replica's backend-compile counter is
+    UNCHANGED across attach→serving (the health verb exposes it) — spawn
+    to serving paid zero compiles;
+  * **mid-stream drain is invisible** — a health-page drain
+    (controller.request_drain → migrate) fires while a request is
+    mid-stream on the victim (a chaos ``slow`` fault paces its rows); the
+    router resubmits same-text/same-seed, the row high-water dedup splices
+    the streams, and the final tokens are BITWISE identical to the
+    undrained single-request reference — with the failover attributed as
+    ``gateway.failover_total{reason="health_page"}``;
+  * **chaos kill → detect, fail over, replace** — a replica process
+    SIGKILLed mid-stream by an env-installed FaultPlan dies between row
+    relays; the client stream heals via ``reason="conn_reset"`` failover
+    (bitwise again), missed heartbeats mark the corpse, and the controller
+    replaces it from the warm pool;
+  * **hysteresis + bounds** — an oscillating load phase (small bursts and
+    idle gaps) produces ZERO fleet actions; sustained idle produces
+    exactly one scale_down; every decision row stays within
+    [min_replicas, max_replicas];
+  * **observability** — every decision is a ``fleet_action`` event and a
+    ``fleet.actions_total{action=}`` counter; ``obs_report`` renders the
+    ``FLEET:`` verdict line and attributes failovers by reason.
+
+Artifacts (smoke.json, decisions.json, metrics.jsonl, fleet_spans.jsonl,
+flight/, replica logs + per-replica flight bundles) land in ``--outdir``
+— the dir ci.yml uploads.
+Run: JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
+"""
+
+import argparse
+import glob
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import types
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import serve_replica as sr  # noqa: E402
+
+
+def _post(address, payload, timeout=180.0, path="/v1/generate"):
+    import http.client
+    host, port = address.split("//")[1].rsplit(":", 1)
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    conn.request("POST", path, json.dumps(payload),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    body = json.loads(resp.read() or b"{}")
+    conn.close()
+    return resp.status, body
+
+
+def _burst(address, texts, seeds, n):
+    """n concurrent blocking posts; returns (results by index, wall_s).
+    results[i] = (status, body)."""
+    out = {}
+
+    def client(i):
+        out[i] = _post(address, {"text": texts[i % len(texts)].tolist(),
+                                 "seed": int(seeds[i])})
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return out, time.perf_counter() - t0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--outdir", type=str, default="fleet_artifacts")
+    ap.add_argument("--slots", type=int, default=2)
+    ap.add_argument("--queue_maxsize", type=int, default=6)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    os.makedirs(args.outdir, exist_ok=True)
+
+    import jax
+    import numpy as np
+
+    from dalle_tpu import obs
+    from dalle_tpu.chaos.faults import Fault, FaultPlan
+    from dalle_tpu.config import DalleConfig
+    from dalle_tpu.fleet import FleetController, FleetManager
+    from dalle_tpu.gateway import (AdmissionController, Gateway,
+                                   ReplicaRouter, SloEstimator, TenantQuotas,
+                                   save_engine_aot)
+    from dalle_tpu.models.dalle import DALLE, init_dalle
+
+    obs.configure()
+    flight_dir = os.path.join(args.outdir, "flight")
+    obs.configure_recorder(flight_dir, min_dump_interval_s=0.0,
+                           sample_interval_s=0.5)
+    failures = []
+
+    def check(ok, msg):
+        print(("PASS " if ok else "FAIL ") + msg, flush=True)
+        if not ok:
+            failures.append(msg)
+
+    # -- references + AOT export (the parent pays every compile) ----------
+    cfg = DalleConfig(**sr.TINY_CFG)
+    model, params = init_dalle(cfg, jax.random.PRNGKey(0), batch=2)
+    rng = np.random.RandomState(args.seed)
+    texts = [rng.randint(1, 20, (cfg.text_seq_len,)).astype(np.int32)
+             for _ in range(4)]
+    ref = {}                       # (text_idx, seed) -> token list
+
+    def ref_for(ti, seed):
+        if (ti, seed) not in ref:
+            ref[(ti, seed)] = np.asarray(model.apply(
+                params, np.asarray(texts[ti][None]),
+                jax.random.PRNGKey(seed),
+                method=DALLE.generate_images_tokens)[0]).tolist()
+        return ref[(ti, seed)]
+
+    eng_args = types.SimpleNamespace(
+        untrained=True, dalle_path=None, model_seed=0,
+        precision="float32", slots=args.slots, steps_per_sync=4,
+        queue_maxsize=args.queue_maxsize, prefill_chunk=0,
+        decode_health=False)
+    aot_dir = os.path.join(args.outdir, "aot")
+    manifest = save_engine_aot(sr.build_engine(eng_args), aot_dir)
+    check(all(v > 0 for v in manifest["payload_bytes"].values()),
+          "AOT export serialized the engine programs for the fleet")
+
+    # -- fleet: 1 serving replica + 1 warm, controller over both ----------
+    argv_base = [
+        sys.executable, os.path.join(os.path.dirname(__file__),
+                                     "serve_replica.py"),
+        "--untrained", "--model_seed", "0", "--precision", "float32",
+        "--slots", str(args.slots), "--steps_per_sync", "4",
+        "--queue_maxsize", str(args.queue_maxsize),
+        "--aot_dir", aot_dir, "--warmup", "--no_compile_cache",
+        "--flight_dir", os.path.join(args.outdir, "replica_flight")]
+    manager = FleetManager(argv_base, warm_pool=1,
+                           env={"JAX_PLATFORMS": "cpu"},
+                           log_dir=os.path.join(args.outdir, "replica_logs"))
+    try:
+        rp0 = manager.spawn()
+        check(rp0.handshake.get("aot_loaded") is True,
+              "initial replica process loaded the AOT bundle "
+              "(fingerprint matched across processes)")
+        manager.prewarm()
+        check(manager.warm_available == 1, "warm pool prespawned 1 replica")
+
+        router = ReplicaRouter([rp0.remote])
+        admission = AdmissionController(
+            TenantQuotas(rate_per_s=1000.0, burst=1000.0),
+            SloEstimator(parallelism=args.slots))
+        # short windows so the smoke's burn decays in seconds, and a 1.5×
+        # threshold (error rate ≥ 15% of a 0.9 objective's budget) so the
+        # overload verdict is structural — the reject count of a fixed
+        # burst varies with box speed, the breach must not
+        sentry = obs.BurnRateSentry(
+            objective=0.9, windows=((3.0, 1.5), (10.0, 1.5)),
+            on_breach=lambda v: obs.dump_recorder(
+                "slo_breach", extra={"dominating": v["dominating"]}))
+        gw = Gateway(router, admission, slo_sentry=sentry).start()
+        # down_sustain deliberately dwarfs up_sustain (add capacity fast,
+        # remove it slowly): the oscillating-load phase's idle gaps must
+        # never accumulate into a shrink
+        ctl = FleetController(
+            router, manager, sentry=sentry, estimator=admission.slo,
+            min_replicas=1, max_replicas=3, up_sustain=2, down_sustain=12,
+            cooldown_ticks=3, retire_grace_ticks=1,
+            slots_per_replica=args.slots)
+        ctl.adopt(rp0)                  # the boot replica is already routed
+
+        # -- phase A: overload burst → burn → warm scale-up ---------------
+        warm_rp = manager._warm[0]
+        warm_compiles_0 = warm_rp.handshake["backend_compiles"]
+        n0 = 24
+        results0 = {}
+        wall0 = [0.0]
+
+        def run_burst0():
+            results0.update(_burst(gw.address, texts,
+                                   [1000 + i for i in range(n0)], n0)[0])
+        b0 = threading.Thread(target=run_burst0)
+        t0 = time.perf_counter()
+        b0.start()
+        time.sleep(0.7)            # rejects land instantly; burn is live NOW
+        a1 = ctl.tick()
+        a2 = ctl.tick()
+        scale_ups = [d for d in a1 + a2 if d["action"] == "scale_up"]
+        b0.join()
+        wall0[0] = time.perf_counter() - t0
+        ok0 = [i for i, (st, _) in results0.items() if st == 200]
+        rej0 = [i for i, (st, b) in results0.items()
+                if st == 429 and b.get("error") == "queue_full"]
+        check(len(rej0) > 0 and len(ok0) + len(rej0) == n0,
+              f"overload burst: {len(ok0)}/{n0} served, {len(rej0)} "
+              "queue_full rejects burned the error budget")
+        check(len(scale_ups) == 1 and scale_ups[0]["reason"] == "slo_burn",
+              "controller scaled up on sustained multi-window burn "
+              f"(actions: {[d['action'] for d in a1 + a2]})")
+        check(len(router.replicas) == 2,
+              "warm replica attached — fleet is 2")
+        check(all(results0[i][1]["tokens"] == ref_for(i % len(texts),
+                                                      1000 + i)
+                  for i in ok0),
+              "every served burst request token-exact vs single-request "
+              "reference")
+
+        n1 = 14
+        results1, wall1 = _burst(gw.address, texts,
+                                 [2000 + i for i in range(n1)], n1)
+        ok1 = [i for i, (st, _) in results1.items() if st == 200]
+        check(len(ok1) == n1,
+              f"post-scale-up burst: goodput recovered to {len(ok1)}/{n1} "
+              f"(was {len(ok0)}/{n0}); completed req/s "
+              f"{len(ok0) / wall0[0]:.2f} → {len(ok1) / wall1:.2f}")
+        check(all(results1[i][1]["tokens"] == ref_for(i % len(texts),
+                                                      2000 + i)
+                  for i in ok1),
+              "post-scale-up tokens bitwise-exact across both replicas")
+        warm_h = warm_rp.remote.health()
+        check(warm_h.get("backend_compiles") == warm_compiles_0,
+              f"warm AOT replica served with ZERO new backend compiles "
+              f"({warm_compiles_0} at handshake, "
+              f"{warm_h.get('backend_compiles')} after serving)")
+
+        # -- oscillating load: hysteresis must hold the fleet still ------
+        deadline = time.time() + 20.0
+        while sentry.evaluate()["burning"] and time.time() < deadline:
+            time.sleep(0.5)
+        check(not sentry.evaluate()["burning"],
+              "burn cleared after capacity caught up")
+        before = len(ctl.decisions)
+        for i in range(3):
+            _burst(gw.address, texts, [3000 + 10 * i, 3001 + 10 * i], 2)
+            ctl.tick()
+            time.sleep(0.3)
+            ctl.tick()
+        check(len(ctl.decisions) == before,
+              "oscillating load phase: zero fleet actions (hysteresis + "
+              "cooldown hold)")
+
+        # -- phase B: mid-stream health-page drain, bitwise-invisible -----
+        # engine-step chaos (serve/engine.py chaos hook; steps advance 4
+        # per dispatch at steps_per_sync=4, and the --warmup request
+        # consumes 1): the slow fault paces the dispatches after row 0 by
+        # 0.6 s each, holding the stream open long enough for the drain
+        # tick to land mid-decode
+        slow_plan = FaultPlan([Fault(kind="slow", step=3, duration_s=0.6,
+                                     span_steps=16)])
+        sv = manager.spawn(extra_env=slow_plan.env())
+        ctl.attach(sv)
+        # steer the stream onto the victim: it is briefly the only routed
+        # replica (membership is dynamic; the standbys come right back)
+        standbys = [rp0.remote, warm_rp.remote]
+        for r in standbys:
+            router.remove_replica(r)
+        routed = router.submit(texts[0], 5000)
+        for r in standbys:
+            router.add_replica(r)
+        check(routed.replica_id == sv.replica_id,
+              "drain-phase stream landed on the victim replica")
+        rows, done_box = [], [None]
+        first_row = threading.Event()
+
+        def consume():
+            for kind, payload in routed.events(timeout=30.0):
+                if kind == "row":
+                    rows.append(payload)
+                    first_row.set()
+                elif kind == "done":
+                    done_box[0] = payload
+            first_row.set()
+        ct = threading.Thread(target=consume)
+        ct.start()
+        check(first_row.wait(timeout=60.0) and done_box[0] is None,
+              "victim is streaming (chaos slow fault pacing its rows)")
+        ctl.request_drain(sv.replica_id, reason="health_page")
+        drain_acts = ctl.tick()
+        ct.join(timeout=120.0)
+        done = done_box[0]
+        check(any(d["action"] == "drain" and d["reason"] == "health_page"
+                  for d in drain_acts),
+              "controller executed the health-page drain")
+        check(done is not None and done["failovers"] == 1
+              and done["tokens"] == ref_for(0, 5000),
+              "mid-stream drain: spliced stream bitwise-identical to the "
+              "undrained reference")
+        check(sorted(p["row"] for p in rows)
+              == list(range(cfg.image_fmap_size)),
+              "every grid row delivered exactly once across the hand-off")
+        ctl.tick()                                    # reap the drained victim
+        time.sleep(0.2)
+        check(not sv.alive, "drained victim process was killed after grace")
+        snap = obs.metrics_snapshot()
+        check(snap.get('gateway.failover_total{reason="health_page"}',
+                       0) >= 1,
+              "failover attributed as {reason=health_page}")
+
+        # -- phase C: chaos-killed replica → conn_reset failover + replace
+        # SIGKILL at engine step 9 = after the second row's dispatch: the
+        # process dies BETWEEN row relays, mid-stream by construction
+        kill_plan = FaultPlan([Fault(kind="kill", step=9,
+                                     signal="SIGKILL")])
+        kv = manager.spawn(extra_env=kill_plan.env())
+        ctl.attach(kv)
+        for r in standbys:
+            router.remove_replica(r)
+        routed = router.submit(texts[1], 6000)
+        for r in standbys:
+            router.add_replica(r)
+        check(routed.replica_id == kv.replica_id,
+              "kill-phase stream landed on the chaos victim")
+        krows, kdone = [], [None]
+
+        def kconsume():
+            for kind, payload in routed.events(timeout=30.0):
+                if kind == "row":
+                    krows.append(payload)
+                elif kind == "done":
+                    kdone[0] = payload
+        kt = threading.Thread(target=kconsume)
+        kt.start()
+        kt.join(timeout=180.0)
+        check(kdone[0] is not None and kdone[0]["failovers"] == 1
+              and kdone[0]["tokens"] == ref_for(1, 6000),
+              "SIGKILLed replica: stream failed over bitwise-exact")
+        check([d["row"] for d in krows] == list(range(cfg.image_fmap_size)),
+              "rows exactly once, in order, across the process death")
+        snap = obs.metrics_snapshot()
+        check(snap.get('gateway.failover_total{reason="conn_reset"}',
+                       0) >= 1,
+              "failover attributed as {reason=conn_reset}")
+        replace_deadline = time.time() + 120.0
+        replaced = []
+        while time.time() < replace_deadline and not replaced:
+            replaced = [d for d in ctl.tick() if d["action"] == "replace"]
+            time.sleep(0.3)
+        check(bool(replaced),
+              "controller detected the dead process (missed heartbeats) "
+              "and replaced it")
+        check(len(router.replicas) == 3 and not kv.alive,
+              "fleet healed back to 3 with the corpse reaped")
+        st, body = _post(gw.address, {"text": texts[2].tolist(),
+                                      "seed": 7000})
+        check(st == 200 and body["tokens"] == ref_for(2, 7000),
+              "healed fleet serves token-exact")
+
+        # -- cross-process AOT fingerprint refusal: a replica handed a
+        # bundle built under a mismatched config must refuse LOUDLY in its
+        # handshake and serve on the jit fallback (cold, correct)
+        mm_argv = list(argv_base)
+        mm_argv[mm_argv.index("--slots") + 1] = str(args.slots + 1)
+        mm_argv.remove("--warmup")        # jit fallback: nothing to prewarm
+        mm = FleetManager(mm_argv, env={"JAX_PLATFORMS": "cpu"},
+                          log_dir=os.path.join(args.outdir, "replica_logs"))
+        try:
+            mmr = mm.spawn(replica_id="mismatch-0")
+            check(mmr.handshake["aot_loaded"] is False
+                  and "slots" in (mmr.handshake["aot_refusal"] or ""),
+                  "mismatched AOT bundle refused loudly in the handshake "
+                  f"({mmr.handshake['aot_refusal']})")
+            mstream = mmr.remote.submit(texts[3], 8000)
+            mdone = None
+            for kind, payload in mstream.events(
+                    timeout=300.0, still_alive=lambda: mmr.remote.healthy):
+                if kind == "done":
+                    mdone = payload
+            check(mdone is not None and mdone.tokens == ref_for(3, 8000),
+                  "refusing replica still serves token-exact on the jit "
+                  "fallback")
+        finally:
+            mm.shutdown()
+
+        # -- sustained idle → one bounded scale_down, then hysteresis -----
+        downs = []
+        for _ in range(40):
+            downs += [d for d in ctl.tick() if d["action"] == "scale_down"]
+            if downs:
+                break
+            time.sleep(0.05)
+        check(len(downs) == 1 and downs[0]["fleet"] >= ctl.min_replicas,
+              "sustained idle produced a bounded scale_down")
+        # a fresh shrink needs down_sustain MORE idle ticks — the next few
+        # ticks cannot fire a second one (no collapse, deterministically)
+        post = []
+        for _ in range(8):
+            post += [d for d in ctl.tick() if d["action"] == "scale_down"]
+        check(post == [],
+              "no second scale_down inside the hysteresis window")
+        check(all(ctl.min_replicas <= d["fleet"] <= ctl.max_replicas
+                  for d in ctl.decisions),
+              "every decision row within [min_replicas, max_replicas]")
+
+        # -- observability: decision log, metrics, FLEET verdict ----------
+        ctl.tick()
+        snap = obs.metrics_snapshot()
+        actions = {k: v for k, v in snap.items()
+                   if k.startswith("fleet.actions_total")}
+        check(len(actions) >= 3 and "fleet.size" in snap,
+              f"fleet_action counters + size gauge live ({actions})")
+        with open(os.path.join(args.outdir, "decisions.json"), "w") as fh:
+            json.dump(ctl.decisions, fh, indent=2)
+        with open(os.path.join(args.outdir, "metrics.jsonl"), "w") as fh:
+            fh.write(json.dumps({"step": 0, **snap}) + "\n")
+        n_spans = obs.export_spans_jsonl(
+            os.path.join(args.outdir, "fleet_spans.jsonl"))
+        rep = subprocess.run(
+            [sys.executable, os.path.join(os.path.dirname(__file__),
+                                          "obs_report.py"), args.outdir],
+            capture_output=True, text=True,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        check(rep.returncode == 0 and "FLEET:" in rep.stdout,
+              "obs_report prints the FLEET verdict line")
+        check("by reason" in rep.stdout and "conn_reset" in rep.stdout,
+              "obs_report attributes failovers by reason")
+
+        summary = {
+            "burst0": {"offered": n0, "completed": len(ok0),
+                       "rps": len(ok0) / wall0[0]},
+            "burst1": {"offered": n1, "completed": len(ok1),
+                       "rps": len(ok1) / wall1},
+            "warm_backend_compiles_delta":
+                warm_h.get("backend_compiles") - warm_compiles_0,
+            "decisions": [d["action"] for d in ctl.decisions],
+            "failover_reasons": {
+                k: v for k, v in snap.items()
+                if k.startswith("gateway.failover_total")},
+            "flight_bundles": sorted(os.path.basename(p) for p in glob.glob(
+                os.path.join(flight_dir, "postmortem_*"))),
+            "spans_exported": n_spans,
+            "failures": failures,
+        }
+        with open(os.path.join(args.outdir, "smoke.json"), "w") as fh:
+            json.dump(summary, fh, indent=2)
+        print(json.dumps({"metric": "fleet_smoke", **summary}), flush=True)
+        gw.shutdown(drain=True, timeout=60)
+    finally:
+        manager.shutdown()
+        obs.disable_recorder()
+        obs.disable()
+    if failures:
+        print(f"fleet_smoke: FAILED ({len(failures)} checks)")
+        return 1
+    print("fleet_smoke: GREEN")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Standalone serving replica: one engine + queue behind the fleet RPC.
+
+The graftfleet unit of capacity AND of failure (docs/SERVING.md
+"Deployment topology"): a process hosting one continuous-batching
+``DecodeEngine`` + ``PolicyQueue`` (``dalle_tpu/gateway/replica.py``)
+served over the length-prefixed frame protocol
+(``dalle_tpu/fleet/transport.py``). The gateway dials it through
+``RemoteReplica``; the controller (``fleet/controller.py``) spawns, drains
+and kills it.
+
+Cold-start contract: with ``--aot_dir`` the engine loads serialized
+executables (fingerprint-checked; a mismatch refuses LOUDLY and falls back
+to jit), and with ``--warmup`` the process serves one self-request before
+printing its handshake — so the moment the parent sees the handshake line,
+attach→serving pays ZERO backend compiles (asserted by
+scripts/fleet_smoke.py via the compile counter the health verb exposes).
+
+The handshake is ONE JSON line on stdout once the socket is listening:
+
+  {"fleet_replica": 1, "addr": "127.0.0.1:PORT", "pid": ..,
+   "replica_id": .., "aot_loaded": bool, "aot_refusal": str|null, ...}
+
+Postmortem story matches the gateway process: ``--flight_dir`` configures
+a flight recorder (bundles on worker death / SIGQUIT), ``kill -USR2``
+captures a bounded jax profile, SIGTERM drains gracefully. A
+``DALLE_CHAOS_PLAN`` env plan (dalle_tpu/chaos) is installed on entry and
+fires at the engine's decode-iteration boundaries — the fleet smoke
+kills/hangs/slows replica processes through it mid-stream.
+
+Run (loopback demo):
+  JAX_PLATFORMS=cpu python scripts/serve_replica.py --untrained --port 0
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _common import (add_compile_cache_args, add_profiler_args,  # noqa: E402
+                     enable_compile_cache, install_sigusr2_profiler,
+                     load_model_checkpoint)
+
+TINY_CFG = dict(num_text_tokens=32, text_seq_len=6, dim=64, depth=2,
+                heads=2, dim_head=32, image_size=16, image_vocab_size=24,
+                image_fmap_size=4)
+
+
+def build_parser():
+    ap = argparse.ArgumentParser(description=__doc__)
+    src = ap.add_argument_group("model")
+    src.add_argument("--dalle_path", type=str, default=None,
+                     help="DALLE checkpoint dir (scripts/train_dalle.py)")
+    src.add_argument("--untrained", action="store_true",
+                     help="tiny random model (TINY_CFG; loopback smoke)")
+    src.add_argument("--model_seed", type=int, default=0,
+                     help="--untrained init seed — every replica of one "
+                          "fleet must use the SAME seed so their params "
+                          "(and therefore tokens) are identical")
+    src.add_argument("--precision", type=str, default="int8w",
+                     choices=["float32", "bfloat16", "bf16_int8kv", "int8w"])
+    eng = ap.add_argument_group("engine")
+    eng.add_argument("--slots", type=int, default=4)
+    eng.add_argument("--steps_per_sync", type=int, default=4)
+    eng.add_argument("--queue_maxsize", type=int, default=64)
+    eng.add_argument("--prefill_chunk", type=int, default=0)
+    eng.add_argument("--policy", type=str, default="fifo",
+                     choices=["fifo", "priority_deadline"])
+    eng.add_argument("--decode_health", action="store_true",
+                     help="graftpulse decode-quality gauges; exposed via "
+                          "the health verb — the controller's drain-on-"
+                          "degradation signal")
+    aot = ap.add_argument_group("AOT cold start")
+    aot.add_argument("--aot_dir", type=str, default=None,
+                     help="serialized engine executables; fingerprint "
+                          "mismatch refuses loudly and falls back to jit")
+    aot.add_argument("--warmup", action="store_true",
+                     help="serve one self-request before the handshake so "
+                          "attach-time serving pays zero compiles")
+    net = ap.add_argument_group("network")
+    net.add_argument("--host", type=str, default="127.0.0.1")
+    net.add_argument("--port", type=int, default=0,
+                     help="0 = ephemeral (the handshake reports it)")
+    net.add_argument("--replica_id", type=str, default=None)
+    scope = ap.add_argument_group("graftscope (docs/OBSERVABILITY.md)")
+    scope.add_argument("--flight_dir", type=str, default="flight_bundles",
+                       help="flight-recorder bundle dir ('off' disables); "
+                            "a per-replica subdir keyed by replica_id "
+                            "keeps fleet postmortems separable")
+    add_compile_cache_args(ap)
+    add_profiler_args(ap)
+    return ap
+
+
+def build_engine(args):
+    import jax
+    from dalle_tpu.models.wrapper import DalleWithVae
+    if args.untrained:
+        from dalle_tpu.config import DalleConfig
+        from dalle_tpu.models.dalle import init_dalle
+        model, params = init_dalle(DalleConfig(**TINY_CFG),
+                                   jax.random.PRNGKey(args.model_seed),
+                                   batch=2)
+        dv = DalleWithVae(model, params, None)
+    elif args.dalle_path:
+        from dalle_tpu.config import DalleConfig
+        from dalle_tpu.models.dalle import init_dalle
+        model, params, _ = load_model_checkpoint(args.dalle_path, "DALLE",
+                                                 DalleConfig, init_dalle)
+        dv = DalleWithVae(model, params, None)
+    else:
+        raise SystemExit("provide --dalle_path or --untrained")
+    return dv.serve_engine(slots=args.slots, precision=args.precision,
+                           steps_per_sync=args.steps_per_sync,
+                           decode_health=args.decode_health,
+                           prefill_chunk=args.prefill_chunk)
+
+
+def warmup(replica, text_seq_len: int) -> None:
+    """One self-request through the full submit→stream→done path: after
+    this, admission and decode dispatch only already-compiled programs."""
+    import numpy as np
+    stream = replica.submit(np.zeros((text_seq_len,), np.int32), seed=0,
+                            max_tokens=1)
+    for kind, _payload in stream.events(timeout=300.0,
+                                        still_alive=lambda: replica.healthy):
+        if kind != "row":
+            break
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    enable_compile_cache(args)
+    install_sigusr2_profiler("profile_artifacts", args)
+
+    from dalle_tpu import obs
+    from dalle_tpu.chaos import faults
+    from dalle_tpu.fleet import ReplicaServer
+    from dalle_tpu.gateway import Replica, fingerprint_mismatch
+    from dalle_tpu.serve import PriorityDeadlinePolicy
+
+    obs.configure()
+    counter = obs.install_compile_counter()
+    rid = args.replica_id or f"replica-{os.getpid()}"
+    if args.flight_dir != "off":
+        obs.configure_recorder(os.path.join(args.flight_dir, rid),
+                               sample_interval_s=1.0)
+        obs.install_signal_dump()
+    # a parent-scripted fault plan (kill/hang/slow keyed on the engine's
+    # decode-iteration counter — serve/engine.py fires chaos.step_hook at
+    # every step dispatch, so a fault lands mid-stream, between row
+    # commits); no-op without the env var
+    faults.install_from_env()
+
+    engine = build_engine(args)
+    aot_refusal = (fingerprint_mismatch(engine, args.aot_dir)
+                   if args.aot_dir else None)
+    replica = Replica(
+        engine, replica_id=rid, maxsize=args.queue_maxsize,
+        policy=(PriorityDeadlinePolicy() if args.policy ==
+                "priority_deadline" else None),
+        aot_dir=args.aot_dir).start()
+    if args.warmup:
+        warmup(replica, engine.text_seq_len)
+    server = ReplicaServer(replica, host=args.host, port=args.port,
+                           compile_counter=counter).start()
+
+    stop = threading.Event()
+
+    def _sigterm(*_):
+        stop.set()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, _sigterm)
+
+    print(json.dumps({
+        "fleet_replica": 1, "addr": server.addr, "pid": os.getpid(),
+        "replica_id": rid, "slots": args.slots,
+        "aot_loaded": replica.aot_loaded, "aot_refusal": aot_refusal,
+        "warmed": bool(args.warmup),
+        "backend_compiles": counter.count}), flush=True)
+
+    stop.wait()
+    # graceful preemption: stop accepting, finish accepted work, exit 0
+    server.shutdown()
+    replica.drain(timeout=60)
+    obs.disable_recorder()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -957,3 +957,58 @@ def test_unguarded_io_suppression():
         jax.distributed.initialize(coord)  # graftlint: disable=unguarded-distributed-io
     """
     assert lint_source("unguarded-distributed-io", src) == []
+
+
+def test_unguarded_io_flags_bare_socket_dial():
+    # the graftfleet transport edge: a raw TCP dial outside the retry
+    # layer turns a replica mid-restart into a failed request
+    src = """
+    import socket
+    def dial(host, port):
+        return socket.create_connection((host, port), timeout=5.0)
+    """
+    found = lint_source("unguarded-distributed-io", src)
+    assert len(found) == 1 \
+        and "socket.create_connection" in found[0].message \
+        and "retry layer" in found[0].message
+    # the from-import spelling is the same dial
+    bare = """
+    from socket import create_connection
+    def dial(host, port):
+        return create_connection((host, port))
+    """
+    assert len(lint_source("unguarded-distributed-io", bare)) == 1
+
+
+def test_unguarded_io_socket_dial_clean_when_guarded():
+    # the fleet/transport.py idiom: ONE raw dial function wrapped by the
+    # retry factory applied inline; everything else goes through it
+    src = """
+    import socket
+    from dalle_tpu.utils.retry import retry
+    def _connect_raw(addr, timeout=5.0):
+        host, _, port = addr.rpartition(":")
+        return socket.create_connection((host, int(port)), timeout=timeout)
+    dial = retry("fleet_dial", attempts=4)(_connect_raw)
+    """
+    assert lint_source("unguarded-distributed-io", src) == []
+
+
+def test_unguarded_io_socket_dial_suppression_and_unrelated():
+    src = """
+    import socket
+    def probe(host, port):
+        # liveness probe: one attempt IS the signal (a miss must not
+        # hide behind backoff)
+        return socket.create_connection((host, port))  # graftlint: disable=unguarded-distributed-io
+    """
+    assert lint_source("unguarded-distributed-io", src) == []
+    # ONLY the stdlib socket dial spellings are the rule's business:
+    # other APIs carrying the method name (asyncio, pools) manage their
+    # own retries, and differently-named connection getters never matched
+    clean = """
+    async def g(loop, pool):
+        await loop.create_connection(lambda: None, "h", 1)
+        return pool.get_connection()
+    """
+    assert lint_source("unguarded-distributed-io", clean) == []
