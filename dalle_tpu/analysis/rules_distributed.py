@@ -119,6 +119,78 @@ def _is_mgr_io(node: ast.Call) -> bool:
 
 
 @register_rule
+class UnboundedBlockingCall(Rule):
+    """``unbounded-blocking-call`` — codifying the graftward
+    wedge-detection lesson (docs/RESILIENCE.md "Degradation ladder"): the
+    serving control plane is a web of threads joined by queues, events and
+    sockets, and ONE timeout-less blocking call turns a sick peer into a
+    parked thread nobody can observe — the connection handler waiting on a
+    queue a wedged engine will never feed, the worker waiting on an event
+    a dead thread will never set. Every cross-thread/cross-process wait in
+    the fleet/gateway/serve paths must be BOUNDED so the waiter gets a
+    chance to notice the world changed (drain flags, closed replicas,
+    frozen progress).
+
+    Flagged, scoped to ``dalle_tpu/{fleet,gateway,serve}/``:
+
+      * ``q.get()`` / ``ev.wait()`` / ``t.join()`` with NO arguments and
+        no ``timeout=`` — the zero-arg forms are exactly the
+        block-forever spellings (``d.get(key)`` has a positional arg and
+        never matches, so dict lookups stay out of scope).
+      * ``sock.recv(...)`` in a module that never calls ``settimeout`` —
+        a best-effort whole-module check: one ``settimeout`` anywhere
+        means the module manages socket deadlines (the
+        ``fleet/transport.py`` convention, where every reader sets the
+        socket timeout before pulling frames).
+
+    A deliberate forever-wait (a main thread parked on a shutdown event)
+    takes a one-line suppression with the why."""
+
+    name = "unbounded-blocking-call"
+    description = (
+        "a Queue.get()/Event.wait()/Thread.join() with no timeout, or a "
+        "socket recv in a module that never sets a socket timeout, in the "
+        "fleet/gateway/serve control plane — a wedged or dead peer then "
+        "parks this thread forever with no way to notice drain flags or "
+        "frozen progress; pass a timeout and re-check, or suppress with "
+        "the why")
+    include = ("dalle_tpu/fleet/", "dalle_tpu/gateway/",
+               "dalle_tpu/serve/")
+
+    _BLOCKING_ATTRS = ("get", "wait", "join")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        has_settimeout = any(
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "settimeout"
+            for n in ast.walk(ctx.tree))
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            attr = node.func.attr
+            has_timeout_kw = any(kw.arg == "timeout"
+                                 for kw in node.keywords)
+            if (attr in self._BLOCKING_ATTRS and not node.args
+                    and not node.keywords):
+                yield Finding(
+                    self.name, ctx.rel_path, node.lineno,
+                    f".{attr}() with no timeout blocks this thread until "
+                    "the other side acts — a wedged engine or dead peer "
+                    "parks it forever; pass timeout= and re-check the "
+                    "drain/closed state each wakeup")
+            elif (attr == "recv" and not has_settimeout
+                    and not has_timeout_kw):
+                yield Finding(
+                    self.name, ctx.rel_path, node.lineno,
+                    ".recv() in a module that never calls settimeout — "
+                    "a quiet peer blocks this reader forever; set a "
+                    "socket timeout (the fleet/transport.py convention) "
+                    "so liveness checks get to run")
+
+
+@register_rule
 class UnguardedDistributedIO(Rule):
     name = "unguarded-distributed-io"
     description = (

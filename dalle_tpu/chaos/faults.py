@@ -51,7 +51,7 @@ RANK_ENV = "DALLE_CHAOS_RANK"
 EPOCH_ENV = "DALLE_CHAOS_EPOCH"
 
 IO_SITES = ("coordinator_connect", "ckpt_save", "ckpt_restore", "heartbeat")
-STEP_KINDS = ("kill", "hang", "slow", "corrupt_ckpt")
+STEP_KINDS = ("kill", "hang", "slow", "wedge", "corrupt_ckpt")
 KINDS = STEP_KINDS + ("fail_io",)
 
 
@@ -71,7 +71,15 @@ class Fault:
       * ``hang`` — block the training loop for ``duration_s`` (liveness
         detectors must notice via stale heartbeats).
       * ``slow`` — sleep ``duration_s`` on each of ``span_steps``
-        consecutive steps starting at ``step`` (straggler).
+        consecutive steps starting at ``step`` (straggler). Fires at BOTH
+        step-hook surfaces: a training worker's fit loop and a serving
+        replica's decode-iteration hook (serve/engine.py) — the serve-side
+        form paces row commits, the fleet smoke's mid-stream drain tool.
+      * ``wedge`` — ``hang``, named for the serving plane: block inside
+        the ENGINE loop for ``duration_s`` so a live replica process stops
+        committing iterations while its accept/health threads keep
+        answering — the graftward wedged-engine scenario (the in-process
+        WedgeWatchdog must self-report it; docs/RESILIENCE.md).
       * ``corrupt_ckpt`` — damage the newest finalized step under
         ``path``: ``mode`` "truncate" (zero-length the array files),
         "garbage" (overwrite with noise), or "tmp_litter" (plant a stale
@@ -167,8 +175,8 @@ class FaultPlan:
                 faults.append(Fault(kind="slow", step=at, rank=victim,
                                     duration_s=0.2,
                                     span_steps=rng.randint(1, 3)))
-            elif kind == "hang":
-                faults.append(Fault(kind="hang", step=at, rank=victim))
+            elif kind in ("hang", "wedge"):
+                faults.append(Fault(kind=kind, step=at, rank=victim))
             elif kind == "corrupt_ckpt":
                 faults.append(Fault(kind="corrupt_ckpt", step=at,
                                     rank=victim, path=ckpt_dir))
@@ -211,7 +219,7 @@ class FaultPlan:
                 os.kill(os.getpid(), getattr(_signal, f.signal))
                 if f.signal == "SIGKILL":      # pragma: no cover - we died
                     time.sleep(60)
-            elif f.kind == "hang":
+            elif f.kind in ("hang", "wedge"):
                 time.sleep(f.duration_s)
             elif f.kind == "corrupt_ckpt":
                 corrupt_checkpoint(f.path, mode=f.mode, age_s=f.age_s)

@@ -1,0 +1,44 @@
+"""graftward: proactive degradation response, shared by both planes.
+
+graftmend (training) and graftfleet (serving) already survive components
+that *die* — a SIGKILLed worker reshapes the pod, a crashed replica fails
+over bitwise. This package closes the loop for components that are *sick
+but alive* (Dean & Barroso, "The Tail at Scale"): the straggling training
+worker that drags every lockstep collective, the worker whose graftpulse
+sentries page while it keeps heartbeating, the serving replica whose
+decode loop wedges while its process keeps answering health RPCs.
+
+Three building blocks, all pure stdlib (the elastic agent imports before
+jax initializes; the wedge watchdog runs inside replica processes):
+
+  * :class:`~.detector.StragglerDetector` — flags a worker whose per-step
+    completion *arrival* lags the fleet median by a sustained factor of
+    the step interval (EWMA-smoothed, hysteresis-guarded, edge-triggered).
+  * :class:`~.ladder.DegradeMonitor` — the page → drain response ladder
+    the :class:`~..parallel.elastic.ElasticAgent` runs each poll over the
+    fleet's heartbeat files (straggler verdicts + health-page markers).
+  * :class:`~.wedge.WedgeWatchdog` — the engine-iteration liveness probe a
+    replica process runs against its own decode loop: busy + frozen
+    progress past a timeout = wedged, self-reported through the health
+    verb so the fleet controller drains it with no operator page.
+
+Consumed by ``parallel/elastic.py`` (agent-side ladder, heartbeat pages),
+``fleet/controller.py`` / ``fleet/transport.py`` (wedge drains, the
+outside-in frozen-progress check) and ``scripts/serve_replica.py`` (the
+in-process watchdog). docs/RESILIENCE.md "Degradation ladder" is the
+operator guide.
+"""
+
+from .detector import StragglerDetector, StragglerVerdict, frozen_progress
+from .ladder import DegradeAction, DegradeMonitor, install_breach_pager
+from .wedge import WedgeWatchdog
+
+__all__ = [
+    "DegradeAction",
+    "DegradeMonitor",
+    "StragglerDetector",
+    "StragglerVerdict",
+    "WedgeWatchdog",
+    "frozen_progress",
+    "install_breach_pager",
+]

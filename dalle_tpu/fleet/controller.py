@@ -197,11 +197,16 @@ class FleetController:
     def _drain_replica(self, rp: ReplicaProcess, reason: str,
                        detail: str = "") -> dict:
         """``reason`` must stay a BOUNDED token (health_page /
-        decode_degraded / operator-chosen): it rides the migrate payload
-        into the ``gateway.failover_total{reason=}`` label, where every
-        distinct value is a Prometheus series held forever. Free-form
-        measurements go in ``detail`` (decision log + recorder event
-        only)."""
+        decode_degraded / wedged / operator-chosen): it rides the migrate
+        payload into the ``gateway.failover_total{reason=}`` label AND the
+        ``degrade.actions_total{reason=}`` family, where every distinct
+        value is a Prometheus series held forever. Free-form measurements
+        go in ``detail`` (decision log + recorder event only)."""
+        # graftward attribution: every proactive drain is a degradation
+        # response — the same reason-labeled family the training plane's
+        # straggler/health-page drains count into (parallel/elastic.py),
+        # read by obs_report's DEGRADE verdict
+        counter_add("degrade.actions_total", 1.0, labels={"reason": reason})
         self._detach(rp)
         migrated = rp.remote.migrate(reason=reason)
         with self._lock:
@@ -248,6 +253,24 @@ class FleetController:
         for rp in attached:
             missed = rp.remote.missed_heartbeats
             draining = getattr(rp.remote, "draining", False)
+            # graftward wedge, BEFORE the generic repair predicate: a
+            # wedged replica self-reports unhealthy (its process is alive,
+            # its accept/drain threads answer), so the right action is the
+            # migrate-DRAIN — in-flight streams fail over with
+            # reason="wedged" and splice bitwise — not a blind SIGKILL
+            # that would surface as anonymous conn_resets. Two sources,
+            # same verdict: the replica's own watchdog (health verb
+            # "wedged") and the transport's outside-in frozen-progress
+            # check (progress_stalled). Edge-triggered by construction:
+            # the drain detaches the replica from supervision.
+            wedged = (bool((rp.remote.health() or {}).get("wedged"))
+                      or getattr(rp.remote, "progress_stalled", False))
+            if rp.alive and wedged and not draining:
+                self._drain_replica(
+                    rp, "wedged",
+                    detail=str((rp.remote.health() or {}).get(
+                        "wedge_detail", "frozen engine progress")))
+                continue
             if rp.alive and missed < rp.remote.max_missed \
                     and (rp.remote.healthy or draining):
                 # draining is DELIBERATELY unhealthy (gateway shutdown,

@@ -160,6 +160,7 @@ class FleetManager:
     def __init__(self, argv: List[str], *, warm_pool: int = 0,
                  spawn_timeout_s: float = 240.0,
                  heartbeat_s: float = 0.25, max_missed: int = 3,
+                 progress_timeout_s: float = 0.0,
                  env: Optional[Dict[str, str]] = None,
                  log_dir: Optional[str] = None):
         self.argv = list(argv)
@@ -167,6 +168,12 @@ class FleetManager:
         self.spawn_timeout_s = float(spawn_timeout_s)
         self.heartbeat_s = float(heartbeat_s)
         self.max_missed = int(max_missed)
+        # graftward outside-in wedge backstop (transport._track_progress):
+        # busy replica + frozen engine-iteration counter past this →
+        # controller drains it {reason=wedged}. 0 (default) disables; arm
+        # on AOT+warmed fleets where no legitimate compile can freeze a
+        # busy engine (docs/SERVING.md).
+        self.progress_timeout_s = float(progress_timeout_s)
         self.env = dict(env or {})
         self.log_dir = log_dir
         self._seq = 0
@@ -223,9 +230,10 @@ class FleetManager:
         threading.Thread(target=_drain_stdout, args=(proc, rid),
                          name=f"stdout-{rid}", daemon=True).start()
         try:
-            remote = RemoteReplica(shake["addr"], replica_id=rid,
-                                   heartbeat_s=self.heartbeat_s,
-                                   max_missed=self.max_missed)
+            remote = RemoteReplica(
+                shake["addr"], replica_id=rid,
+                heartbeat_s=self.heartbeat_s, max_missed=self.max_missed,
+                progress_timeout_s=self.progress_timeout_s)
         except (RetryBudgetExceeded, TransportError, OSError) as exc:
             # handshook but won't answer health (died/wedged in between):
             # reap it NOW and surface the one spawn-failure type callers

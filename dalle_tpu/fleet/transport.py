@@ -57,6 +57,7 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
+from ..degrade.detector import frozen_progress
 from ..obs import counter_add, record_event
 from ..serve.queue import QueueFull
 from ..utils.retry import RetryBudgetExceeded, retry
@@ -150,12 +151,17 @@ class RemoteCompletion:
     """The ``done`` payload shape the router reads off a completed stream
     (``.tokens`` / ``.ttft_s`` / ``.latency_s``), rebuilt from the wire."""
 
-    __slots__ = ("tokens", "ttft_s", "latency_s", "request_id")
+    __slots__ = ("tokens", "ttft_s", "latency_s", "decode_s", "request_id")
 
     def __init__(self, frame: dict):
         self.tokens = [int(t) for t in frame["tokens"]]
         self.ttft_s = float(frame.get("ttft_s", 0.0))
         self.latency_s = float(frame.get("latency_s", 0.0))
+        # admission→completion in the REPLICA's timebase (durations ship
+        # fine across processes; absolute perf_counter stamps would not).
+        # Falls back to latency_s — queue wait included, so the estimator
+        # under-predicts throughput rather than over-admitting.
+        self.decode_s = float(frame.get("decode_s", self.latency_s))
         self.request_id = frame.get("request_id")
 
 
@@ -348,10 +354,23 @@ class RemoteReplica:
 
     def __init__(self, addr: str, *, replica_id: Optional[str] = None,
                  heartbeat_s: float = 0.25, max_missed: int = 3,
-                 dial_timeout: float = 5.0):
+                 dial_timeout: float = 5.0,
+                 progress_timeout_s: float = 0.0):
         self.addr = addr
         self.dial_timeout = float(dial_timeout)
         self.heartbeat_s = float(heartbeat_s)
+        # graftward outside-in wedge check (the serve twin of elastic.py's
+        # fresh-file-but-frozen-step liveness): a replica answering every
+        # health dial while its engine-iteration counter is frozen WITH
+        # work in flight is wedged even if its own watchdog is off/dead.
+        # 0 disables — the default, because a jit-fallback replica paying
+        # a first compile mid-request is busy-and-frozen legitimately;
+        # arm it on AOT+warmed fleets (the manager plumbs it through).
+        self.progress_timeout_s = float(progress_timeout_s)
+        self._progress_last: Optional[int] = None
+        self._progress_t = 0.0
+        self._progress_armed = False
+        self._progress_stalled = False
         # liveness probes must FAIL fast, not wait out the generous
         # submit-path dial timeout: against a blackholing partition a 5 s
         # connect per attempt would stretch missed-heartbeat detection to
@@ -395,6 +414,52 @@ class RemoteReplica:
             with self._lock:
                 self._missed = 0
                 self._last_health = h
+            self._track_progress(h)
+
+    def _track_progress(self, h: dict) -> None:
+        """Fresh-but-frozen, serve-side: the health reply carries the
+        engine's monotonic ``progress`` counter and its backlog; busy +
+        frozen counter past the timeout = wedged (``elastic.hung_workers``
+        semantics via the shared ``degrade.frozen_progress`` core). Idle
+        replicas and never-yet-advanced engines (compiles) never trip."""
+        if self.progress_timeout_s <= 0:
+            return
+        prog = h.get("progress")
+        if prog is None:
+            return                    # engine exposes no counter: inert
+        busy = (int(h.get("inflight") or 0)
+                + int(h.get("queue_depth") or 0)) > 0
+        now = time.monotonic()
+        # arm on the counter's VALUE (>0 = the engine completed at least
+        # one dispatch this run — the wedge.py/hung_workers rule), never
+        # on witnessing a change between two polls: a replica can wedge at
+        # the first value this monitor ever observes (attach to a warmed
+        # replica, first request wedges its first dispatch) and a
+        # change-based gate would never arm on it
+        if prog > 0:
+            self._progress_armed = True
+        if self._progress_last is None or prog != self._progress_last:
+            self._progress_last, self._progress_t = prog, now
+            self._progress_stalled = False      # progress clears the latch
+            return
+        if not busy:
+            self._progress_t = now              # idle ≠ wedged
+            return
+        if (self._progress_armed and not self._progress_stalled
+                and frozen_progress(prog, self._progress_t, now,
+                                    self.progress_timeout_s)):
+            self._progress_stalled = True
+            counter_add("degrade.wedged_total", 1.0)
+            record_event("replica_progress_stalled",
+                         replica_id=self.replica_id, progress=prog,
+                         frozen_s=now - self._progress_t)
+
+    @property
+    def progress_stalled(self) -> bool:
+        """True while the replica is busy with a frozen engine-iteration
+        counter past ``progress_timeout_s`` — the controller treats it
+        like a wedge self-report (drain, reason="wedged")."""
+        return self._progress_stalled
 
     @property
     def missed_heartbeats(self) -> int:
@@ -680,6 +745,8 @@ class ReplicaServer:
                     "tokens": [int(t) for t in payload.tokens],
                     "ttft_s": payload.ttft_s,
                     "latency_s": payload.latency_s,
+                    "decode_s": getattr(payload, "decode_s",
+                                        payload.latency_s),
                     "request_id": payload.request_id})
             elif kind == "shed":
                 send_frame(conn, {"kind": "shed",
@@ -714,6 +781,8 @@ class ReplicaServer:
                     "tokens": [int(t) for t in payload.tokens],
                     "ttft_s": payload.ttft_s,
                     "latency_s": payload.latency_s,
+                    "decode_s": getattr(payload, "decode_s",
+                                        payload.latency_s),
                     "request_id": payload.request_id})
             elif kind == "shed":
                 send_frame(conn, {"kind": "shed", "candidate": idx,

@@ -161,6 +161,13 @@ class Replica:
         self._thread: Optional[threading.Thread] = None
         self.failed: Optional[BaseException] = None
         self.migrated = False
+        # graftward wedged-engine self-report (degrade/wedge.py): latched
+        # by the in-process WedgeWatchdog when the decode loop stops
+        # committing iterations while busy. Makes ``healthy`` False and
+        # rides the health verb as {"wedged": true, "reason": "wedged"} —
+        # the fleet controller's no-operator drain trigger.
+        self.wedged = False
+        self.wedge_detail: Optional[str] = None
         self._fail_after_rows: Optional[int] = None
 
     # -- lifecycle ---------------------------------------------------------
@@ -212,7 +219,36 @@ class Replica:
     @property
     def healthy(self) -> bool:
         return (self._thread is not None and self._thread.is_alive()
-                and self.failed is None and not self.migrated)
+                and self.failed is None and not self.migrated
+                and not self.wedged)
+
+    def mark_wedged(self, detail: str = "") -> None:
+        """Latch the graftward wedge self-report: the router stops
+        dispatching here (``healthy`` → False), the health verb answers
+        ``{"healthy": false, "wedged": true, "reason": "wedged"}``, and
+        the fleet controller's next tick migrate-drains the in-flight
+        streams (same-seed resubmission keeps the splice bitwise) and
+        replaces the process — no operator ``request_drain``. Latched, not
+        self-clearing: a loop that wedged once is forfeit; the REPLACEMENT
+        process is the recovery."""
+        self.wedged = True
+        self.wedge_detail = detail
+        counter_add("degrade.wedged_total", 1.0)
+        record_event("replica_wedged", replica_id=self.replica_id,
+                     detail=detail)
+        dump_recorder("replica_wedged",
+                      extra={"replica_id": self.replica_id,
+                             "detail": detail})
+
+    @property
+    def progress(self) -> Optional[int]:
+        """The engine's monotonic iteration counter (graftward): rides the
+        health verb so the fleet transport can run the outside-in
+        fresh-heartbeat-but-frozen-progress check, and feeds the
+        in-process WedgeWatchdog probe. None for engines without stats
+        (test fakes)."""
+        stats = getattr(self.engine, "stats", None)
+        return stats.progress if stats is not None else None
 
     @property
     def draining(self) -> bool:
@@ -389,6 +425,13 @@ class Replica:
         return {"replica_id": self.replica_id, "healthy": self.healthy,
                 "draining": self.draining, "queue_depth": self.queue_depth,
                 "inflight": self.inflight, "aot_loaded": self.aot_loaded,
+                # graftward: the engine-iteration progress counter + the
+                # wedge self-report — a live process with a stuck decode
+                # loop answers health fine, so liveness must read PROGRESS
+                "progress": self.progress,
+                "wedged": self.wedged,
+                **({"reason": "wedged", "wedge_detail": self.wedge_detail}
+                   if self.wedged else {}),
                 "shed_total": self.queue.shed_total,
                 # engine shape facts a REMOTE consumer (gateway over
                 # RemoteReplica, fleet controller) can't read off .engine

@@ -102,6 +102,12 @@ class RoutedStream:
                         "tokens": [int(t) for t in payload.tokens],
                         "ttft_s": payload.ttft_s,
                         "latency_s": payload.latency_s,
+                        # slot-time consumed (admission→done): the
+                        # gateway's estimator feed, topology-uniform —
+                        # local CompletedRequest and the wire's
+                        # RemoteCompletion both carry it
+                        "decode_s": getattr(payload, "decode_s",
+                                            payload.latency_s),
                         "replica": self._replica.replica_id,
                         "failovers": self.failovers})
                     return
@@ -206,6 +212,13 @@ class RoutedGroup:
                                            for cr in crs],
                             "ttft_s": min(cr.ttft_s for cr in crs),
                             "latency_s": max(cr.latency_s for cr in crs),
+                            # slowest candidate's slot time: one
+                            # per-request service-rate sample per group
+                            # for the estimator (candidates decode
+                            # concurrently, so summing would overcount)
+                            "decode_s": max(
+                                getattr(cr, "decode_s", cr.latency_s)
+                                for cr in crs),
                             "replica": self._replica.replica_id,
                             "failovers": self.failovers})
                         return

@@ -335,12 +335,26 @@ def _make_handler(gw: Gateway):
                             deadline_s) -> None:
             """One burn-rate observation per finished request: a
             completion that beat its deadline is good; a shed, failover
-            exhaustion or deadline overrun is budget burned."""
+            exhaustion or deadline overrun is budget burned. Completions
+            ALSO feed the admission estimator HERE, at the door — the one
+            point every topology's completions pass through, so a
+            fully-remote fleet (graftfleet) warms the throughput estimate
+            exactly like in-process replicas do (the `done` payload
+            carries tokens + the replica-measured slot time)."""
             if kind == "done":
                 late = (deadline_s is not None
                         and payload.get("latency_s", 0.0) > deadline_s)
                 gw.slo_sentry.record(not late,
                                      "deadline_miss" if late else "")
+                toks = payload.get("candidates") or payload.get("tokens")
+                dec = payload.get("decode_s")
+                if toks and dec:
+                    # groups: one per-request rate sample at the
+                    # per-candidate token count (candidates decode
+                    # concurrently — parallelism is the estimator's knob)
+                    n = (len(toks[0]) if payload.get("candidates")
+                         else len(toks))
+                    gw.admission.slo.observe(n, float(dec))
             else:
                 gw.slo_sentry.record(False, payload.get("reason", "error"))
 

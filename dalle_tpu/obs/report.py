@@ -197,6 +197,39 @@ _FLEET_ACTION_RE = re.compile(r'^fleet\.actions_total\{action="([^"]+)"\}$')
 
 
 _SLO_BURN_RE = re.compile(r'^slo\.burn_rate\{window="([^"]+)"\}$')
+_DEGRADE_ACTION_RE = re.compile(
+    r'^degrade\.actions_total\{reason="([^"]+)"\}$')
+_DEGRADE_PAGE_RE = re.compile(
+    r'^degrade\.pages_total\{reason="([^"]+)"\}$')
+
+
+def degrade_accounting(metrics: List[dict]) -> Optional[dict]:
+    """graftward verdict inputs from the degradation-response counters
+    both planes emit (``parallel/elastic.py`` straggler/health-page
+    drains, ``fleet/controller.py`` wedge/health drains,
+    ``degrade.wedged_total`` self-reports). ``None`` when no record
+    carries a degrade key — runs without the response layer keep their
+    report unchanged. The verdict names what the ladder DID: ``responded``
+    (at least one drain/reshape, with its reasons), ``paged`` (detections
+    that never escalated), else ``quiet``."""
+    rows = [r for r in metrics if any(k.startswith("degrade.") for k in r)]
+    if not rows:
+        return None
+    last = rows[-1]
+    actions, pages = {}, {}
+    for key, val in last.items():
+        m = _DEGRADE_ACTION_RE.match(key)
+        if m:
+            actions[m.group(1)] = int(val)
+            continue
+        m = _DEGRADE_PAGE_RE.match(key)
+        if m:
+            pages[m.group(1)] = int(val)
+    wedged = int(last.get("degrade.wedged_total", 0))
+    verdict = ("responded" if actions
+               else "paged" if pages or wedged else "quiet")
+    return {"actions": actions, "pages": pages, "wedged": wedged,
+            "verdict": verdict}
 
 
 def slo_accounting(metrics: List[dict]) -> Optional[dict]:
@@ -516,6 +549,23 @@ def format_report(rows: List[dict], *, topk: int = 10) -> str:
                 parts.append(f"actions {fl['actions']}")
             lines.append("== fleet (graftfleet): " + ", ".join(parts)
                          + f" → FLEET: {fl['verdict']}")
+        dg = degrade_accounting(metrics)
+        if dg is not None:
+            parts = []
+            if dg["pages"]:
+                parts.append(f"pages {dg['pages']}")
+            if dg["actions"]:
+                parts.append(f"actions {dg['actions']}")
+            if dg["wedged"]:
+                parts.append(f"wedge self-reports {dg['wedged']}")
+            verdict = ("DEGRADE: responded "
+                       f"({', '.join(sorted(dg['actions']))})"
+                       if dg["verdict"] == "responded"
+                       else "DEGRADE: paged (no action)"
+                       if dg["verdict"] == "paged" else "DEGRADE: quiet")
+            lines.append("== degradation response (graftward): "
+                         + (", ".join(parts) if parts else "no events")
+                         + f" → {verdict}")
         slo = slo_accounting(metrics)
         if slo is not None:
             wtxt = " ".join(f"{w['window']}={w['burn']:.3g}x"

@@ -62,6 +62,8 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from ..chaos import io_hook
+from ..degrade import DegradeMonitor
+from ..degrade.detector import frozen_progress
 from ..obs import counter_add, record_event
 from ..utils.retry import retry
 
@@ -162,16 +164,38 @@ class Heartbeat:
         self._last = 0.0
         self._step: Optional[int] = None
         self._step_time: Optional[float] = None
+        # graftward straggler signal: the worker's self-measured device/
+        # collective wait for its last step (grafttrace t_dispatch+t_sync).
+        # In lockstep SPMD every worker's step WALL time is the same; the
+        # one that never waits is the straggler (degrade/detector.py).
+        self._blocked_s: Optional[float] = None
+        # graftward health page: a sentry breach on THIS worker, carried
+        # in every subsequent beat so the agent's DegradeMonitor sees a
+        # fleet-visible page instead of a process-local log line
+        self._page: Optional[str] = None
         # the beater thread and the fit thread's on_step both write; the
         # shared tmp path must never be truncated/renamed mid-write
         self._write_lock = threading.Lock()
 
+    def page(self, reason: str, epoch: Optional[int] = None) -> None:
+        """Latch a health page into the beacon and publish it NOW (the
+        agent must not wait out the write throttle to learn a worker is
+        sick). Sticky for the life of this process — the drain decision is
+        the agent's; a page that cleared locally still warranted it."""
+        self._page = str(reason)
+        self._write(epoch, time.time())
+        self._last = time.time()
+
     def beat(self, step: Optional[int] = None,
-             epoch: Optional[int] = None, *, force: bool = False) -> bool:
+             epoch: Optional[int] = None, *,
+             blocked_s: Optional[float] = None,
+             force: bool = False) -> bool:
         now = time.time()
         if step is not None and step != self._step:
             self._step = step
             self._step_time = now
+            if blocked_s is not None:
+                self._blocked_s = float(blocked_s)
         if not force and now - self._last < self.interval_s:
             return False
         self._write(epoch, now)
@@ -186,7 +210,9 @@ class Heartbeat:
             with open(tmp, "w", encoding="utf-8") as fh:
                 json.dump({"worker_id": self.worker_id, "pid": os.getpid(),
                            "time": now, "step": self._step,
-                           "step_time": self._step_time, "epoch": epoch}, fh)
+                           "step_time": self._step_time,
+                           "blocked_s": self._blocked_s, "epoch": epoch,
+                           "page": self._page}, fh)
             os.replace(tmp, self.path)
 
 
@@ -248,9 +274,11 @@ def hung_workers(run_dir: str, members: List[int], timeout_s: float,
         if now - float(doc.get("time", 0.0)) > timeout_s:
             out.append(wid)
             continue
-        step, step_time = doc.get("step"), doc.get("step_time")
-        if (step is not None and step_time is not None
-                and now - float(step_time) > timeout_s):
+        # fresh file, frozen step: the shared graftward core — the same
+        # predicate the fleet transport runs against a replica's engine
+        # iteration counter (degrade/detector.py)
+        if frozen_progress(doc.get("step"), doc.get("step_time"), now,
+                           timeout_s):
             out.append(wid)
     return out
 
@@ -311,15 +339,39 @@ class ElasticWorker:
     def stop(self) -> None:
         self._stop.set()
 
-    def on_step(self, step: int) -> None:
+    def on_step(self, step: int,
+                blocked_s: Optional[float] = None) -> None:
         """The ``BaseTrainer.fit(on_step=...)`` hook: records progress (the
-        beater publishes it even while a later step wedges)."""
+        beater publishes it even while a later step wedges).
+        ``blocked_s`` — the worker's device/collective wait for its last
+        step — feeds the agent's straggler detector; callers with a
+        grafttrace breakdown forward ``t_dispatch_s + t_sync_s`` (one step
+        stale is fine, the detector smooths)."""
         try:
-            self.heartbeat.beat(step=step, epoch=self.epoch.epoch)
+            self.heartbeat.beat(step=step, epoch=self.epoch.epoch,
+                                blocked_s=blocked_s)
         except Exception as exc:  # noqa: BLE001 - a heartbeat outage past
             # the retry budget must not kill the training loop it reports
             # on; a quiet/stale file IS the failure signal
             self.log(f"[elastic] heartbeat beat failed: {exc!r}")
+
+    def page(self, reason: str) -> None:
+        """Publish a health page (graftward): latch ``reason`` into the
+        heartbeat file so the agent's DegradeMonitor treats this worker
+        like a straggler verdict — clean save, reshape around it,
+        quarantine-respawn. Wire a graftpulse sentry to this via
+        ``degrade.install_breach_pager(worker, sentry)``. Best-effort:
+        a page lost to a heartbeat outage is re-published by every later
+        beat (the marker is sticky)."""
+        counter_add("degrade.pages_total", 1.0,
+                    labels={"reason": "health_page"})
+        record_event("worker_paged", worker_id=self.worker_id,
+                     epoch=self.epoch.epoch, reason=reason)
+        try:
+            self.heartbeat.page(reason, epoch=self.epoch.epoch)
+        except Exception as exc:  # noqa: BLE001 - same contract as
+            # on_step: a beacon outage must not kill the loop it reports on
+            self.log(f"[elastic] health page publish failed: {exc!r}")
 
     def _beat_loop(self) -> None:
         while not self._stop.wait(self.heartbeat.interval_s):
@@ -418,6 +470,7 @@ class ElasticAgent:
                  members: List[int], *, policy: str = "respawn",
                  hb_timeout_s: float = 0.0, poll_s: float = 0.2,
                  term_grace_s: float = 10.0, max_reconfigures: int = 4,
+                 degrade: Optional[DegradeMonitor] = None,
                  log=print):
         assert policy in ("respawn", "shrink"), policy
         self.run_dir = run_dir
@@ -425,6 +478,13 @@ class ElasticAgent:
         self.spawn = spawn
         self.all_members = list(members)
         self.policy = policy
+        # graftward (docs/RESILIENCE.md "Degradation ladder"): when set,
+        # every poll also feeds the fleet's heartbeats to the degradation
+        # monitor — straggler verdicts page then drain (reshape WITHOUT
+        # the slow worker), health-page markers drain straight away
+        # (quarantine-respawn: fresh process, same slot). None = PR 10
+        # behavior, dead/hung detection only.
+        self.degrade = degrade
         self.hb_timeout_s = float(hb_timeout_s)
         self.poll_s = float(poll_s)
         self.term_grace_s = float(term_grace_s)
@@ -463,6 +523,10 @@ class ElasticAgent:
                 pass
         self._event("epoch_start", members=members,
                     port=self.epoch.port, policy=self.policy)
+        if self.degrade is not None:
+            # verdict state must not outlive the membership it was
+            # computed over (EWMAs, page markers, escalation rungs)
+            self.degrade.reset()
         # completion is PER EPOCH: a reconfiguration respawns every member
         # (done ones included) so the gang resumes in lockstep from one
         # shared durable step — a "done" worker sitting out would leave the
@@ -494,7 +558,14 @@ class ElasticAgent:
                 p.kill()
                 p.wait()
 
-    def _reconfigure(self, *, lost: List[int], reason: str) -> None:
+    def _reconfigure(self, *, lost: List[int], reason: str,
+                     members: Optional[List[int]] = None) -> None:
+        """Tear the epoch down and start the next one. ``members`` pins the
+        new membership explicitly (the graftward drain rungs choose it —
+        a straggler loses its slot regardless of policy, a health-paged
+        worker keeps it for a fresh quarantine-respawn); None falls back
+        to the death policy (respawn keeps every slot, shrink drops the
+        lost)."""
         self.reconfigures += 1
         counter_add("elastic.reconfigures_total", 1.0)
         self._event("reconfigure", lost=lost, reason=reason,
@@ -508,13 +579,36 @@ class ElasticAgent:
                 f"elastic agent: {self.reconfigures} reconfigurations "
                 f"(max {self.max_reconfigures}) — crash loop, giving up")
         self._kill_epoch()
-        if self.policy == "shrink":
-            members = [m for m in self.epoch.members if m not in lost]
-            if not members:
-                raise RuntimeError("elastic agent: no survivors to shrink to")
+        if members is None:
+            if self.policy == "shrink":
+                members = [m for m in self.epoch.members if m not in lost]
+            else:
+                members = list(self.epoch.members)
+        if not members:
+            raise RuntimeError("elastic agent: no survivors to shrink to")
+        self.start_epoch(members)
+
+    def _degrade_drain(self, action) -> None:
+        """One ladder drain (graftward): SIGTERM the whole gang so every
+        member — the sick one included — takes its graceful-preemption
+        save at the next checkpoint boundary (``_kill_epoch``'s TERM →
+        grace → KILL escalation is exactly the proactive-drain contract),
+        then reshape: a STRAGGLER is excluded from the next epoch (a slow
+        host is hardware-suspect — the PR 10 shrink path, bitwise-asserted
+        by chaos_smoke's ``straggler_reshape``); a HEALTH-PAGED worker
+        keeps its slot and is quarantine-respawned as a fresh process
+        (sick software state, healthy host), with ``max_reconfigures``
+        bounding the crash loop if the respawn pages again."""
+        wid, reason = action.worker_id, action.reason
+        counter_add("degrade.actions_total", 1.0, labels={"reason": reason})
+        self._event("degrade_drain", worker=wid, reason=reason,
+                    detail=action.detail)
+        if reason == "straggler":
+            members = [m for m in self.epoch.members if m != wid]
         else:
             members = list(self.epoch.members)
-        self.start_epoch(members)
+        self._reconfigure(lost=[wid], reason=f"degrade_{reason}",
+                          members=members)
 
     # -- the supervision loop ----------------------------------------------
     def run(self, deadline_s: float = 600.0) -> List[dict]:
@@ -573,7 +667,30 @@ class ElasticAgent:
                         self.procs[wid].wait()
                     self._reconfigure(lost=hung, reason="heartbeat_stale")
                     continue
-            # 3. done?
+            # 3. degradation ladder (graftward): stragglers and health
+            # pages among RUNNING members — sick-but-alive is this rung's
+            # whole domain; dead/hung workers were handled above
+            if self.degrade is not None:
+                running = [w for w, p in self.procs.items()
+                           if p.poll() is None and w not in self.done]
+                actions = self.degrade.observe(
+                    read_heartbeats(self.run_dir), running)
+                drained = False
+                for act in actions:
+                    if act.kind == "page":
+                        counter_add("degrade.pages_total", 1.0,
+                                    labels={"reason": act.reason})
+                        self._event("worker_paged", worker=act.worker_id,
+                                    reason=act.reason, detail=act.detail)
+                    elif not drained:
+                        # one drain per poll: the reshape replaces the
+                        # whole epoch, so a second same-poll verdict is
+                        # stale by construction
+                        drained = True
+                        self._degrade_drain(act)
+                if drained:
+                    continue
+            # 4. done?
             if all(w in self.done for w in self.epoch.members):
                 self._event("pod_done", members=self.epoch.members)
                 return self.events

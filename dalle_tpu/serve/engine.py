@@ -76,6 +76,17 @@ class EngineStats:
         self.occupancy_n += 1
 
     @property
+    def progress(self) -> int:
+        """Monotonic engine-iteration counter (graftward): every device
+        dispatch the host loop completes — decode steps, refill windows,
+        prefill chunks — advances it. A BUSY engine whose progress freezes
+        is wedged; an idle one is just idle. Read cross-thread by the
+        in-process :class:`~dalle_tpu.degrade.WedgeWatchdog`, the health
+        verb, and (remotely) the fleet transport's frozen-progress
+        check."""
+        return self.steps + self.refills + self.prefill_chunks
+
+    @property
     def occupancy_while_queued(self) -> float:
         if not self.occupancy_n:
             return 1.0

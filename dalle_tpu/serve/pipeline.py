@@ -215,7 +215,15 @@ class ImagePipeline:
     def _work(self, stage: str) -> None:
         q = self._qs[stage]
         while True:
-            item = q.get()
+            try:
+                # bounded wait (graftlint: unbounded-blocking-call): the
+                # drain sentinel is the normal exit, but a worker must
+                # re-check the world on a cadence rather than park forever
+                # on a queue nothing will ever feed again (a wedged
+                # upstream stage, an abandoned pipeline)
+                item = q.get(timeout=1.0)
+            except _queue.Empty:
+                continue
             gauge_set("pipeline.queue_depth", float(q.qsize()),
                       labels={"stage": stage})
             if item is None:                    # drain sentinel: pass on

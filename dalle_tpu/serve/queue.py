@@ -90,6 +90,15 @@ class CompletedRequest:
     def latency_s(self) -> float:
         return self.completed_at - self.submitted_at
 
+    @property
+    def decode_s(self) -> float:
+        """Admission → completion: the slot-time the request actually
+        consumed, queue wait excluded — the SloEstimator's observation
+        unit (tokens / decode_s = per-request service rate). Shipped on
+        the wire ``done`` frame so REMOTE completions feed the gateway's
+        admission estimator exactly like local ones."""
+        return self.completed_at - self.admitted_at
+
 
 class RequestQueue:
     """FIFO with close semantics. All methods are thread-safe.

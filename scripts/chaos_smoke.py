@@ -56,7 +56,10 @@ sys.path.insert(0, ROOT)
 
 from dalle_tpu.chaos import (EPOCH_ENV, PLAN_ENV, RANK_ENV, Fault,  # noqa: E402
                              FaultPlan)
+from dalle_tpu.degrade import DegradeMonitor, StragglerDetector  # noqa: E402
+from dalle_tpu.obs import configure as obs_configure  # noqa: E402
 from dalle_tpu.obs import configure_recorder, dump_recorder  # noqa: E402
+from dalle_tpu.obs import metrics_snapshot  # noqa: E402
 from dalle_tpu.parallel.elastic import (DIR_ENV, WORKER_ENV,  # noqa: E402
                                         ElasticAgent, python_worker_env)
 
@@ -134,7 +137,7 @@ def run_pod(name: str, outdir: str, cache: str, *, nproc: int, target: int,
             save_every: int, plan: FaultPlan = None, policy: str = "respawn",
             hb_timeout_s: float = 0.0, peer_timeout_s: float = 0.0,
             term_grace_s: float = 5.0, deadline_s: float = 420.0,
-            extra_args: tuple = ()):
+            degrade: DegradeMonitor = None, extra_args: tuple = ()):
     """One pod run under the elastic agent; returns (agent, digests)."""
     run_dir = os.path.join(outdir, name)
     shutil.rmtree(run_dir, ignore_errors=True)
@@ -142,7 +145,7 @@ def run_pod(name: str, outdir: str, cache: str, *, nproc: int, target: int,
     agent = ElasticAgent(
         run_dir, make_spawn(run_dir, cache, target, save_every, plan,
                             peer_timeout_s, extra_args),
-        members=list(range(nproc)), policy=policy,
+        members=list(range(nproc)), policy=policy, degrade=degrade,
         hb_timeout_s=hb_timeout_s, term_grace_s=term_grace_s, poll_s=0.2)
     t0 = time.time()
     try:
@@ -191,6 +194,9 @@ def main(argv=None):
     # The smoke therefore runs cache-off; chaos_worker keeps the
     # --compile_cache flag for the hardware path.
     cache = ""
+    # the agent's degrade.* counters live in THIS process (the smoke IS
+    # the agent host); without a configured tracer they drop silently
+    obs_configure()
     configure_recorder(os.path.join(outdir, "flight"),
                        min_dump_interval_s=0.0)
     target, save_every, kill_at = (args.target_steps, args.save_every,
@@ -404,6 +410,85 @@ def main(argv=None):
             print(tail_logs(os.path.join(outdir, "shrink")))
         summaries.append(verdict(outdir, "shrink", agent, digests, checks))
 
+    # -- straggler_reshape: the graftward ladder — page → drain → reshape ---
+    # (docs/RESILIENCE.md "Degradation ladder"). A chaos slow fault makes
+    # worker 1 a HOST-SIDE straggler: every fleet step stretches to its
+    # pace (lockstep collectives), so step rate and arrival phase are
+    # identical across the pod — the distinguishing signal is the WAIT
+    # INVERSION the heartbeats now carry (blocked_s: the peer waits ~the
+    # full injected delay at the collective, the victim waits ~nothing).
+    # The agent pages, escalates to a drain (SIGTERM gang → graceful
+    # boundary saves), and reshapes WITHOUT the straggler; the survivor's
+    # post-recovery state must be bitwise a clean single-proc run pinned
+    # to the same restore step (the shrink oracle — topology held fixed).
+    if enabled("straggler_reshape"):
+        target_sr = max(target, 20)
+        plan = FaultPlan([Fault(kind="slow", step=2, rank=1,
+                                duration_s=0.8, span_steps=400)])
+        monitor = DegradeMonitor(
+            StragglerDetector(factor=0.4, sustain=2, warmup_steps=2,
+                              min_deficit_s=0.2),
+            straggler_escalate=1)
+        agent, digests = run_pod("straggler_reshape", outdir, cache,
+                                 nproc=2, target=target_sr,
+                                 save_every=save_every, plan=plan,
+                                 degrade=monitor)
+        w0 = digests.get("w0", {})
+        restored_from = w0.get("restored_from")
+        ref_d = None
+        if restored_from is not None:
+            ref_dir = os.path.join(outdir, "straggler_ref")
+            shutil.rmtree(ref_dir, ignore_errors=True)
+            os.makedirs(ref_dir)
+            shutil.copytree(os.path.join(outdir, "straggler_reshape",
+                                         "ckpt"),
+                            os.path.join(ref_dir, "ckpt"))
+            log = open(os.path.join(ref_dir, "ref.log"), "w")
+            rc = subprocess.run(
+                [sys.executable, WORKER, "--run_dir", ref_dir,
+                 "--target_steps", str(target_sr), "--save_every", "0",
+                 "--restore_step", str(restored_from),
+                 "--reference", "--compile_cache", cache],
+                env=child_env(), stdout=log, stderr=subprocess.STDOUT,
+                cwd=ROOT).returncode
+            refs = read_digests(ref_dir)
+            ref_d = (next(iter(refs.values()))["digest"]
+                     if rc == 0 and refs else None)
+        checks = {}
+        checks["paged"] = check(
+            any(e["kind"] == "worker_paged" and e.get("worker") == 1
+                and e.get("reason") == "straggler" for e in agent.events),
+            "straggler_reshape: the ladder PAGED the slow worker first "
+            "(log/page rung, no membership change)")
+        checks["drained"] = check(
+            any(e["kind"] == "degrade_drain" and e.get("worker") == 1
+                and e.get("reason") == "straggler" for e in agent.events),
+            "straggler_reshape: sustained verdict escalated to a drain")
+        checks["reshaped"] = check(
+            agent.epoch is not None and agent.epoch.members == [0]
+            and w0.get("world_size") == 1,
+            "straggler_reshape: pod reshaped WITHOUT the straggler "
+            f"(members {agent.epoch.members if agent.epoch else None})")
+        checks["resumed_durable"] = check(
+            restored_from is not None and restored_from > 0,
+            f"straggler_reshape: survivor resumed a durable graceful save "
+            f"(step {restored_from}), not from scratch")
+        checks["bitwise_vs_pinned_ref"] = check(
+            ref_d is not None and w0.get("digest") == ref_d,
+            "straggler_reshape: post-recovery state BITWISE-identical to "
+            "a clean single-process run pinned to the same restore step")
+        snap = metrics_snapshot()
+        checks["degrade_counters"] = check(
+            snap.get('degrade.actions_total{reason="straggler"}', 0) >= 1
+            and snap.get('degrade.pages_total{reason="straggler"}', 0) >= 1,
+            "straggler_reshape: degrade.{pages,actions}_total{reason="
+            "straggler} counters recorded the ladder")
+        if not all(checks.values()):
+            print(tail_logs(os.path.join(outdir, "straggler_reshape")))
+        summaries.append(verdict(outdir, "straggler_reshape", agent,
+                                 digests, checks))
+        dump_recorder("straggler_reshape")
+
     # -- hang detection (heavy: dominated by liveness timeouts) -------------
     if args.heavy and enabled("hang_detect"):
         plan = FaultPlan([Fault(kind="hang", step=kill_at, rank=1,
@@ -421,6 +506,12 @@ def main(argv=None):
                                  checks))
 
     # -- summary -------------------------------------------------------------
+    # agent-side registry snapshot (degrade.*/elastic.* counters) as a
+    # metrics artifact: `obs_report <outdir>` then renders the DEGRADE
+    # verdict over the same files CI uploads
+    with open(os.path.join(outdir, "metrics.jsonl"), "w",
+              encoding="utf-8") as fh:
+        fh.write(json.dumps({"step": 0, **metrics_snapshot()}) + "\n")
     summary = {"ok": not FAILURES, "failures": FAILURES,
                "elapsed_s": round(time.time() - t_all, 1),
                "scenarios": {s["scenario"]: s["ok"] for s in summaries}}

@@ -64,6 +64,11 @@ def main():
     ap.add_argument("--reference", action="store_true",
                     help="reference leg: no elastic runtime, no heartbeats")
     ap.add_argument("--peer_timeout_s", type=float, default=0.0)
+    ap.add_argument("--health_page", action="store_true",
+                    help="arm the graftpulse health taps + sentry and wire "
+                    "breaches into the heartbeat page marker "
+                    "(degrade.install_breach_pager) — the agent's "
+                    "DegradeMonitor then drains this worker on a breach")
     ap.add_argument("--sync_ckpt", action="store_true",
                     help="synchronous checkpointing: every save is durable "
                     "at its boundary (scenarios that script against the "
@@ -81,7 +86,7 @@ def main():
     chaos.install_from_env()
 
     from dalle_tpu.config import (AnnealConfig, DVAEConfig, MeshConfig,
-                                  TrainConfig)
+                                  ObsConfig, TrainConfig)
     from dalle_tpu.parallel import backend as B
     from dalle_tpu.parallel import elastic
     from dalle_tpu.train.trainer_vae import VAETrainer
@@ -126,9 +131,19 @@ def main():
         preflight_checkpoint=False,
         async_checkpointing=not args.sync_ckpt,
         device_prefetch=0,                 # resume math owns the iterator
+        obs=ObsConfig(health=True) if args.health_page else ObsConfig(),
         mesh=MeshConfig())
     trainer = VAETrainer(model_cfg, tc, anneal_cfg=AnnealConfig(),
                          backend=backend)
+    if worker is not None and args.health_page:
+        # graftward drain-on-health-page: build the sentry PRE-fit (the
+        # BreachActions.attach precedent — fit's is-None check then reuses
+        # it) and chain its on_breach into the heartbeat page marker
+        from dalle_tpu.degrade import install_breach_pager
+        from dalle_tpu.obs.anomaly import HealthSentry
+        if trainer.health_sentry is None:
+            trainer.health_sentry = HealthSentry.from_obs_config(tc.obs)
+        install_breach_pager(worker, trainer.health_sentry)
 
     restored_from = None
     if args.restore_step is not None:
@@ -162,8 +177,29 @@ def main():
 
     batches = (make_batch(args.seed, s, args.batch, model_cfg.image_size)
                for s in range(trainer._host_step, args.target_steps))
+    on_step = writer = None
+    if worker is not None:
+        # graftward straggler signal: forward the grafttrace step
+        # breakdown's device/collective wait (t_dispatch + t_sync) into
+        # the heartbeat — in lockstep SPMD the worker that never waits IS
+        # the straggler (degrade/detector.py). The writer sees step s's
+        # record after on_step(s) fired, so beats carry the previous
+        # step's wait; one step stale, which the detector's EWMA absorbs.
+        last_m: dict = {}
+
+        class _HBWriter:
+            def log(self, step, m):
+                last_m.clear()
+                last_m.update(m)
+        writer = _HBWriter()
+
+        def on_step(step):
+            blocked = (last_m.get("t_dispatch_s", 0.0)
+                       + last_m.get("t_sync_s", 0.0)
+                       if "t_dispatch_s" in last_m else None)
+            worker.on_step(step, blocked_s=blocked)
     trainer.fit(batches, steps=args.target_steps,
-                on_step=worker.on_step if worker is not None else None)
+                metrics_writer=writer, on_step=on_step)
     if worker is not None:
         worker.stop()
 

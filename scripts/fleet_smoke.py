@@ -362,6 +362,100 @@ def main(argv=None):
         check(st == 200 and body["tokens"] == ref_for(2, 7000),
               "healed fleet serves token-exact")
 
+        # -- phase D (wedge_drain): wedged-engine self-detection ----------
+        # a chaos `wedge` fault hangs the victim INSIDE its engine loop
+        # mid-stream: the process stays alive, answers health dials, keeps
+        # heartbeating — the PR 12 gap where only an operator request_drain
+        # could save the stream. Now the in-process WedgeWatchdog
+        # (--wedge_timeout_s; armed safely here: AOT+warmup replicas pay
+        # no compiles) sees busy-with-frozen-iteration-counter and
+        # self-reports unhealthy{reason=wedged} through the health verb;
+        # the controller migrate-drains it with NO operator page, the
+        # router resubmits same-seed, and the splice is bitwise.
+        wedge_plan = FaultPlan([Fault(kind="wedge", step=9,
+                                      duration_s=600.0)])
+        wm = FleetManager(argv_base + ["--wedge_timeout_s", "1.5"],
+                          env={"JAX_PLATFORMS": "cpu"},
+                          log_dir=os.path.join(args.outdir,
+                                               "replica_logs"))
+        try:
+            # explicit id: the second manager's replica-N sequence would
+            # collide with the main fleet's ids and clobber the
+            # controller's supervision table
+            wv = wm.spawn(replica_id="wedge-0",
+                          extra_env=wedge_plan.env())
+            ctl.attach(wv)
+            # steer onto the victim: every OTHER routed replica (the
+            # originals plus phase C's replacement) steps out briefly —
+            # re-added in a finally so a failed submit can't strand the
+            # rest of the smoke on a one-replica router
+            others = [r for r in router.replicas
+                      if r.replica_id != wv.replica_id]
+            for r in others:
+                router.remove_replica(r)
+            try:
+                routed = router.submit(texts[2], 9000)
+            finally:
+                for r in others:
+                    router.add_replica(r)
+            check(routed.replica_id == wv.replica_id,
+                  "wedge-phase stream landed on the chaos victim")
+            wrows, wdone = [], [None]
+
+            def wconsume():
+                for kind, payload in routed.events(timeout=30.0):
+                    if kind == "row":
+                        wrows.append(payload)
+                    elif kind == "done":
+                        wdone[0] = payload
+            wt = threading.Thread(target=wconsume)
+            wt.start()
+            wedged_seen = False
+            deadline = time.time() + 30.0
+            while time.time() < deadline:
+                h = wv.remote.health()
+                if h.get("wedged") and not h.get("healthy", True):
+                    wedged_seen = True
+                    break
+                time.sleep(0.25)
+            check(wedged_seen,
+                  "wedged replica SELF-reported unhealthy{reason=wedged} "
+                  "through the health verb (live process, stuck engine)")
+            wedge_drains = []
+            deadline = time.time() + 20.0
+            while time.time() < deadline and not wedge_drains:
+                wedge_drains = [d for d in ctl.tick()
+                                if d["action"] == "drain"
+                                and d["reason"] == "wedged"]
+                time.sleep(0.2)
+            check(bool(wedge_drains),
+                  "controller drained the wedged replica with NO operator "
+                  "request_drain")
+            wt.join(timeout=120.0)
+            check(wdone[0] is not None and wdone[0]["failovers"] == 1
+                  and wdone[0]["tokens"] == ref_for(2, 9000),
+                  "wedge drain: in-flight stream spliced bitwise-identical "
+                  "to the undisturbed reference")
+            check(sorted(p["row"] for p in wrows)
+                  == list(range(cfg.image_fmap_size)),
+                  "every grid row delivered exactly once across the wedge "
+                  "hand-off")
+            ctl.tick()                     # reap the drained victim
+            time.sleep(0.2)
+            ctl.tick()
+            check(not wv.alive,
+                  "wedged victim process was killed after grace")
+            snap = obs.metrics_snapshot()
+            check(snap.get('gateway.failover_total{reason="wedged"}',
+                           0) >= 1,
+                  "failover attributed as {reason=wedged}")
+            check(snap.get('degrade.actions_total{reason="wedged"}',
+                           0) >= 1,
+                  "degrade.actions_total{reason=wedged} recorded the "
+                  "response")
+        finally:
+            wm.shutdown()
+
         # -- cross-process AOT fingerprint refusal: a replica handed a
         # bundle built under a mismatched config must refuse LOUDLY in its
         # handshake and serve on the jit fallback (cold, correct)
@@ -430,6 +524,9 @@ def main(argv=None):
               "obs_report prints the FLEET verdict line")
         check("by reason" in rep.stdout and "conn_reset" in rep.stdout,
               "obs_report attributes failovers by reason")
+        check("DEGRADE:" in rep.stdout and "wedged" in rep.stdout,
+              "obs_report renders the DEGRADE verdict naming the wedged "
+              "response")
 
         summary = {
             "burst0": {"offered": n0, "completed": len(ok0),
@@ -442,6 +539,8 @@ def main(argv=None):
             "failover_reasons": {
                 k: v for k, v in snap.items()
                 if k.startswith("gateway.failover_total")},
+            "degrade": {k: v for k, v in snap.items()
+                        if k.startswith("degrade.")},
             "flight_bundles": sorted(os.path.basename(p) for p in glob.glob(
                 os.path.join(flight_dir, "postmortem_*"))),
             "spans_exported": n_spans,

@@ -184,13 +184,15 @@ def main(argv=None):
 
     replicas = []
     for i in range(args.replicas):
+        # estimator feeding moved to the gateway door (server.py
+        # _record_outcome): every topology's completions — these local
+        # threads AND graftfleet remote processes — warm the admission
+        # throughput estimate through one path, so no per-replica
+        # on_served wiring here (it would double-count local completions)
         rep = Replica(make_engine(), replica_id=f"replica-{i}",
                       maxsize=args.queue_maxsize,
                       policy=policy_cls() if policy_cls else None,
-                      aot_dir=args.aot_dir,
-                      on_served=lambda cr: admission.slo.observe(
-                          int(cr.tokens.shape[0]),
-                          cr.completed_at - cr.admitted_at))
+                      aot_dir=args.aot_dir)
         replicas.append(rep.start())
         print(f"{rep.replica_id}: serving (aot_loaded={rep.aot_loaded})")
 

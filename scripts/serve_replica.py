@@ -74,6 +74,16 @@ def build_parser():
                      help="graftpulse decode-quality gauges; exposed via "
                           "the health verb — the controller's drain-on-"
                           "degradation signal")
+    eng.add_argument("--wedge_timeout_s", type=float, default=0.0,
+                     help="graftward wedged-engine self-detection: a BUSY "
+                          "engine whose iteration counter freezes this "
+                          "long self-reports unhealthy{reason=wedged} "
+                          "through the health verb (the controller then "
+                          "drains + replaces with no operator page). Set "
+                          "above the longest legitimate single dispatch; "
+                          "0 disables — arm it on --aot_dir --warmup "
+                          "replicas, where no compile can freeze a busy "
+                          "engine (docs/SERVING.md)")
     aot = ap.add_argument_group("AOT cold start")
     aot.add_argument("--aot_dir", type=str, default=None,
                      help="serialized engine executables; fingerprint "
@@ -166,6 +176,18 @@ def main(argv=None):
         aot_dir=args.aot_dir).start()
     if args.warmup:
         warmup(replica, engine.text_seq_len)
+    watchdog = None
+    if args.wedge_timeout_s > 0:
+        # the engine-iteration liveness probe (dalle_tpu/degrade/wedge.py):
+        # progress = the loop's monotonic dispatch counter, busy = accepted
+        # work not yet completed. A trip latches Replica.mark_wedged —
+        # healthy goes False, the health verb carries reason="wedged", and
+        # the fleet controller's next tick migrate-drains this process.
+        from dalle_tpu.degrade import WedgeWatchdog
+        watchdog = WedgeWatchdog(
+            lambda: (replica.progress or 0, replica.inflight > 0),
+            args.wedge_timeout_s,
+            on_wedge=replica.mark_wedged).start()
     server = ReplicaServer(replica, host=args.host, port=args.port,
                            compile_counter=counter).start()
 
@@ -185,6 +207,8 @@ def main(argv=None):
 
     stop.wait()
     # graceful preemption: stop accepting, finish accepted work, exit 0
+    if watchdog is not None:
+        watchdog.stop()
     server.shutdown()
     replica.drain(timeout=60)
     obs.disable_recorder()

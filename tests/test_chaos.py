@@ -195,6 +195,22 @@ def test_step_faults_slow_and_kill(monkeypatch):
     assert counters()['chaos.faults_injected_total{kind="kill"}'] == 1
 
 
+def test_wedge_fault_blocks_engine_loop_once(monkeypatch):
+    """`wedge` = `hang` named for the serving plane (graftward): blocks
+    inside the engine's step hook for duration_s, fires once, and
+    roundtrips the env handoff like every other kind."""
+    sleeps = []
+    monkeypatch.setattr(chaos.faults.time, "sleep",
+                        lambda s: sleeps.append(s))
+    plan = FaultPlan([Fault(kind="wedge", step=9, duration_s=600.0)])
+    plan2 = FaultPlan.from_json(plan.env()[chaos.PLAN_ENV])
+    chaos.install(plan2)
+    for s in range(12):
+        chaos.step_hook(s)
+    assert sleeps == [600.0]             # one wedge, at step 9, latched
+    assert counters()['chaos.faults_injected_total{kind="wedge"}'] == 1
+
+
 def test_plan_sample_is_seed_deterministic():
     a = FaultPlan.sample(5, nproc=3, max_step=10, kinds=("kill", "fail_io"))
     b = FaultPlan.sample(5, nproc=3, max_step=10, kinds=("kill", "fail_io"))

@@ -1,0 +1,468 @@
+"""graftward degradation-response units (dalle_tpu/degrade/): the
+straggler detector's wait-inversion math and hysteresis, the response
+ladder's one-action-per-edge semantics, the wedge watchdog's arm gate and
+no-false-positive behavior, and the elastic heartbeat page plumbing.
+
+Everything here is pure host-side python over injected clocks/heartbeat
+dicts — the real two-plane integrations run in scripts/chaos_smoke.py
+(straggler_reshape) and scripts/fleet_smoke.py (wedge_drain).
+"""
+
+import json
+import os
+
+import pytest
+
+from dalle_tpu.degrade import (DegradeMonitor, StragglerDetector,
+                               WedgeWatchdog, frozen_progress,
+                               install_breach_pager)
+from dalle_tpu.parallel import elastic
+
+# ---------------------------------------------------------------------------
+# heartbeat-stream builders: lockstep fleet where every worker completes
+# step s at the same wall time (the coupled interval), but each carries its
+# own self-measured blocked_s (the wait-inversion signal)
+# ---------------------------------------------------------------------------
+
+
+def beats_at(step, t, blocked):
+    """blocked: {wid: blocked_s}; arrival identical across the fleet —
+    the lockstep reality the detector must see through."""
+    return {w: {"step": step, "step_time": t, "blocked_s": b}
+            for w, b in blocked.items()}
+
+
+def drive(det_or_mon, rounds, members=(0, 1)):
+    """Feed a list of (step, t, {wid: blocked}) rounds; returns all
+    emitted verdicts/actions."""
+    out = []
+    for step, t, blocked in rounds:
+        out.extend(det_or_mon.observe(beats_at(step, t, blocked),
+                                      list(members)))
+    return out
+
+
+def lockstep(n_steps, interval, victim_blocked, peer_blocked,
+             victim=1, peers=(0,), start_step=1):
+    rounds = []
+    t = 0.0
+    for i in range(n_steps):
+        t += interval
+        blocked = {w: peer_blocked for w in peers}
+        blocked[victim] = victim_blocked
+        rounds.append((start_step + i, t, blocked))
+    return rounds
+
+
+# ---------------------------------------------------------------------------
+# StragglerDetector
+# ---------------------------------------------------------------------------
+
+def test_detector_warmup_emits_nothing():
+    det = StragglerDetector(factor=0.4, sustain=1, warmup_steps=4)
+    # a blatant straggler, but only warmup_steps rounds: EWMAs have no
+    # baseline yet — no verdict may fire
+    verdicts = drive(det, lockstep(4, 1.0, victim_blocked=0.02,
+                                   peer_blocked=0.9))
+    assert verdicts == []
+
+
+def test_detector_flags_victim_not_peer_n2():
+    """The n=2 median-robustness case: the reference is the median of the
+    OTHER workers (= the peer), so the victim carries the full inversion
+    and the peer's deficit is negative — a whole-fleet median would split
+    it and flag nobody."""
+    det = StragglerDetector(factor=0.4, sustain=2, warmup_steps=2)
+    verdicts = drive(det, lockstep(8, 1.0, victim_blocked=0.03,
+                                   peer_blocked=0.85))
+    assert [v.worker_id for v in verdicts] == [1]
+    v = verdicts[0]
+    assert v.deficit_s == pytest.approx(0.82, abs=0.05)
+    assert v.ratio > 0.4
+    assert det.is_flagged(1) and not det.is_flagged(0)
+    assert det.deficit_of(0) < 0            # the peer WAITS — never flagged
+
+
+def test_detector_healthy_fleet_quiet():
+    det = StragglerDetector(factor=0.4, sustain=2, warmup_steps=2)
+    rounds = []
+    t = 0.0
+    for s in range(1, 30):
+        t += 0.1
+        # ±2ms jitter in who waits a hair longer
+        rounds.append((s, t, {0: 0.08 + 0.002 * (s % 2),
+                              1: 0.08 + 0.002 * ((s + 1) % 2)}))
+    assert drive(det, rounds) == []
+
+
+def test_detector_single_spike_never_trips_sustain():
+    det = StragglerDetector(factor=0.4, sustain=3, warmup_steps=2,
+                            alpha=1.0)   # no smoothing: isolate sustain
+    rounds = lockstep(4, 0.5, victim_blocked=0.4, peer_blocked=0.4)
+    # one spiked step (a GC pause / checkpoint boundary on worker 1)
+    rounds += lockstep(1, 1.0, victim_blocked=0.02, peer_blocked=0.9,
+                       start_step=5)
+    rounds += lockstep(4, 0.5, victim_blocked=0.4, peer_blocked=0.4,
+                       start_step=6)
+    assert drive(det, rounds) == []
+
+
+def test_detector_edge_trigger_and_hysteresis_recovery():
+    det = StragglerDetector(factor=0.4, sustain=2, warmup_steps=2,
+                            recover_ratio=0.5, alpha=1.0)
+    rounds = lockstep(8, 1.0, victim_blocked=0.02, peer_blocked=0.9)
+    verdicts = drive(det, rounds)
+    assert len(verdicts) == 1               # ONE edge, not one per step
+    # recovery must cross BELOW recover_ratio × threshold to clear:
+    # a deficit in the hysteresis band holds the flagged state
+    thresh = det.factor * det.interval_ewma
+    in_band = thresh * 0.7                  # above recover (0.5×), below trip
+    drive(det, lockstep(3, 1.0, victim_blocked=0.9 - in_band,
+                        peer_blocked=0.9, start_step=9))
+    assert det.is_flagged(1)
+    drive(det, lockstep(3, 1.0, victim_blocked=0.9, peer_blocked=0.9,
+                        start_step=12))
+    assert not det.is_flagged(1)            # clean recovery clears
+    # a relapse re-arms the edge: a second verdict may fire
+    verdicts2 = drive(det, lockstep(4, 1.0, victim_blocked=0.02,
+                                    peer_blocked=0.9, start_step=15))
+    assert [v.worker_id for v in verdicts2] == [1]
+
+
+def test_detector_inert_without_blocked_signal_and_small_fleets():
+    det = StragglerDetector(sustain=1, warmup_steps=1)
+    # old heartbeats (no blocked_s) make it inert, not wrong
+    rounds = [(s, float(s), {0: None, 1: None}) for s in range(1, 8)]
+    assert drive(det, rounds) == []
+    # one-member fleets have nobody to wait for
+    det2 = StragglerDetector(sustain=1, warmup_steps=1)
+    assert det2.observe({0: {"step": 3, "step_time": 1.0,
+                             "blocked_s": 0.0}}, [0]) == []
+
+
+def test_detector_reset_clears_verdict_state():
+    det = StragglerDetector(factor=0.4, sustain=2, warmup_steps=2)
+    drive(det, lockstep(8, 1.0, victim_blocked=0.02, peer_blocked=0.9))
+    assert det.is_flagged(1)
+    det.reset()
+    assert not det.is_flagged(1) and det.processed == 0
+    # post-reset: warmup applies again before anything can fire
+    assert drive(det, lockstep(2, 1.0, victim_blocked=0.02,
+                               peer_blocked=0.9)) == []
+
+
+def test_frozen_progress_core():
+    # the shared fresh-but-frozen predicate (elastic.hung_workers + the
+    # fleet transport's outside-in wedge check ride this)
+    assert frozen_progress(5, 100.0, now=103.0, timeout_s=2.0)
+    assert not frozen_progress(5, 100.0, now=101.0, timeout_s=2.0)
+    assert not frozen_progress(None, None, now=1e9, timeout_s=2.0)  # arm gate
+
+
+# ---------------------------------------------------------------------------
+# DegradeMonitor: the page → drain ladder
+# ---------------------------------------------------------------------------
+
+def _mon(escalate=2, **det_kw):
+    det_kw.setdefault("factor", 0.4)
+    det_kw.setdefault("sustain", 2)
+    det_kw.setdefault("warmup_steps", 2)
+    return DegradeMonitor(StragglerDetector(**det_kw),
+                          straggler_escalate=escalate)
+
+
+def test_ladder_pages_then_escalates_once_each():
+    mon = _mon(escalate=2)
+    actions = drive(mon, lockstep(12, 1.0, victim_blocked=0.02,
+                                  peer_blocked=0.9))
+    kinds = [(a.kind, a.worker_id, a.reason) for a in actions]
+    assert kinds == [("page", 1, "straggler"), ("drain", 1, "straggler")]
+    # the drain rung fires AFTER the page rung, not with it
+    page_i = kinds.index(("page", 1, "straggler"))
+    drain_i = kinds.index(("drain", 1, "straggler"))
+    assert drain_i > page_i
+    # continued degradation after the drain: NO further actions (the
+    # agent reshapes; this monitor's job for worker 1 is done)
+    assert drive(mon, lockstep(6, 1.0, victim_blocked=0.02,
+                               peer_blocked=0.9, start_step=13)) == []
+
+
+def test_ladder_recovery_between_rungs_resets_to_ok():
+    # alpha=1 so the recovery clears the EWMA within the escalation
+    # window — the smoothed default would (correctly) still drain a
+    # victim whose deficit is only just starting to decay
+    mon = _mon(escalate=4, alpha=1.0)
+    actions = drive(mon, lockstep(5, 1.0, victim_blocked=0.02,
+                                  peer_blocked=0.9))
+    assert [a.kind for a in actions] == ["page"]
+    # full recovery before the escalation window elapses → no drain
+    actions2 = drive(mon, lockstep(8, 0.5, victim_blocked=0.4,
+                                   peer_blocked=0.4, start_step=6))
+    assert actions2 == []
+    assert not mon.detector.is_flagged(1)
+
+
+def test_ladder_health_page_goes_straight_to_drain_once():
+    mon = _mon()
+    beats = beats_at(3, 1.0, {0: 0.1, 1: 0.1})
+    beats[1]["page"] = "nan-precursor:transformer"
+    actions = mon.observe(beats, [0, 1])
+    assert [(a.kind, a.worker_id, a.reason) for a in actions] == [
+        ("page", 1, "health_page"), ("drain", 1, "health_page")]
+    assert "nan-precursor" in actions[1].detail
+    # sticky marker in later beats: edge already consumed, no re-fire
+    beats2 = beats_at(4, 2.0, {0: 0.1, 1: 0.1})
+    beats2[1]["page"] = "nan-precursor:transformer"
+    assert mon.observe(beats2, [0, 1]) == []
+
+
+def test_ladder_reset_forgets_pages_and_rungs():
+    mon = _mon()
+    beats = beats_at(3, 1.0, {0: 0.1, 1: 0.1})
+    beats[1]["page"] = "grad-explosion:decoder"
+    assert len(mon.observe(beats, [0, 1])) == 2
+    mon.reset()
+    # the NEXT epoch's fresh page is a fresh edge (quarantine-respawn that
+    # pages again must drain again — max_reconfigures bounds the loop)
+    assert len(mon.observe(beats, [0, 1])) == 2
+
+
+# ---------------------------------------------------------------------------
+# WedgeWatchdog
+# ---------------------------------------------------------------------------
+
+class _Probe:
+    def __init__(self):
+        self.progress = 0
+        self.busy = False
+
+    def __call__(self):
+        return self.progress, self.busy
+
+
+def _wd(probe, timeout=1.0, trips=None):
+    return WedgeWatchdog(probe, timeout,
+                         on_wedge=(trips.append if trips is not None
+                                   else None))
+
+
+def test_watchdog_arm_gate_ignores_first_compile():
+    """A cold engine paying its first trace+compile inside the first
+    dispatch is busy with a frozen counter for a LONG time — slow, not
+    wedged. No trip until progress has advanced at least once."""
+    p, trips = _Probe(), []
+    wd = _wd(p, timeout=1.0, trips=trips)
+    p.busy = True                           # request admitted, compiling
+    for t in range(0, 300, 10):
+        assert wd.check(now=float(t)) is False
+    assert trips == [] and not wd.wedged
+
+
+def test_watchdog_idle_is_healthy_forever():
+    p, trips = _Probe(), []
+    wd = _wd(p, timeout=1.0, trips=trips)
+    p.progress, p.busy = 5, False
+    wd.check(now=0.0)
+    wd.check(now=1.0)                       # arm (progress seen to move)
+    p.progress = 6
+    wd.check(now=2.0)
+    for t in range(3, 1000, 50):
+        assert wd.check(now=float(t)) is False
+    assert trips == []
+
+
+def test_watchdog_no_false_positive_during_long_prefill():
+    """A legitimate long prefill is ONE bounded dispatch: the counter
+    freezes for under the timeout, then bumps. As long as every dispatch
+    beats the timeout, the watchdog stays quiet — the timeout's contract
+    is 'longer than the longest legitimate single dispatch'."""
+    p, trips = _Probe(), []
+    wd = _wd(p, timeout=1.0, trips=trips)
+    p.busy = True
+    t = 0.0
+    p.progress = 1
+    wd.check(now=t)
+    p.progress = 2
+    wd.check(now=t + 0.1)                   # armed
+    for _ in range(20):                     # long prefills: 0.9s each
+        t += 0.9
+        p.progress += 1
+        assert wd.check(now=t) is False
+    assert trips == [] and not wd.wedged
+
+
+def test_watchdog_arms_from_counter_value_alone():
+    """A request can race the engine from idle to wedged inside ONE poll
+    interval: the watchdog's first observation is already the frozen
+    value. The counter being > 0 is itself the arm evidence — requiring a
+    change between two polls would never arm (the fleet_smoke wedge_drain
+    regression)."""
+    p, trips = _Probe(), []
+    wd = _wd(p, timeout=1.0, trips=trips)
+    p.progress, p.busy = 11, True           # first look: already wedged
+    assert wd.check(now=0.0) is False
+    assert wd.check(now=1.5) is True
+    assert wd.wedged and len(trips) == 1
+
+
+def test_watchdog_trips_once_per_episode_and_rearms():
+    p, trips = _Probe(), []
+    wd = _wd(p, timeout=1.0, trips=trips)
+    p.busy = True
+    p.progress = 1
+    wd.check(now=0.0)
+    p.progress = 2
+    wd.check(now=0.1)                       # armed
+    # frozen + busy past the timeout: exactly ONE trip, then latched
+    assert wd.check(now=0.5) is False
+    assert wd.check(now=1.5) is True
+    assert wd.check(now=2.5) is False       # edge, not a page storm
+    assert wd.wedged and len(trips) == 1
+    assert "no iteration progress" in trips[0]
+    # progress resumes → re-arms; a second wedge is a second edge
+    p.progress = 3
+    wd.check(now=3.0)
+    assert not wd.wedged
+    assert wd.check(now=4.5) is True
+    assert len(trips) == 2
+
+
+def test_watchdog_survives_probe_and_sink_failures():
+    calls = []
+
+    def bad_probe():
+        calls.append(1)
+        raise RuntimeError("engine is gone")
+
+    wd = WedgeWatchdog(bad_probe, 1.0, log=lambda *_: None)
+    assert wd.check(now=0.0) is False       # logged, not raised
+    p = _Probe()
+
+    def bad_sink(detail):
+        raise RuntimeError("pager down")
+
+    wd2 = WedgeWatchdog(p, 1.0, on_wedge=bad_sink, log=lambda *_: None)
+    p.busy = True
+    p.progress = 1
+    wd2.check(now=0.0)
+    p.progress = 2
+    wd2.check(now=0.1)
+    assert wd2.check(now=2.0) is True       # wedge latched despite the sink
+    assert wd2.wedged
+
+
+# ---------------------------------------------------------------------------
+# heartbeat page plumbing (parallel/elastic.py) + the sentry pager
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_page_is_published_and_sticky(tmp_path):
+    d = str(tmp_path)
+    hb = elastic.Heartbeat(d, 0, interval_s=30.0)
+    hb.beat(step=3, epoch=0, force=True)
+    assert elastic.read_heartbeats(d)[0].get("page") is None
+    hb.page("nan-precursor:encoder", epoch=0)
+    assert elastic.read_heartbeats(d)[0]["page"] == "nan-precursor:encoder"
+    # sticky: later beats re-publish the marker (a page lost to an agent
+    # restart is re-learned from any subsequent beat)
+    hb.beat(step=4, force=True)
+    assert elastic.read_heartbeats(d)[0]["page"] == "nan-precursor:encoder"
+
+
+def test_heartbeat_carries_blocked_s(tmp_path):
+    d = str(tmp_path)
+    hb = elastic.Heartbeat(d, 2, interval_s=0.0)
+    hb.beat(step=5, blocked_s=0.73, force=True)
+    doc = elastic.read_heartbeats(d)[2]
+    assert doc["blocked_s"] == pytest.approx(0.73)
+    # no new step → the stale wait must not overwrite the step's sample
+    hb.beat(step=5, blocked_s=9.9, force=True)
+    assert elastic.read_heartbeats(d)[2]["blocked_s"] == pytest.approx(0.73)
+
+
+def test_worker_page_survives_beacon_outage(tmp_path):
+    logs = []
+    ep = elastic.Epoch(epoch=0, members=[0], port=1)
+    w = elastic.ElasticWorker(str(tmp_path), 0, ep, log=logs.append)
+    w.heartbeat._write = _raise_oserror     # total beacon outage
+    w.page("grad-explosion:decoder")        # must not raise
+    assert any("health page publish failed" in l for l in logs)
+
+
+def _raise_oserror(*a, **k):
+    raise OSError("disk gone")
+
+
+class _FakeSentry:
+    def __init__(self):
+        self.on_breach = None
+
+
+class _Breach:
+    detector = "nan-precursor"
+    group = "transformer"
+
+
+def test_install_breach_pager_chains_existing_sink(tmp_path):
+    ep = elastic.Epoch(epoch=0, members=[0, 1], port=1)
+    w = elastic.ElasticWorker(str(tmp_path), 1, ep)
+    sentry = _FakeSentry()
+    seen = []
+    sentry.on_breach = seen.append          # a pre-existing BreachActions
+    install_breach_pager(w, sentry)
+    sentry.on_breach(_Breach())
+    assert len(seen) == 1                   # the original sink still fired
+    doc = elastic.read_heartbeats(str(tmp_path))[1]
+    assert doc["page"] == "nan-precursor:transformer"
+
+
+def test_agent_drains_health_paged_worker_and_respawns(tmp_path):
+    """End to end over jax-free children: a worker that pages via its
+    heartbeat is drained by the agent's ladder and QUARANTINE-RESPAWNED —
+    same slot, fresh process — with the degrade_drain event recorded."""
+    import subprocess
+    import sys
+    run_dir = str(tmp_path / "pod")
+    os.makedirs(run_dir, exist_ok=True)
+    script = tmp_path / "child.py"
+    script.write_text(CHILD_PAGING)
+
+    def spawn(worker_id, epoch):
+        return subprocess.Popen(
+            [sys.executable, str(script), run_dir, str(worker_id),
+             str(epoch.epoch)])
+
+    agent = elastic.ElasticAgent(
+        run_dir, spawn, members=[0, 1], poll_s=0.05, term_grace_s=3.0,
+        degrade=DegradeMonitor(StragglerDetector()))
+    events = agent.run(deadline_s=60)
+    kinds = [e["kind"] for e in events]
+    assert any(e["kind"] == "worker_paged" and e.get("worker") == 1
+               and e.get("reason") == "health_page" for e in events)
+    assert any(e["kind"] == "degrade_drain" and e.get("worker") == 1
+               for e in events)
+    # quarantine-respawn: the paged worker KEEPS its slot (fresh process)
+    assert agent.epoch.members == [0, 1]
+    assert kinds[-1] == "pod_done"
+
+
+CHILD_PAGING = """
+import json, os, sys, time
+run_dir, wid, epoch = sys.argv[1], sys.argv[2], int(sys.argv[3])
+def beat(page=None):
+    p = os.path.join(run_dir, f"hb_{wid}.json")
+    tmp = p + ".tmp"
+    json.dump({"worker_id": int(wid), "pid": os.getpid(),
+               "time": time.time(), "page": page}, open(tmp, "w"))
+    os.replace(tmp, p)
+beat()
+# epoch 0: worker 1's sentry breaches -> page rides the heartbeat; the
+# agent should drain (SIGTERM) and respawn us into epoch 1, where we run
+# clean to completion
+if wid == "1" and epoch == 0:
+    for _ in range(100):
+        beat(page="nan-precursor:transformer"); time.sleep(0.05)
+    sys.exit(0)
+for _ in range(4):
+    beat(); time.sleep(0.05)
+sys.exit(0)
+"""

@@ -177,6 +177,10 @@ def test_remote_submit_streams_rows_and_done(remote_pair):
     assert [t for _r, chunk in rows for t in chunk] == want
     assert done is not None and done.tokens == want
     assert done.latency_s >= 0.0
+    # the wire done frame carries the replica-measured slot time — the
+    # gateway-side SloEstimator's feed for REMOTE completions (graftward
+    # satellite); bounded by the submit→done latency by construction
+    assert 0.0 <= done.decode_s <= done.latency_s + 1e-6
 
 
 def test_remote_health_load_and_graceful_drain(remote_pair):
@@ -591,6 +595,114 @@ def test_gateway_accounting_attributes_failovers_by_reason():
     assert gw["failover_reasons"] == {"conn_reset": 2, "health_page": 1}
     out = format_report(rows)
     assert "by reason" in out and "conn_reset" in out
+
+
+# ---------------------------------------------------------------------------
+# graftward: wedge drains + the DEGRADE verdict
+# ---------------------------------------------------------------------------
+
+def test_controller_drains_wedged_self_report(tracer):
+    """A replica whose health verb self-reports wedged rides the DRAIN
+    path (migrate → streams fail over with reason="wedged" → splice), not
+    the blind replace path — and only once (the drain detaches it)."""
+    ctl, router, mgr, burn, procs = _ctl(n=2)
+    victim = procs[0]
+    victim.remote.health_doc["wedged"] = True
+    acts = ctl.tick()
+    assert [d["action"] for d in acts] == ["drain"]
+    assert acts[0]["reason"] == "wedged"
+    assert victim.remote.migrations == ["wedged"]
+    assert len(router.replicas) == 1
+    assert all(d["action"] != "drain" for d in ctl.tick())
+    from dalle_tpu.obs import metrics_snapshot
+    assert metrics_snapshot()[
+        'degrade.actions_total{reason="wedged"}'] == 1.0
+
+
+def test_controller_drains_on_outside_in_progress_stall(tracer):
+    """The transport-side frozen-progress check (satellite of the wedge
+    work: fresh heartbeats + frozen iteration counter ≠ healthy idle) is
+    the backstop when the replica's own watchdog is off — same drain,
+    same reason label."""
+    ctl, router, mgr, burn, procs = _ctl(n=2)
+    victim = procs[1]
+    victim.remote.progress_stalled = True
+    acts = ctl.tick()
+    assert [(d["action"], d["reason"]) for d in acts] == [
+        ("drain", "wedged")]
+    assert victim.remote.migrations == ["wedged"]
+
+
+def test_remote_progress_stall_semantics(remote_pair):
+    """RemoteReplica._track_progress reuses elastic.py's fresh-but-frozen
+    logic: busy + frozen counter past the timeout = stalled; idle or
+    advancing counters never stall; progress resuming clears the latch;
+    and a counter that never advanced (first compile) never arms."""
+    rep, srv, rem = remote_pair()
+    rem.progress_timeout_s = 0.05
+    # never-advanced counter (progress 0: first-dispatch compile): busy +
+    # frozen forever, but NOT armed — the counter's VALUE is the gate
+    rem._track_progress({"progress": 0, "inflight": 1})
+    time.sleep(0.12)
+    rem._track_progress({"progress": 0, "inflight": 1})
+    assert not rem.progress_stalled
+    # a wedge at the FIRST value this monitor ever observes (attach to a
+    # warmed replica, first request wedges) must still arm and stall —
+    # witnessing a change between polls is NOT required
+    rem._track_progress({"progress": 2, "inflight": 1})
+    time.sleep(0.12)
+    rem._track_progress({"progress": 2, "inflight": 1})
+    assert rem.progress_stalled
+    # progress resuming clears the latch
+    rem._track_progress({"progress": 3, "inflight": 1})
+    assert not rem.progress_stalled
+    # idle with a frozen counter is just idle — never a stall
+    rem._track_progress({"progress": 3, "inflight": 0,
+                         "queue_depth": 0})
+    time.sleep(0.12)
+    rem._track_progress({"progress": 3, "inflight": 0,
+                         "queue_depth": 0})
+    assert not rem.progress_stalled
+    # disabled timeout (the default): inert even when busy + frozen
+    rem2_rep, _, rem2 = remote_pair()
+    rem2._track_progress({"progress": 2, "inflight": 1})
+    rem2._track_progress({"progress": 3, "inflight": 1})
+    time.sleep(0.12)
+    rem2._track_progress({"progress": 3, "inflight": 1})
+    assert not rem2.progress_stalled
+
+
+def test_wedge_self_report_rides_health_verb(remote_pair):
+    rep, srv, rem = remote_pair(heartbeat_s=0.05)
+    rep.mark_wedged("chaos wedge at iteration 9")
+    deadline = time.time() + 5.0
+    while time.time() < deadline and not rem.health().get("wedged"):
+        time.sleep(0.05)
+    h = rem.health()
+    assert h["wedged"] and h["reason"] == "wedged"
+    assert not h["healthy"] and not rem.healthy
+    assert "iteration 9" in h["wedge_detail"]
+
+
+def test_degrade_accounting_and_verdict_line():
+    from dalle_tpu.obs.report import degrade_accounting, format_report
+    rows = [{"step": 0,
+             'degrade.pages_total{reason="straggler"}': 1.0,
+             'degrade.actions_total{reason="straggler"}': 1.0,
+             'degrade.actions_total{reason="wedged"}': 2.0,
+             "degrade.wedged_total": 2.0}]
+    dg = degrade_accounting(rows)
+    assert dg["verdict"] == "responded"
+    assert dg["actions"] == {"straggler": 1, "wedged": 2}
+    assert dg["pages"] == {"straggler": 1} and dg["wedged"] == 2
+    out = format_report(rows)
+    assert "DEGRADE: responded" in out and "wedged" in out
+    # pages without actions: detected but never escalated
+    paged = [{"step": 0, 'degrade.pages_total{reason="health_page"}': 1.0}]
+    assert degrade_accounting(paged)["verdict"] == "paged"
+    assert "DEGRADE: paged" in format_report(paged)
+    # no degrade keys at all: the report is unchanged
+    assert degrade_accounting([{"step": 0, "fleet.size": 1.0}]) is None
 
 
 # ---------------------------------------------------------------------------

@@ -346,6 +346,10 @@ def test_gateway_loopback_stream_quota_health(model_params, refs):
         assert resp.status == 200
         assert json.loads(resp.read())["tokens"] == refs[1].tolist()
         conn.close()
+        # completions warm the admission estimator AT THE DOOR (graftward
+        # satellite: one feed point for every topology — no per-replica
+        # on_served wiring, and remote fleets warm it identically)
+        assert (gw.admission.slo.tokens_per_s or 0) > 0
         conn, resp = post({"text": TEXTS[2].tolist(), "seed": 102,
                            "tenant": "capped"})
         body = json.loads(resp.read())

@@ -994,6 +994,76 @@ def test_unguarded_io_socket_dial_clean_when_guarded():
     assert lint_source("unguarded-distributed-io", src) == []
 
 
+def test_unbounded_blocking_flags_zero_arg_waits():
+    # the graftward wedge lesson: a timeout-less cross-thread wait in the
+    # serving control plane parks a thread a sick peer can wedge forever
+    src = """
+    def f(q, ev, t):
+        item = q.get()
+        ev.wait()
+        t.join()
+    """
+    found = lint_source("unbounded-blocking-call", src,
+                        rel_path="dalle_tpu/serve/_fixture.py")
+    assert len(found) == 3
+    assert all("timeout" in f.message for f in found)
+
+
+def test_unbounded_blocking_clean_with_timeouts_and_dict_get():
+    # bounded forms and dict lookups (positional args) are out of scope;
+    # Event.wait(0.5) passes its timeout positionally — also bounded
+    src = """
+    def f(q, ev, t, d):
+        a = q.get(timeout=1.0)
+        b = ev.wait(0.5)
+        t.join(timeout=2.0)
+        c = d.get("key")
+        e = d.get("key", None)
+    """
+    assert lint_source("unbounded-blocking-call", src,
+                       rel_path="dalle_tpu/gateway/_fixture.py") == []
+
+
+def test_unbounded_blocking_recv_needs_module_settimeout():
+    bare = """
+    def g(sock):
+        return sock.recv(4096)
+    """
+    found = lint_source("unbounded-blocking-call", bare,
+                        rel_path="dalle_tpu/fleet/_fixture.py")
+    assert len(found) == 1 and "settimeout" in found[0].message
+    # one settimeout anywhere in the module = the module manages socket
+    # deadlines (the fleet/transport.py convention: the frame readers set
+    # the timeout, helper recv loops inherit it)
+    managed = """
+    def prep(sock, timeout):
+        sock.settimeout(timeout)
+    def g(sock):
+        return sock.recv(4096)
+    """
+    assert lint_source("unbounded-blocking-call", managed,
+                       rel_path="dalle_tpu/fleet/_fixture.py") == []
+
+
+def test_unbounded_blocking_scope_and_suppression():
+    src = """
+    def f(q):
+        return q.get()
+    """
+    # only the fleet/gateway/serve control plane is in scope
+    assert lint_source("unbounded-blocking-call", src,
+                       rel_path="dalle_tpu/ops/_fixture.py") == []
+    assert lint_source("unbounded-blocking-call", src,
+                       rel_path="dalle_tpu/train/_fixture.py") == []
+    suppressed = """
+    def main(stop):
+        # the main thread's shutdown park: waiting forever IS the intent
+        stop.wait()  # graftlint: disable=unbounded-blocking-call
+    """
+    assert lint_source("unbounded-blocking-call", suppressed,
+                       rel_path="dalle_tpu/gateway/_fixture.py") == []
+
+
 def test_unguarded_io_socket_dial_suppression_and_unrelated():
     src = """
     import socket
