@@ -137,6 +137,37 @@ def register_rule(cls):
     return cls
 
 
+def to_sarif(findings: Sequence[Finding], tool_name: str,
+             rules: Dict[str, str]) -> dict:
+    """SARIF 2.1.0 document for ``findings`` — the format GitHub code
+    scanning ingests to annotate PR diffs. ``rules`` maps rule name ->
+    one-line description (the registry's descriptions)."""
+    used = sorted({f.rule for f in findings})
+    return {
+        "version": "2.1.0",
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/"
+                   "sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "runs": [{
+            "tool": {"driver": {
+                "name": tool_name,
+                "rules": [{"id": r,
+                           "shortDescription": {"text": rules.get(r, r)}}
+                          for r in used],
+            }},
+            "results": [{
+                "ruleId": f.rule,
+                "level": "error",
+                "message": {"text": f.message},
+                "locations": [{"physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(1, f.line)},
+                }}],
+            } for f in findings],
+        }],
+    }
+
+
 def iter_repo_files(roots: Sequence[str] = DEFAULT_ROOTS,
                     repo_root: str = REPO_ROOT) -> List[str]:
     """Repo-relative paths of every .py file under ``roots``."""

@@ -1,0 +1,347 @@
+"""graftsync rules + golden lock-graph audit (the sync_audit.py machinery).
+
+Rules consume the :class:`~dalle_tpu.analysis.sync_flow.SyncModel` — they
+are relational (cross-method, cross-file), so they do not register in the
+graftlint per-file registry; ``scripts/sync_audit.py`` is their CLI, with
+the graftir golden workflow (``contracts/sync.json``, ``--check`` /
+``--update`` / ``--explain``) and ``# graftsync: allow=<rule> -- <reason>``
+waivers.
+
+| rule | hazard |
+|---|---|
+| ``unguarded-field`` | a field written under a class lock somewhere is read or written bare from a thread-entry method (Eraser-style lockset violation: the exact PolicyQueue tie-break class of race) |
+| ``lock-order-cycle`` | the acquisition graph has a cycle — two call paths take the same locks in opposite orders; both ``file::function`` sites are named |
+| ``blocking-under-lock`` | a queue get/put with no timeout, socket recv/dial, ``join``/``wait`` with no timeout, ``subprocess`` wait, ``time.sleep`` or device ``block_until_ready`` inside a ``with <lock>`` body — every other user of that lock stalls behind the wait |
+| ``thread-no-join`` | a non-daemon thread whose creating scope (class, for ``self.``-stored threads) never joins — interpreter shutdown blocks on it |
+| ``cond-wait-no-predicate`` | ``Condition.wait`` outside a ``while`` loop — a stolen or spurious wakeup silently proceeds on a false predicate (``wait_for`` carries its own loop and is exempt) |
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .core import REPO_ROOT, Finding
+from . import sync_flow
+from .sync_flow import SyncModel, find_cycles
+
+SCHEMA = 1
+
+SYNC_RULES: Dict[str, str] = {
+    "unguarded-field":
+        "lock-guarded field read/written bare from a thread entry",
+    "lock-order-cycle":
+        "cycle in the lock-acquisition graph (deadlock potential)",
+    "blocking-under-lock":
+        "unbounded blocking call inside a with-lock body",
+    "thread-no-join":
+        "non-daemon thread with no join on any shutdown path",
+    "cond-wait-no-predicate":
+        "Condition.wait outside a while predicate loop",
+}
+
+
+def _short(lock_id: str) -> str:
+    """'RequestQueue._lock' for display; the golden keeps full ids."""
+    return lock_id.split("::", 1)[-1]
+
+
+# --------------------------------------------------------------------------
+# the rules
+# --------------------------------------------------------------------------
+
+def check_unguarded_fields(model: SyncModel) -> List[Finding]:
+    out, seen = [], set()
+
+    def check_func(info, ckey, entry_key):
+        fields = model.guarded.get(ckey, {})
+        for acc in info.accesses:
+            guards = fields.get(acc.field)
+            if not guards or acc.held & guards:
+                continue
+            dedup = (info.path, acc.line, acc.field)
+            if dedup in seen:
+                continue
+            seen.add(dedup)
+            verb = "written" if acc.kind == "w" else "read"
+            out.append(Finding(
+                "unguarded-field", info.path, acc.line,
+                f"{info.cls}.{acc.field} is {verb} without "
+                f"{' or '.join(sorted(_short(g) for g in guards))} in "
+                f"thread entry {entry_key.split('::')[-1]} — it is "
+                f"written under that lock elsewhere; take the lock or "
+                f"waive the benign race with a reason"))
+
+    for key, tdef in sorted(model.thread_entries.items()):
+        info = model.functions.get(key)
+        if info is None or info.cls is None:
+            continue
+        ckey = f"{info.path}::{info.cls}"
+        check_func(info, ckey, key)
+        # one call deep: same-class helpers invoked with no lock held run
+        # on the entry's thread with the entry's (empty) lockset
+        for callee, _, held in info.calls:
+            if held:
+                continue
+            cinfo = model.functions.get(callee)
+            if cinfo is not None and cinfo.cls == info.cls \
+                    and cinfo.path == info.path:
+                check_func(cinfo, ckey, key)
+    return out
+
+
+def check_lock_order(model: SyncModel) -> List[Finding]:
+    out = []
+    for cycle in find_cycles(model.edges):
+        route = " -> ".join([e.src.split("::")[-1] for e in cycle]
+                            + [cycle[0].src.split("::")[-1]])
+        sites = "; ".join(f"{e.src.split('::')[-1]}->"
+                          f"{e.dst.split('::')[-1]} at {e.site}:{e.line}"
+                          for e in cycle)
+        first = cycle[0]
+        out.append(Finding(
+            "lock-order-cycle", first.site.split("::")[0], first.line,
+            f"lock-order cycle {route} — opposite acquisition orders can "
+            f"deadlock ({sites})"))
+    return out
+
+
+def check_blocking_under_lock(model: SyncModel) -> List[Finding]:
+    out = []
+    for info in model.functions.values():
+        for b in info.blocking:
+            out.append(Finding(
+                "blocking-under-lock", info.path, b.line,
+                f"{b.desc} while holding {_short(b.lock_id)} in "
+                f"{info.qualname} — every other user of the lock stalls "
+                f"behind this wait; move it outside the lock or bound it"))
+    return out
+
+
+def check_thread_lifecycle(model: SyncModel) -> List[Finding]:
+    out = []
+    for t in model.threads:
+        if t.daemon or t.joined:
+            continue
+        out.append(Finding(
+            "thread-no-join", t.path, t.line,
+            f"non-daemon thread{f' {t.name!r}' if t.name else ''} created "
+            f"in {t.site.split('::')[-1]} with no join in scope — "
+            f"interpreter shutdown blocks on it; mark it daemon or join "
+            f"it on the shutdown path"))
+    return out
+
+
+def check_cond_waits(model: SyncModel) -> List[Finding]:
+    out = []
+    for info in model.functions.values():
+        for w in info.cond_waits:
+            if w.in_loop:
+                continue
+            out.append(Finding(
+                "cond-wait-no-predicate", info.path, w.line,
+                f"Condition.wait on {_short(w.lock_id)} in "
+                f"{info.qualname} outside a while loop — a spurious or "
+                f"stolen wakeup proceeds on a false predicate; use "
+                f"wait_for(predicate, ...) or re-check in a loop"))
+    return out
+
+
+_CHECKS = (check_unguarded_fields, check_lock_order,
+           check_blocking_under_lock, check_thread_lifecycle,
+           check_cond_waits)
+
+
+def run_sync(model: SyncModel) -> List[Finding]:
+    findings: List[Finding] = []
+    for check in _CHECKS:
+        findings.extend(check(model))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# golden lock graph (contracts/sync.json)
+# --------------------------------------------------------------------------
+
+def graph_contract(model: SyncModel) -> dict:
+    """The golden: lock inventory + acquisition edges. Keyed on stable
+    identities (owner ids, file::function sites) — NOT line numbers, so
+    unrelated edits don't read as drift."""
+    dedup = {(e.src, e.dst, e.site) for e in model.edges}
+    return {
+        "schema": SCHEMA,
+        "locks": sorted(
+            ({"id": d.lock_id, "kind": d.kind}
+             for d in model.locks.values()),
+            key=lambda l: l["id"]),
+        "edges": sorted(
+            ({"src": src, "dst": dst, "site": site}
+             for src, dst, site in dedup),
+            key=lambda e: (e["src"], e["dst"], e["site"])),
+    }
+
+
+def save_contract(contract: dict, path: str) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(contract, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+
+
+def load_contract(path: str) -> Optional[dict]:
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def diff_contract(old: dict, new: dict) -> List[str]:
+    """Human-readable drift lines; empty == no drift."""
+    lines = []
+    okeys = {l["id"] for l in old.get("locks", [])}
+    nkeys = {l["id"] for l in new.get("locks", [])}
+    for lid in sorted(nkeys - okeys):
+        lines.append(f"+ lock {lid}")
+    for lid in sorted(okeys - nkeys):
+        lines.append(f"- lock {lid}")
+    oe = {(e["src"], e["dst"], e["site"]) for e in old.get("edges", [])}
+    ne = {(e["src"], e["dst"], e["site"]) for e in new.get("edges", [])}
+    for src, dst, site in sorted(ne - oe):
+        lines.append(f"+ edge {_short(src)} -> {_short(dst)} at {site}")
+    for src, dst, site in sorted(oe - ne):
+        lines.append(f"- edge {_short(src)} -> {_short(dst)} at {site}")
+    return lines
+
+
+# --------------------------------------------------------------------------
+# audit orchestration (CLI + tests)
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class SyncReport:
+    findings: List[Finding]                  # unwaived rule findings
+    waived: List[Tuple[Finding, str]]        # (finding, reason)
+    problems: List[str]                      # waiver syntax issues
+    drift: List[str]                         # golden drift lines
+    missing: bool                            # no golden yet
+    contract: dict                           # the live contract
+    model: SyncModel
+    updated: bool = False
+
+    @property
+    def failed(self) -> bool:
+        return bool(self.findings or self.problems or self.drift)
+
+
+def _apply_waivers(findings: Sequence[Finding],
+                   sources: Dict[str, str]
+                   ) -> Tuple[List[Finding], List[Tuple[Finding, str]],
+                              List[str]]:
+    """Split findings into (unwaived, waived-with-reason, problems) using
+    per-file ``# graftsync: allow=`` comments (finding line or line above)."""
+    by_file: Dict[str, Dict[Tuple[str, int], str]] = {}
+    problems: List[str] = []
+    for path, src in sources.items():
+        waivers, probs = sync_flow.collect_waivers(
+            src, path, tuple(SYNC_RULES))
+        problems.extend(probs)
+        table = by_file.setdefault(path, {})
+        for w in waivers:
+            table[(w.rule, w.line)] = w.reason
+    unwaived, waived = [], []
+    for f in findings:
+        table = by_file.get(f.path, {})
+        reason = table.get((f.rule, f.line)) or table.get((f.rule, f.line - 1))
+        if reason is not None:
+            waived.append((f, reason))
+        else:
+            unwaived.append(f)
+    return unwaived, waived, problems
+
+
+def audit(repo_root: str = REPO_ROOT,
+          contract_path: Optional[str] = None,
+          update: bool = False,
+          paths: Optional[Sequence[str]] = None) -> SyncReport:
+    """Build the model over the sync roots, run the rules, apply waivers,
+    and compare (or rewrite) the lock-graph golden."""
+    if contract_path is None:
+        contract_path = os.path.join(repo_root, "contracts", "sync.json")
+    rels = list(paths) if paths is not None \
+        else sync_flow.sync_files(repo_root)
+    sources = {}
+    for rel in rels:
+        with open(os.path.join(repo_root, rel), encoding="utf-8") as fh:
+            sources[rel] = fh.read()
+    model = sync_flow.build_model(sorted(sources.items()))
+    live = graph_contract(model)
+    unwaived, waived, problems = _apply_waivers(run_sync(model), sources)
+
+    if update:
+        save_contract(live, contract_path)
+        return SyncReport(unwaived, waived, problems, [], False, live,
+                          model, updated=True)
+
+    golden = load_contract(contract_path)
+    if golden is None:
+        return SyncReport(unwaived, waived, problems, [], True, live, model)
+    return SyncReport(unwaived, waived, problems,
+                      diff_contract(golden, live), False, live, model)
+
+
+def render_report(report: SyncReport, scope: str) -> str:
+    lines = [str(f) for f in report.findings]
+    lines += [f"{f} [waived: {reason}]" for f, reason in report.waived]
+    lines += [f"waiver-problem: {p}" for p in report.problems]
+    for d in report.drift:
+        lines.append(f"lock-graph drift: {d}")
+    if report.missing:
+        lines.append("no golden lock graph at contracts/sync.json — run "
+                     "scripts/sync_audit.py --update")
+    n = len(report.findings) + len(report.problems)
+    if report.failed:
+        lines.append(
+            f"graftsync: {n} finding{'s' if n != 1 else ''}"
+            + (f", {len(report.drift)} drift line"
+               f"{'s' if len(report.drift) != 1 else ''}"
+               if report.drift else "")
+            + f" ({scope})")
+        if report.drift:
+            lines.append("intentional lock/edge change? regenerate with "
+                         "scripts/sync_audit.py --update and commit the "
+                         "diff")
+    else:
+        lines.append(f"graftsync: clean ({scope})")
+    return "\n".join(lines)
+
+
+def explain(model: SyncModel) -> str:
+    """Pretty-print the model: locks, acquisition edges, guarded fields,
+    thread entries (the --explain CLI path)."""
+    lines = [f"locks ({len(model.locks)}):"]
+    for lid in sorted(model.locks):
+        d = model.locks[lid]
+        lines.append(f"  {d.kind:<9} {lid}  ({d.path}:{d.line})")
+    lines.append(f"acquisition edges ({len(model.edges)}):")
+    if not model.edges:
+        lines.append("  (none — no nested acquisitions)")
+    for e in model.edges:
+        lines.append(f"  {_short(e.src)} -> {_short(e.dst)}  at "
+                     f"{e.site}:{e.line}")
+    lines.append("guarded fields:")
+    for ckey in sorted(model.guarded):
+        fields = model.guarded[ckey]
+        lines.append(f"  {ckey}:")
+        for field in sorted(fields):
+            lines.append(f"    {field:<18} under "
+                         f"{', '.join(sorted(_short(g) for g in fields[field]))}")
+    lines.append(f"thread entries ({len(model.thread_entries)}):")
+    for key in sorted(model.thread_entries):
+        t = model.thread_entries[key]
+        tag = "daemon" if t.daemon else (
+            "joined" if t.joined else "UNJOINED")
+        lines.append(f"  {key}  [{tag}]")
+    return "\n".join(lines)

@@ -1,0 +1,939 @@
+"""graftsync — whole-module static concurrency model for the threaded
+control plane.
+
+graftlint's ``unbounded-blocking-call`` rule found a real hang in
+``serve/pipeline.py`` on its first run, but it reads one call site at a
+time. The hazards that remain are *relational*: a field written under
+``self._lock`` in one method and read bare from the worker thread, two
+locks acquired in opposite orders from two call paths, a blocking wait
+issued while a lock is held, a non-daemon thread nobody joins. This module
+builds the repo-wide model those checks need:
+
+  * **lock inventory** — every ``threading.Lock``/``RLock``/``Condition``
+    created in the sync roots, identified by owner (``path::Class.attr`` or
+    ``path::name`` for module-level locks) and by its creation site
+    ``(path, line)`` — the same key the runtime tracker
+    (:mod:`dalle_tpu.obs.lockorder`) records, so the static graph and an
+    observed run are directly comparable. ``Condition(self._lock)`` aliases
+    the wrapped lock: acquiring the condition IS acquiring the lock.
+  * **guarded-field map** — per class, the attributes written while one of
+    its locks is held (``with self._lock:`` scopes, including helper-method
+    summaries one call deep: a helper's bare writes count as guarded by the
+    caller's held lock).
+  * **lock-acquisition graph** — an edge ``A -> B`` wherever code acquires
+    B while holding A, with the acquiring ``file::function`` site. Edges
+    follow one-call-deep summaries: a locked body calling ``self.m()`` or a
+    typed attribute's method inherits that callee's direct acquisitions.
+  * **thread entries** — ``run`` methods, callables passed to
+    ``threading.Thread(target=...)``/``Timer``/executor ``submit``, with
+    nested ``def``s attributed to their enclosing class (a closure's
+    ``self`` is the enclosing method's).
+  * **access log** — every ``self.field`` read/write per function with the
+    lock set held at that point, plus blocking calls under a held lock,
+    ``Condition.wait`` predicate-loop context, and thread-lifecycle facts.
+
+The model is pure AST — no imports of the analyzed code — so it runs on
+any tree state. Rules that consume it live in
+:mod:`dalle_tpu.analysis.rules_sync`; the CLI is ``scripts/sync_audit.py``
+(golden lock graph in ``contracts/sync.json``). Waivers are source
+comments on the finding's line or the line above::
+
+    # graftsync: allow=blocking-under-lock -- <reason>
+
+A waiver without a reason, or naming an unknown rule, is itself a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Tuple
+
+from .core import REPO_ROOT, iter_repo_files
+from .jit_scan import dotted_name
+
+# the threaded control plane: every package that owns threading state
+SYNC_ROOTS = ("dalle_tpu/serve", "dalle_tpu/gateway", "dalle_tpu/fleet",
+              "dalle_tpu/degrade", "dalle_tpu/obs", "dalle_tpu/parallel",
+              "dalle_tpu/chaos")
+
+_WAIVER_RE = re.compile(r"#\s*graftsync:\s*allow=([\w\-]+)(?:\s*--\s*(.*))?")
+
+_LOCK_CTORS = {"threading.Lock": "Lock", "threading.RLock": "RLock",
+               "threading.Condition": "Condition"}
+
+# container methods that mutate shared state (a write for lockset purposes)
+_MUTATORS = {"append", "appendleft", "pop", "popleft", "add", "remove",
+             "discard", "clear", "update", "extend", "insert", "setdefault",
+             "__setitem__"}
+
+
+@dataclasses.dataclass(frozen=True)
+class LockDef:
+    """One lock object, keyed by owner and by creation site."""
+    lock_id: str            # "path::Class.attr" or "path::name"
+    path: str
+    line: int               # line of the threading.Lock() call
+    kind: str               # Lock | RLock | Condition
+
+
+@dataclasses.dataclass(frozen=True)
+class Edge:
+    """B acquired while A held, at ``site`` (file::function)."""
+    src: str
+    dst: str
+    site: str
+    line: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Access:
+    field: str
+    line: int
+    kind: str               # "r" | "w"
+    held: FrozenSet[str]    # lock ids held at the access
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockingCall:
+    lock_id: str
+    desc: str               # human-readable call description
+    line: int
+
+
+@dataclasses.dataclass(frozen=True)
+class CondWait:
+    lock_id: str
+    line: int
+    in_loop: bool           # lexically inside a while (predicate re-check)
+
+
+@dataclasses.dataclass(frozen=True)
+class ThreadDef:
+    path: str
+    line: int
+    site: str               # creating file::function
+    daemon: bool
+    joined: bool            # a .join( on the thread's binding is in scope
+    target: Optional[str]   # resolved entry func key, when resolvable
+    name: Optional[str]
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """Per-function concurrency summary."""
+    key: str                            # "path::qualname"
+    path: str
+    qualname: str
+    cls: Optional[str]                  # enclosing class name, if any
+    line: int
+    accesses: List[Access] = dataclasses.field(default_factory=list)
+    acquires: List[Tuple[str, int]] = dataclasses.field(default_factory=list)
+    edges: List[Edge] = dataclasses.field(default_factory=list)
+    blocking: List[BlockingCall] = dataclasses.field(default_factory=list)
+    cond_waits: List[CondWait] = dataclasses.field(default_factory=list)
+    # callee key -> (line, held lock ids at the call)
+    calls: List[Tuple[str, int, FrozenSet[str]]] = \
+        dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class SyncModel:
+    """The whole-project concurrency model."""
+    locks: Dict[str, LockDef]
+    functions: Dict[str, FuncInfo]
+    # "path::Class" -> field -> lock ids it is written under
+    guarded: Dict[str, Dict[str, FrozenSet[str]]]
+    edges: List[Edge]                   # deduped, one-call-deep resolved
+    thread_entries: Dict[str, ThreadDef]  # entry func key -> creating thread
+    threads: List[ThreadDef]
+    # class name -> "path::Class" (ambiguous names dropped)
+    class_keys: Dict[str, str]
+
+    def lock_by_site(self) -> Dict[Tuple[str, int], str]:
+        """(path, line) of the Lock() call -> lock_id — the join key with
+        the runtime tracker's creation-site identities."""
+        return {(d.path, d.line): d.lock_id for d in self.locks.values()}
+
+
+# --------------------------------------------------------------------------
+# per-file scan
+# --------------------------------------------------------------------------
+
+class _ClassScan:
+    """First pass over one class: lock attrs, condition aliases, attribute
+    types (``self.x = SomeClass(...)`` / annotated ctor params)."""
+
+    def __init__(self, path: str, name: str):
+        self.path = path
+        self.name = name
+        self.bases: List[str] = []                # base class names
+        self.locks: Dict[str, LockDef] = {}       # attr -> def
+        self.aliases: Dict[str, str] = {}         # cond attr -> lock attr
+        self.attr_types: Dict[str, str] = {}      # attr -> class name
+        self.methods: Dict[str, ast.AST] = {}
+        self.inherited: Dict[str, str] = {}       # method -> base func key
+
+    @property
+    def key(self) -> str:
+        return f"{self.path}::{self.name}"
+
+
+def _lock_ctor_kind(call: ast.AST) -> Optional[str]:
+    if isinstance(call, ast.Call):
+        return _LOCK_CTORS.get(dotted_name(call.func))
+    return None
+
+
+def _ann_name(node: Optional[ast.AST]) -> str:
+    """Class name from an annotation node; string annotations
+    (``x: "Table"``) are Constants, not Names."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return dotted_name(node) if node is not None else ""
+
+
+def _type_from_ann(node: Optional[ast.AST]) -> Optional[str]:
+    """Capitalized class name from an annotation, looking through
+    ``Optional[...]``/subscripts and string annotations."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Subscript):
+        return _type_from_ann(node.slice)
+    name = _ann_name(node).rsplit(".", 1)[-1].strip("\"'")
+    return name if name and name[0].isupper() else None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'f' for ``self.f``, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class _FileScan:
+    """Parse one file into class scans + module-level locks/functions."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.tree = ast.parse(source, filename=path)
+        self.classes: Dict[str, _ClassScan] = {}
+        self.module_locks: Dict[str, LockDef] = {}   # name -> def
+        self.module_funcs: Dict[str, ast.AST] = {}
+        self.module_var_types: Dict[str, str] = {}   # global -> class name
+        self.imported_names: set = set()
+        self._scan()
+
+    def _scan(self) -> None:
+        for node in self.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self._scan_class(node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.module_funcs[node.name] = node
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                for a in node.names:
+                    self.imported_names.add(a.asname
+                                            or a.name.split(".")[0])
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name):
+                # "_tracer: Optional[Tracer] = None" — the module
+                # singleton pattern; functions resolve "tr._lock" via it
+                t = _type_from_ann(node.annotation)
+                if t:
+                    self.module_var_types[node.target.id] = t
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                kind = _lock_ctor_kind(node.value)
+                name = node.targets[0].id
+                if kind:
+                    self.module_locks[name] = LockDef(
+                        f"{self.path}::{name}", self.path,
+                        node.value.lineno, kind)
+                elif isinstance(node.value, ast.Call):
+                    ctor = dotted_name(node.value.func).rsplit(".", 1)[-1]
+                    if ctor and ctor[0].isupper():
+                        self.module_var_types[name] = ctor
+
+    def _scan_class(self, cls: ast.ClassDef) -> None:
+        scan = _ClassScan(self.path, cls.name)
+        scan.bases = [dotted_name(b).rsplit(".", 1)[-1]
+                      for b in cls.bases if dotted_name(b)]
+        self.classes[cls.name] = scan
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan.methods[item.name] = item
+                ann = {a.arg: _ann_name(a.annotation)
+                       for a in item.args.args
+                       if a.annotation is not None}
+                for sub in ast.walk(item):
+                    self._scan_stmt(scan, sub, ann)
+            elif isinstance(item, ast.Assign) and len(item.targets) == 1 \
+                    and isinstance(item.targets[0], ast.Name):
+                kind = _lock_ctor_kind(item.value)
+                if kind:     # class-body lock (shared across instances)
+                    attr = item.targets[0].id
+                    scan.locks[attr] = LockDef(
+                        f"{self.path}::{cls.name}.{attr}", self.path,
+                        item.value.lineno, kind)
+
+    def _scan_stmt(self, scan: _ClassScan, node: ast.AST,
+                   annotations: Dict[str, str]) -> None:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            return
+        attr = _self_attr(node.targets[0])
+        if attr is None:
+            return
+        kind = _lock_ctor_kind(node.value)
+        if kind == "Condition" and isinstance(node.value, ast.Call) \
+                and node.value.args:
+            wrapped = _self_attr(node.value.args[0])
+            if wrapped is not None:
+                # Condition(self._lock): acquiring the condition IS
+                # acquiring the wrapped lock — alias, not a new node
+                scan.aliases[attr] = wrapped
+                return
+        if kind:
+            scan.locks[attr] = LockDef(
+                f"{self.path}::{scan.name}.{attr}", self.path,
+                node.value.lineno, kind)
+            return
+        # attribute types: self.x = SomeClass(...) and self.x = param
+        # where the ctor annotates param's class — the one-call-deep
+        # resolver uses these to find the callee's locks across files
+        if isinstance(node.value, ast.Call):
+            callee = dotted_name(node.value.func).rsplit(".", 1)[-1]
+            if callee and callee[0].isupper():
+                scan.attr_types[attr] = callee
+        elif isinstance(node.value, ast.Name):
+            ann = annotations.get(node.value.id, "")
+            ann = ann.rsplit(".", 1)[-1]
+            if ann and ann[0].isupper():
+                scan.attr_types[attr] = ann
+
+
+# --------------------------------------------------------------------------
+# per-function walk (held-lock tracking)
+# --------------------------------------------------------------------------
+
+def _call_blocking_desc(call: ast.Call) -> Optional[str]:
+    """Description when ``call`` is a blocking primitive, else None."""
+    func = call.func
+    if not isinstance(func, ast.Attribute):
+        name = dotted_name(func)
+        if name == "time.sleep" or name.endswith("create_connection"):
+            return f"{name}(...)"
+        return None
+    attr = func.attr
+    recv = dotted_name(func.value) or "<expr>"
+    kwargs = {k.arg for k in call.keywords}
+    has_timeout = "timeout" in kwargs or (
+        attr in ("get", "wait", "join") and call.args)
+    if attr == "get" and not call.args and not kwargs:
+        return f"{recv}.get() with no timeout"
+    if attr == "put" and not has_timeout \
+            and ("q" == recv.rsplit(".", 1)[-1]
+                 or recv.rsplit(".", 1)[-1].endswith(("queue", "_q"))):
+        return f"{recv}.put(...) with no timeout"
+    if attr in ("wait", "join") and not has_timeout:
+        return f"{recv}.{attr}() with no timeout"
+    if attr in ("recv", "recv_into", "accept", "connect"):
+        return f"{recv}.{attr}(...) socket I/O"
+    if attr == "create_connection":
+        return f"{recv}.create_connection(...) socket dial"
+    if attr == "block_until_ready":
+        return f"{recv}.block_until_ready()"
+    if attr == "sleep" and recv == "time":
+        return "time.sleep(...)"
+    return None
+
+
+class _FuncWalker:
+    """Walk one function body tracking the held-lock stack."""
+
+    def __init__(self, file_scan: _FileScan, scan: Optional[_ClassScan],
+                 qualname: str, node: ast.AST, collect,
+                 global_classes: Optional[Dict[str, _ClassScan]] = None):
+        self.fs = file_scan
+        self.cls = scan
+        self.path = file_scan.path
+        self.qualname = qualname
+        self.global_classes = global_classes or {}
+        self.info = FuncInfo(
+            key=f"{file_scan.path}::{qualname}", path=file_scan.path,
+            qualname=qualname, cls=scan.name if scan else None,
+            line=node.lineno)
+        self.collect = collect      # (qualname, node) for nested defs
+        self.held: List[str] = []
+        self.loop_depth = 0
+        # local var -> class name: annotated params + "x = Class(...)" +
+        # "x = <typed module global>" (the "tr = _tracer" singleton grab)
+        self.local_types: Dict[str, str] = {}
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for a in node.args.args:
+                t = _type_from_ann(a.annotation)
+                if t:
+                    self.local_types[a.arg] = t
+        for stmt in node.body:
+            self._walk(stmt)
+
+    # -- lock-expression resolution ---------------------------------------
+
+    def _local_class(self, var: str) -> Optional[_ClassScan]:
+        """The _ClassScan a local/global variable is known to hold."""
+        tname = self.local_types.get(var) \
+            or self.fs.module_var_types.get(var)
+        if tname is None:
+            return None
+        return self.fs.classes.get(tname) or self.global_classes.get(tname)
+
+    def _resolve_lock(self, expr: ast.AST) -> Optional[str]:
+        attr = _self_attr(expr)
+        if attr is not None and self.cls is not None:
+            attr = self.cls.aliases.get(attr, attr)
+            d = self.cls.locks.get(attr)
+            return d.lock_id if d else None
+        if isinstance(expr, ast.Name):
+            d = self.fs.module_locks.get(expr.id)
+            return d.lock_id if d else None
+        # "tr._lock" where tr's class is known (annotated param, local
+        # "x = Class(...)", or a typed module singleton like obs.trace's
+        # "_tracer: Optional[Tracer]")
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name):
+            cscan = self._local_class(expr.value.id)
+            if cscan is not None:
+                attr = cscan.aliases.get(expr.attr, expr.attr)
+                d = cscan.locks.get(attr)
+                return d.lock_id if d else None
+        return None
+
+    def _callee_key(self, func: ast.AST) -> Optional[str]:
+        """One-call-deep resolution: self.m(), typed-attr .m(), module f(),
+        imported f() (resolved against the global registry later)."""
+        if isinstance(func, ast.Name):
+            if func.id in self.fs.module_funcs:
+                return f"{self.path}::{func.id}"
+            if func.id in self.fs.imported_names:
+                return f"@@{func.id}"      # cross-module, resolved later
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        owner = _self_attr(func.value)
+        if isinstance(func.value, ast.Name) and func.value.id == "self" \
+                and self.cls is not None:
+            if func.attr in self.cls.methods:
+                return f"{self.path}::{self.cls.name}.{func.attr}"
+            return self.cls.inherited.get(func.attr)
+        if owner is not None and self.cls is not None:
+            tname = self.cls.attr_types.get(owner)
+            if tname:
+                return f"@{tname}.{func.attr}"   # resolved globally later
+        if isinstance(func.value, ast.Name):
+            cscan = self._local_class(func.value.id)
+            if cscan is not None:
+                if func.attr in cscan.methods:
+                    return f"{cscan.path}::{cscan.name}.{func.attr}"
+                return cscan.inherited.get(func.attr)
+        return None
+
+    # -- the walk ---------------------------------------------------------
+
+    def _walk(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: its own summary, attributed to the enclosing
+            # class (a closure's ``self`` is the enclosing method's)
+            self.collect(f"{self.qualname}.{node.name}", node, self.cls)
+            return
+        if isinstance(node, ast.Lambda):
+            return
+        if isinstance(node, ast.With):
+            self._walk_with(node)
+            return
+        if isinstance(node, (ast.While, ast.For)):
+            self.loop_depth += 1
+            for child in ast.iter_child_nodes(node):
+                self._walk(child)
+            self.loop_depth -= 1
+            return
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            v, tname = node.value, None
+            if isinstance(v, ast.Call):
+                ctor = dotted_name(v.func).rsplit(".", 1)[-1]
+                if ctor and ctor[0].isupper():
+                    tname = ctor
+            elif isinstance(v, ast.Name):
+                tname = self.fs.module_var_types.get(v.id)
+            if tname:
+                self.local_types[node.targets[0].id] = tname
+        if isinstance(node, ast.Call):
+            self._visit_call(node)
+        elif isinstance(node, ast.Subscript) \
+                and isinstance(node.ctx, (ast.Store, ast.Del)):
+            field = _self_attr(node.value)
+            if field is not None:      # self.f[k] = v writes f
+                self.info.accesses.append(Access(
+                    field, node.lineno, "w", frozenset(self.held)))
+        elif isinstance(node, ast.Attribute):
+            self._visit_attribute(node)
+        for child in ast.iter_child_nodes(node):
+            self._walk(child)
+
+    def _walk_with(self, node: ast.With) -> None:
+        pushed = []
+        for item in node.items:
+            lock = self._resolve_lock(item.context_expr)
+            if lock is None:
+                self._walk(item.context_expr)
+                continue
+            line = item.context_expr.lineno
+            self.info.acquires.append((lock, line))
+            for held in self.held:
+                if held != lock:
+                    self.info.edges.append(Edge(
+                        held, lock, f"{self.path}::{self.qualname}", line))
+            self.held.append(lock)
+            pushed.append(lock)
+        for stmt in node.body:
+            self._walk(stmt)
+        for _ in pushed:
+            self.held.pop()
+
+    def _visit_call(self, node: ast.Call) -> None:
+        func = node.func
+        held = frozenset(self.held)
+        callee = self._callee_key(func)
+        if callee is not None:
+            self.info.calls.append((callee, node.lineno, held))
+        # Condition.wait predicate-loop check (wait_for builds its own)
+        if isinstance(func, ast.Attribute) and func.attr == "wait":
+            lock = self._resolve_lock(func.value)
+            if lock is not None:
+                self.info.cond_waits.append(CondWait(
+                    lock, node.lineno, self.loop_depth > 0))
+        if self.held:
+            # Condition.wait/wait_for RELEASES the condition's own lock
+            # while parked — only OTHER held locks make it a blocking
+            # hazard, and they are the ones attributed
+            recv_lock = None
+            if isinstance(func, ast.Attribute):
+                recv_lock = self._resolve_lock(func.value)
+            effective = [h for h in self.held if h != recv_lock]
+            desc = _call_blocking_desc(node)
+            if desc is not None and callee is None and effective:
+                self.info.blocking.append(BlockingCall(
+                    effective[-1], desc, node.lineno))
+        # container mutation on a self field is a WRITE to that field
+        if isinstance(func, ast.Attribute) and func.attr in _MUTATORS:
+            field = _self_attr(func.value)
+            if field is not None:
+                self.info.accesses.append(Access(
+                    field, node.lineno, "w", held))
+
+    def _visit_attribute(self, node: ast.Attribute) -> None:
+        field = _self_attr(node)
+        if field is None:
+            return
+        if self.cls is not None and (
+                field in self.cls.locks or field in self.cls.aliases):
+            return                       # the lock itself is not data
+        kind = "w" if isinstance(node.ctx, (ast.Store, ast.Del)) else "r"
+        self.info.accesses.append(Access(
+            field, node.lineno, kind, frozenset(self.held)))
+
+
+# --------------------------------------------------------------------------
+# thread-entry + lifecycle extraction
+# --------------------------------------------------------------------------
+
+def _scope_has_join(nodes: Iterable[ast.AST]) -> bool:
+    """Any ``<x>.join(...)`` call in the given bodies (str.join excluded by
+    requiring a non-string-literal receiver heuristically: a call with
+    positional args whose receiver is a Constant is a str.join)."""
+    for root in nodes:
+        for node in ast.walk(root):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "join" \
+                    and not isinstance(node.func.value, ast.Constant):
+                # os.path.join / "sep".join are not thread joins
+                recv = dotted_name(node.func.value)
+                if recv.startswith(("os.", "posixpath", "ntpath")):
+                    continue
+                return True
+    return False
+
+
+def _thread_facts(file_scan: _FileScan, scan: Optional[_ClassScan],
+                  qualname: str, fn: ast.AST,
+                  scope_has_join: bool) -> List[ThreadDef]:
+    """Thread creations in one function: daemon-ness, join-ness, target.
+    ``scope_has_join`` is class-wide for methods (threads stored on self
+    are joined from the shutdown path, a different method), function-local
+    for module functions."""
+    out = []
+    src_dump = ast.dump(fn)
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        ctor = dotted_name(node.func)
+        is_submit = (isinstance(node.func, ast.Attribute)
+                     and node.func.attr == "submit"
+                     and ("executor" in dotted_name(node.func.value).lower()
+                          or "pool" in dotted_name(node.func.value).lower()))
+        if ctor not in ("threading.Thread", "Thread", "threading.Timer",
+                        "Timer") and not is_submit:
+            continue
+        kwargs = {k.arg: k.value for k in node.keywords if k.arg}
+        daemon = isinstance(kwargs.get("daemon"), ast.Constant) \
+            and bool(kwargs["daemon"].value)
+        target = None
+        tval = kwargs.get("target")
+        if "Timer" in ctor and len(node.args) >= 2:
+            tval = node.args[1]
+        if is_submit and node.args:
+            tval = node.args[0]
+        if tval is not None:
+            tattr = _self_attr(tval)
+            if tattr is not None and scan is not None \
+                    and tattr in scan.methods:
+                target = f"{file_scan.path}::{scan.name}.{tattr}"
+            elif isinstance(tval, ast.Name):
+                # local closure defined in this function, or module func
+                if tval.id in file_scan.module_funcs:
+                    target = f"{file_scan.path}::{tval.id}"
+                else:
+                    target = f"{file_scan.path}::{qualname}.{tval.id}"
+        name = None
+        nval = kwargs.get("name")
+        if isinstance(nval, ast.Constant):
+            name = str(nval.value)
+        if not daemon:
+            # daemon set post-construction (t.daemon = True) in this fn
+            daemon = bool(re.search(r"attr='daemon'", src_dump)
+                          and "Constant(value=True" in src_dump)
+        if is_submit:
+            daemon = True              # the executor owns the lifecycle
+        out.append(ThreadDef(file_scan.path, node.lineno,
+                             f"{file_scan.path}::{qualname}",
+                             daemon, scope_has_join, target, name))
+    return out
+
+
+# --------------------------------------------------------------------------
+# model build
+# --------------------------------------------------------------------------
+
+def sync_files(repo_root: str = REPO_ROOT) -> List[str]:
+    """Repo-relative .py files in the sync roots."""
+    return iter_repo_files(SYNC_ROOTS, repo_root)
+
+
+def build_model(files: Sequence[Tuple[str, str]]) -> SyncModel:
+    """Build the concurrency model from (rel_path, source) pairs."""
+    file_scans: List[_FileScan] = []
+    for path, source in files:
+        try:
+            file_scans.append(_FileScan(path, source))
+        except SyntaxError:
+            continue
+
+    # global class registry: name -> key (ambiguous names are dropped —
+    # a wrong cross-file resolution is worse than a missing one)
+    class_keys: Dict[str, Optional[str]] = {}
+    scans_by_key: Dict[str, _ClassScan] = {}
+    for fs in file_scans:
+        for cname, scan in fs.classes.items():
+            key = f"{fs.path}::{cname}"
+            scans_by_key[key] = scan
+            class_keys[cname] = None if cname in class_keys else key
+
+    # inheritance: a subclass shares its base's locks/aliases/attr types
+    # and can call inherited methods on self — propagate base facts down
+    # (bases first; the subclass's own definitions win; lock identity is
+    # the BASE's lock_id: one object at runtime, one graph node here)
+    propagated: set = set()
+
+    def _propagate(scan: _ClassScan) -> None:
+        if scan.key in propagated:
+            return
+        propagated.add(scan.key)
+        for bname in scan.bases:
+            bkey = class_keys.get(bname)
+            if bkey is None:
+                continue
+            base = scans_by_key[bkey]
+            _propagate(base)
+            for attr, d in base.locks.items():
+                scan.locks.setdefault(attr, d)
+            for attr, tgt in base.aliases.items():
+                scan.aliases.setdefault(attr, tgt)
+            for attr, tname in base.attr_types.items():
+                scan.attr_types.setdefault(attr, tname)
+            for mname in base.methods:
+                if mname not in scan.methods:
+                    scan.inherited.setdefault(
+                        mname, f"{base.path}::{base.name}.{mname}")
+            for mname, fkey in base.inherited.items():
+                if mname not in scan.methods:
+                    scan.inherited.setdefault(mname, fkey)
+
+    for scan in scans_by_key.values():
+        _propagate(scan)
+
+    # unambiguous class/function name registries for cross-file resolution
+    global_classes = {n: scans_by_key[k]
+                      for n, k in class_keys.items() if k is not None}
+    func_keys: Dict[str, Optional[str]] = {}
+    for fs in file_scans:
+        for fname in fs.module_funcs:
+            key = f"{fs.path}::{fname}"
+            func_keys[fname] = None if fname in func_keys else key
+
+    locks: Dict[str, LockDef] = {}
+    functions: Dict[str, FuncInfo] = {}
+    threads: List[ThreadDef] = []
+
+    for fs in file_scans:
+        for d in fs.module_locks.values():
+            locks[d.lock_id] = d
+        for scan in fs.classes.values():
+            for d in scan.locks.values():
+                locks[d.lock_id] = d
+
+        class_joins = {cname: _scope_has_join(scan.methods.values())
+                       for cname, scan in fs.classes.items()}
+        pending: List[Tuple[str, ast.AST, Optional[_ClassScan]]] = []
+        for cname, scan in fs.classes.items():
+            for mname, mnode in scan.methods.items():
+                pending.append((f"{cname}.{mname}", mnode, scan))
+        for fname, fnode in fs.module_funcs.items():
+            pending.append((fname, fnode, None))
+        while pending:
+            qualname, node, scan = pending.pop(0)
+
+            def _collect(q, n, s):
+                pending.append((q, n, s))
+            walker = _FuncWalker(fs, scan, qualname, node, _collect,
+                                 global_classes)
+            functions[walker.info.key] = walker.info
+            has_join = (class_joins[scan.name] if scan is not None
+                        else _scope_has_join([node]))
+            threads.extend(_thread_facts(fs, scan, qualname, node,
+                                         has_join))
+
+    # resolve "@Class.method" / "@@func" callee keys against the registries
+    def resolve(callee: str) -> Optional[str]:
+        if callee.startswith("@@"):
+            fkey = func_keys.get(callee[2:])
+            return fkey if fkey in functions else None
+        if not callee.startswith("@"):
+            return callee if callee in functions else None
+        cname, mname = callee[1:].rsplit(".", 1)
+        key = class_keys.get(cname)
+        if key is None:
+            return None
+        scan = scans_by_key[key]
+        fkey = f"{scan.path}::{cname}.{mname}"
+        if fkey in functions:
+            return fkey
+        fkey = scan.inherited.get(mname)       # method defined on a base
+        return fkey if fkey in functions else None
+
+    # rewrite call targets to resolved function keys (unresolvable calls
+    # drop out — a wrong cross-file resolution is worse than a missing one)
+    for info in functions.values():
+        info.calls = [(resolve(c), line, held) for c, line, held in
+                      info.calls if resolve(c) is not None]
+
+    # transitive may-acquire summaries: the locks a call into f can end up
+    # taking, any depth down the resolved call graph. Deadlock edges need
+    # the closure — "record_event -> recorder.event -> with self._lock" is
+    # two frames deep and very much a real runtime edge (the fleet smoke's
+    # tracker observed exactly that before this was transitive).
+    may_acquire: Dict[str, set] = {
+        k: {lock for lock, _ in f.acquires} for k, f in functions.items()}
+    changed = True
+    while changed:
+        changed = False
+        for key, info in functions.items():
+            acc = may_acquire[key]
+            for callee, _, _ in info.calls:
+                extra = may_acquire[callee] - acc
+                if extra:
+                    acc |= extra
+                    changed = True
+
+    # edge propagation: caller holds L at a call whose closure may
+    # acquire M -> edge L -> M at the call site
+    edges: Dict[Tuple[str, str, str], Edge] = {}
+    for info in functions.values():
+        for e in info.edges:
+            edges.setdefault((e.src, e.dst, e.site), e)
+        for callee, line, held in info.calls:
+            if not held:
+                continue
+            for lock in may_acquire[callee]:
+                for h in held:
+                    if h != lock:
+                        e = Edge(h, lock, f"{info.path}::{info.qualname}",
+                                 line)
+                        edges.setdefault((e.src, e.dst, e.site), e)
+
+    # guarded-field map: direct locked writes + one-call-deep (a helper's
+    # bare writes guarded by the caller's held lock)
+    guarded: Dict[str, Dict[str, set]] = {}
+
+    def class_key_of(info: FuncInfo) -> Optional[str]:
+        return f"{info.path}::{info.cls}" if info.cls else None
+
+    for info in functions.values():
+        ckey = class_key_of(info)
+        if ckey is None:
+            continue
+        for acc in info.accesses:
+            if acc.kind == "w" and acc.held:
+                fields = guarded.setdefault(ckey, {})
+                fields.setdefault(acc.field, set()).update(acc.held)
+        for callee, _, held in info.calls:
+            if not held:
+                continue
+            tinfo = functions[callee]
+            tckey = class_key_of(tinfo)
+            if tckey is None:
+                continue
+            for acc in tinfo.accesses:
+                if acc.kind == "w" and not acc.held:
+                    fields = guarded.setdefault(tckey, {})
+                    fields.setdefault(acc.field, set()).update(held)
+
+    # guarded fields flow down the hierarchy too: a subclass method reading
+    # a base-guarded field bare is the same race, so the subclass's map is
+    # the union of its own and every (resolvable) ancestor's
+    def _ancestor_keys(scan: _ClassScan, out: List[str]) -> None:
+        for bname in scan.bases:
+            bkey = class_keys.get(bname)
+            if bkey is not None and bkey not in out:
+                out.append(bkey)
+                _ancestor_keys(scans_by_key[bkey], out)
+
+    for key, scan in scans_by_key.items():
+        ancestors: List[str] = []
+        _ancestor_keys(scan, ancestors)
+        for akey in ancestors:
+            for field, lks in guarded.get(akey, {}).items():
+                guarded.setdefault(key, {}).setdefault(field, set()).update(lks)
+
+    # thread entries: explicit targets + every method literally named run
+    thread_entries: Dict[str, ThreadDef] = {}
+    for t in threads:
+        if t.target is not None and t.target in functions:
+            thread_entries.setdefault(t.target, t)
+    for key, info in functions.items():
+        if info.cls and info.qualname.endswith(".run") \
+                and info.qualname.count(".") == 1:
+            thread_entries.setdefault(key, ThreadDef(
+                info.path, info.line, key, True, True, key, None))
+
+    return SyncModel(
+        locks=locks,
+        functions=functions,
+        guarded={k: {f: frozenset(v) for f, v in fields.items()}
+                 for k, fields in guarded.items()},
+        edges=sorted(edges.values(),
+                     key=lambda e: (e.src, e.dst, e.site, e.line)),
+        thread_entries=thread_entries,
+        threads=threads,
+        class_keys={n: k for n, k in class_keys.items() if k is not None},
+    )
+
+
+def build_repo_model(repo_root: str = REPO_ROOT,
+                     paths: Optional[Sequence[str]] = None) -> SyncModel:
+    import os
+    files = []
+    for rel in (paths if paths is not None else sync_files(repo_root)):
+        with open(os.path.join(repo_root, rel), encoding="utf-8") as fh:
+            files.append((rel, fh.read()))
+    return build_model(files)
+
+
+# --------------------------------------------------------------------------
+# lock-graph utilities
+# --------------------------------------------------------------------------
+
+def find_cycles(edges: Iterable[Edge]) -> List[List[Edge]]:
+    """Elementary cycles in the acquisition graph, each as its edge list
+    (both/all acquisition sites named). Deduped by node set."""
+    adj: Dict[str, List[Edge]] = {}
+    for e in edges:
+        adj.setdefault(e.src, []).append(e)
+    cycles: List[List[Edge]] = []
+    seen_sets = set()
+
+    def dfs(start: str, node: str, path: List[Edge], on_path: set) -> None:
+        for e in adj.get(node, []):
+            if e.dst == start:
+                key = frozenset(x.src for x in path + [e])
+                if key not in seen_sets:
+                    seen_sets.add(key)
+                    cycles.append(path + [e])
+            elif e.dst not in on_path and e.dst > start:
+                # only expand nodes ordered after start: each cycle is
+                # discovered exactly once, from its smallest node
+                on_path.add(e.dst)
+                dfs(start, e.dst, path + [e], on_path)
+                on_path.discard(e.dst)
+
+    for start in sorted(adj):
+        dfs(start, start, [], {start})
+    return cycles
+
+
+# --------------------------------------------------------------------------
+# waivers
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class SyncWaiver:
+    rule: str
+    reason: str
+    line: int
+
+
+def collect_waivers(source: str, rel_path: str, known_rules: Sequence[str]
+                    ) -> Tuple[List[SyncWaiver], List[str]]:
+    """(waivers, problems) from real comment tokens of one file. A waiver
+    applies to findings of its rule on its own line or the line below
+    (comment-above placement, graftlint-style)."""
+    waivers: List[SyncWaiver] = []
+    problems: List[str] = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError):
+        return waivers, problems
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _WAIVER_RE.search(tok.string)
+        if not m:
+            continue
+        rule, reason = m.group(1), (m.group(2) or "").strip()
+        if rule not in known_rules:
+            problems.append(
+                f"{rel_path}:{tok.start[0]}: unknown graftsync rule "
+                f"'{rule}' in waiver (known: {', '.join(known_rules)})")
+            continue
+        if not reason:
+            problems.append(
+                f"{rel_path}:{tok.start[0]}: graftsync waiver for "
+                f"'{rule}' has no reason — write "
+                f"'# graftsync: allow={rule} -- <why>'")
+            continue
+        waivers.append(SyncWaiver(rule, reason, tok.start[0]))
+    return waivers, problems

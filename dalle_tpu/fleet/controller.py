@@ -291,7 +291,8 @@ class FleetController:
         with self._lock:
             pending, self._pending_drains = self._pending_drains, []
         for replica_id, reason in pending:
-            rp = self._procs.get(replica_id)
+            with self._lock:
+                rp = self._procs.get(replica_id)
             if rp is not None:
                 self._drain_replica(rp, reason)
         if (self.drain_repeat_ratio is not None

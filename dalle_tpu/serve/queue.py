@@ -186,12 +186,25 @@ class RequestQueue:
                 out.append(self._q.popleft())
         return out
 
-    def wait_nonempty(self, timeout: Optional[float] = None) -> bool:
+    def wait_nonempty(self, timeout: Optional[float] = None,
+                      _poll_s: float = 0.5) -> bool:
         """Block until a request is queued or the queue is closed. Returns
-        True when a request is available."""
+        True when a request is available.
+
+        Every park is bounded by ``_poll_s`` and re-checks the predicate:
+        drain must not rely on close()'s final notify — a producer/closer
+        thread that dies before notifying (or a close() the interpreter
+        never reaches during teardown) degrades to one poll interval of
+        extra latency here, never an unbounded hang."""
+        deadline = None if timeout is None else time.monotonic() + timeout
         with self._cond:
-            self._cond.wait_for(lambda: self._q or self._closed,
-                                timeout=timeout)
+            while not (self._q or self._closed):
+                remaining = _poll_s
+                if deadline is not None:
+                    remaining = min(_poll_s, deadline - time.monotonic())
+                    if remaining <= 0:
+                        break
+                self._cond.wait(remaining)
             return bool(self._q)
 
     def close(self) -> None:

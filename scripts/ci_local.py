@@ -72,6 +72,23 @@ def run_precision_audit_stage() -> int:
     return subprocess.run(cmd, cwd=ROOT).returncode
 
 
+def run_sync_audit_stage() -> int:
+    """The graftsync stage: the whole-module static concurrency model over
+    the threaded control plane — guarded-field/lockset violations,
+    acquisition-order cycles, blocking calls under a lock, thread-lifecycle
+    hygiene — plus drift of the lock-acquisition graph against the golden
+    in contracts/sync.json (scripts/sync_audit.py; the workflow's matching
+    step is skipped below). Waivers are '# graftsync: allow=<rule> -- why'
+    source comments. Report + findings + SARIF land in ./sync_artifacts —
+    the dir ci.yml uploads. The runtime half runs inside the gateway/fleet
+    smokes (obs/lockorder.py cross-checks the observed graph)."""
+    cmd = [sys.executable, os.path.join(ROOT, "scripts", "sync_audit.py"),
+           "--check", "--report", os.path.join(ROOT, "sync_artifacts")]
+    print(f"== [graftsync] {' '.join(cmd[1:])}")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(cmd, cwd=ROOT, env=env).returncode
+
+
 def run_obs_smoke_stage() -> int:
     """The grafttrace + host-overlap + graftpulse smoke stage: a short
     synthetic traced fit (device prefetch + async checkpointing + deferred
@@ -202,6 +219,17 @@ def main():
               "not run")
         return 1
 
+    rc = run_sync_audit_stage()
+    if rc == 3:
+        print("ci_local: FAILED (graftsync golden lock graph MISSING — "
+              "run scripts/sync_audit.py --update and commit "
+              "contracts/sync.json) — test tiers not run")
+        return 1
+    if rc != 0:
+        print("ci_local: FAILED (graftsync concurrency findings / lock-"
+              "graph drift) — test tiers not run")
+        return 1
+
     if run_obs_smoke_stage() != 0:
         print("ci_local: FAILED (observability smoke) — test tiers not run")
         return 1
@@ -241,6 +269,9 @@ def main():
             continue
         if "scripts/precision_audit.py" in cmd:
             print(f"-- [skip] {name}: already run in the graftnum stage")
+            continue
+        if "scripts/sync_audit.py" in cmd:
+            print(f"-- [skip] {name}: already run in the graftsync stage")
             continue
         if "scripts/obs_smoke.py" in cmd:
             print(f"-- [skip] {name}: already run in the obs smoke stage")

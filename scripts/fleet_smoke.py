@@ -98,6 +98,12 @@ def main(argv=None):
     import numpy as np
 
     from dalle_tpu import obs
+    from dalle_tpu.obs import lockorder
+
+    # graftsync runtime half: every dalle_tpu lock created from here on is
+    # instrumented; the end of the smoke asserts the acquisition order this
+    # real run exhibited is acyclic and within the static golden
+    lockorder.install()
     from dalle_tpu.chaos.faults import Fault, FaultPlan
     from dalle_tpu.config import DalleConfig
     from dalle_tpu.fleet import FleetController, FleetManager
@@ -528,9 +534,36 @@ def main(argv=None):
               "obs_report renders the DEGRADE verdict naming the wedged "
               "response")
 
+        # graftsync cross-check: the lock-acquisition order this real
+        # multi-threaded run exhibited must be acyclic and a subgraph of
+        # the static golden (contracts/sync.json)
+        from dalle_tpu.analysis.sync_flow import build_repo_model
+        obs_edges = lockorder.observed_edges()
+        check(not lockorder.cycles(),
+              f"observed lock-acquisition graph acyclic "
+              f"({len(obs_edges)} edges over "
+              f"{len(lockorder.observed_sites())} locks)")
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        site_to_id = build_repo_model(root).lock_by_site()
+        with open(os.path.join(root, "contracts", "sync.json")) as fh:
+            golden_edges = {(d["src"], d["dst"])
+                            for d in json.load(fh)["edges"]}
+        unknown = [lockorder.format_edge(e) for e in obs_edges
+                   if e.src not in site_to_id or e.dst not in site_to_id]
+        mapped = {(site_to_id[e.src], site_to_id[e.dst]) for e in obs_edges
+                  if e.src in site_to_id and e.dst in site_to_id}
+        extra = sorted(f"{s} -> {d}" for s, d in mapped - golden_edges)
+        check(not unknown and not extra,
+              "observed lock graph ⊆ static golden (unknown locks: "
+              f"{unknown or 'none'}; edges beyond golden: "
+              f"{extra or 'none'})")
+
         summary = {
             "burst0": {"offered": n0, "completed": len(ok0),
                        "rps": len(ok0) / wall0[0]},
+            "lock_sites_observed": len(lockorder.observed_sites()),
+            "lock_edges_observed": [lockorder.format_edge(e)
+                                    for e in obs_edges],
             "burst1": {"offered": n1, "completed": len(ok1),
                        "rps": len(ok1) / wall1},
             "warm_backend_compiles_delta":

@@ -36,10 +36,15 @@ def main(argv=None):
                          "wide rules still run when their triggers changed")
     ap.add_argument("--select", help="comma-separated rule names to run")
     ap.add_argument("--ignore", help="comma-separated rule names to skip")
+    ap.add_argument("--format", choices=("text", "sarif"), default="text",
+                    help="finding output format (sarif: a SARIF 2.1.0 "
+                         "document on stdout for GitHub PR annotation; "
+                         "the text summary moves to stderr)")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
     from dalle_tpu.analysis import RULES, run_lint
+    from dalle_tpu.analysis.core import to_sarif
 
     if args.list_rules:
         width = max(len(n) for n in RULES)
@@ -78,11 +83,21 @@ def main(argv=None):
         )
     except RuntimeError as e:   # e.g. --changed-only with git unavailable
         sys.exit(f"lint.py: {e}")
-    for f in findings:
-        print(f)
     n = len(findings)
     scope = "changed files" if args.changed_only else "repo"
-    print(f"graftlint: {n} finding{'s' if n != 1 else ''} ({scope})")
+    summary = f"graftlint: {n} finding{'s' if n != 1 else ''} ({scope})"
+    if args.format == "sarif":
+        import json
+        print(json.dumps(to_sarif(
+            findings, "graftlint",
+            {name: r.description for name, r in RULES.items()}), indent=1))
+        for f in findings:
+            print(f, file=sys.stderr)
+        print(summary, file=sys.stderr)
+    else:
+        for f in findings:
+            print(f)
+        print(summary)
     return 1 if findings else 0
 
 

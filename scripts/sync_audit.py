@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""graftsync CLI — static concurrency audit of the threaded control plane.
+
+    python scripts/sync_audit.py --check            # CI gate (default)
+    python scripts/sync_audit.py --update           # regenerate the golden
+    python scripts/sync_audit.py --explain          # print the lock model
+    python scripts/sync_audit.py --list-rules
+    python scripts/sync_audit.py --check --format sarif > sync.sarif
+    python scripts/sync_audit.py --check --report sync_artifacts
+
+--check builds the whole-module concurrency model (lock inventory,
+guarded-field map, lock-acquisition graph, thread entries) over the sync
+roots and fails on: rule findings (lockset violations, acquisition-order
+cycles, blocking calls under a lock, lifecycle hygiene), waiver problems,
+or drift of the acquisition graph against the golden in contracts/sync.json.
+Intentional lock/edge changes are accepted with --update (commit the JSON
+diff — it is the PR's reviewable locking story). The runtime half is
+dalle_tpu/obs/lockorder.py: gateway_smoke/fleet_smoke record the OBSERVED
+acquisition graph and assert it is acyclic and a subgraph of this golden.
+
+Waivers are source comments on the finding's line or the line above
+(``# graftsync: allow=blocking-under-lock -- <reason>``); see
+docs/ANALYSIS.md.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+# pure-AST analysis — but the analysis package import pulls jax via the
+# vmem rule; keep it on CPU so auditing never touches an accelerator
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument("--check", action="store_true",
+                      help="findings + golden drift (default)")
+    mode.add_argument("--update", action="store_true",
+                      help="regenerate the golden lock graph")
+    mode.add_argument("--explain", action="store_true",
+                      help="pretty-print the live concurrency model")
+    ap.add_argument("--contract",
+                    default=os.path.join(ROOT, "contracts", "sync.json"),
+                    help="golden path (default: contracts/sync.json)")
+    ap.add_argument("--format", choices=("text", "sarif"), default="text",
+                    help="finding output format (sarif: a SARIF 2.1.0 "
+                         "document on stdout for GitHub PR annotation)")
+    ap.add_argument("--report", metavar="DIR",
+                    help="write report.txt + findings.json + sync.sarif "
+                         "into DIR (CI artifact)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    from dalle_tpu.analysis import rules_sync as R
+    from dalle_tpu.analysis.core import to_sarif
+
+    if args.list_rules:
+        width = max(len(n) for n in R.SYNC_RULES)
+        for name, desc in sorted(R.SYNC_RULES.items()):
+            print(f"{name:<{width}}  {desc}")
+        return 0
+
+    if args.explain:
+        report = R.audit(ROOT, args.contract, update=False)
+        print(R.explain(report.model))
+        return 0
+
+    report = R.audit(ROOT, args.contract, update=bool(args.update))
+    scope = (f"{len(report.model.locks)} locks, "
+             f"{len(report.model.edges)} edges, "
+             f"{len(report.model.thread_entries)} thread entries")
+    text = R.render_report(report, scope)
+    if args.format == "sarif":
+        print(json.dumps(to_sarif(report.findings, "graftsync",
+                                  R.SYNC_RULES), indent=1))
+        print(text, file=sys.stderr)
+    else:
+        print(text)
+
+    if args.report:
+        os.makedirs(args.report, exist_ok=True)
+        with open(os.path.join(args.report, "report.txt"), "w",
+                  encoding="utf-8") as fh:
+            fh.write(text + "\n")
+        with open(os.path.join(args.report, "findings.json"), "w",
+                  encoding="utf-8") as fh:
+            json.dump({"findings": [vars(f) for f in report.findings],
+                       "waived": [{**vars(f), "reason": r}
+                                  for f, r in report.waived],
+                       "problems": report.problems,
+                       "drift": report.drift}, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        with open(os.path.join(args.report, "sync.sarif"), "w",
+                  encoding="utf-8") as fh:
+            json.dump(to_sarif(report.findings, "graftsync", R.SYNC_RULES),
+                      fh, indent=1)
+            fh.write("\n")
+
+    # distinct exit codes, graftir-style: 1 = findings/waiver problems/
+    # graph drift (a regression); 3 = ONLY a missing golden (first run —
+    # needs --update, not a code change)
+    if report.failed:
+        return 1
+    if report.missing:
+        print("sync_audit: exit 3 — golden lock graph MISSING; run "
+              "scripts/sync_audit.py --update and commit contracts/sync.json")
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -436,8 +436,12 @@ def test_registry_entries_have_goldens_and_valid_schema():
         assert golden["schema"] == A.SCHEMA
         assert golden["entry"] == name
         assert golden["primitives"], name
-    # and no orphaned goldens for entries that no longer exist
+    # and no orphaned goldens for entries that no longer exist (sync.json
+    # is the graftsync lock-graph golden, not a graftir entry contract —
+    # tests/test_sync_flow.py owns its schema)
     for fname in os.listdir(cdir):
+        if fname == "sync.json":
+            continue
         assert fname.removesuffix(".json") in C.ENTRIES, fname
 
 

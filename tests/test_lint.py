@@ -664,6 +664,32 @@ def test_changed_files_follows_renames(tmp_path):
     assert "steady.py" in changed
 
 
+def test_changed_files_includes_untracked(tmp_path):
+    import subprocess
+    from dalle_tpu.analysis.core import changed_files
+
+    def git(*args):
+        subprocess.run(["git", *args], cwd=tmp_path, check=True,
+                       capture_output=True)
+
+    git("init", "-q")
+    git("config", "user.email", "t@t")
+    git("config", "user.name", "t")
+    (tmp_path / "committed.py").write_text("x = 1\n")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    # a brand-new module with NO git add yet: `git diff HEAD` alone never
+    # reports it, so a fresh file would sail through --changed-only unlinted
+    (tmp_path / "brand_new.py").write_text("import jax\n")
+    (tmp_path / "sub").mkdir()
+    (tmp_path / "sub" / "nested_new.py").write_text("y = 2\n")
+    changed = changed_files(repo_root=str(tmp_path))
+    assert "brand_new.py" in changed
+    assert "sub/nested_new.py" in changed
+    # the committed, unmodified file stays out of the changed scope
+    assert "committed.py" not in changed
+
+
 # ---------------------------------------------------------------------------
 # hardcoded-dtype
 # ---------------------------------------------------------------------------

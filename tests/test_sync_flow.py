@@ -1,0 +1,575 @@
+"""graftsync — the static concurrency model, its rules, the golden
+lock-graph workflow, and the runtime lock-order tracker.
+
+Fixture style mirrors test_lint.py: small synthetic sources fed through
+``build_model``, plus repo-level invariants (the tree stays sync-clean;
+the committed golden matches the live model) so every rule here is
+enforced on the real control plane, not just the fixtures.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from dalle_tpu.analysis import rules_sync
+from dalle_tpu.analysis.sync_flow import (
+    build_model, build_repo_model, find_cycles,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(ROOT, "contracts", "sync.json")
+
+
+def model_of(src, path="dalle_tpu/serve/_fix.py"):
+    return build_model([(path, textwrap.dedent(src))])
+
+
+def findings_of(src, rule, path="dalle_tpu/serve/_fix.py"):
+    return [f for f in rules_sync.run_sync(model_of(src, path))
+            if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# guarded-field inference + the lockset rule
+# ---------------------------------------------------------------------------
+
+WORKER = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+            self._t = threading.Thread(target=self._run, daemon=True)
+
+        def push(self, x):
+            with self._lock:
+                self._items.append(x)
+
+        def _run(self):
+            while True:
+                n = len(self._items)
+    """
+
+
+def test_guarded_field_inferred_from_locked_write():
+    model = model_of(WORKER)
+    guards = model.guarded["dalle_tpu/serve/_fix.py::Worker"]
+    assert "_items" in guards
+    assert guards["_items"] == frozenset(
+        {"dalle_tpu/serve/_fix.py::Worker._lock"})
+    # the lock attribute itself is never "data"
+    assert "_lock" not in guards
+
+
+def test_bare_read_from_thread_entry_flagged():
+    found = findings_of(WORKER, "unguarded-field")
+    assert len(found) == 1
+    assert "Worker._items" in found[0].message
+    assert "read" in found[0].message
+    assert "_run" in found[0].message
+
+
+def test_locked_read_from_thread_entry_clean():
+    src = WORKER.replace(
+        "            while True:\n"
+        "                n = len(self._items)",
+        "            while True:\n"
+        "                with self._lock:\n"
+        "                    n = len(self._items)")
+    assert findings_of(src, "unguarded-field") == []
+
+
+def test_unlocked_helper_called_from_entry_flagged():
+    # the entry itself is clean; a same-class helper it calls lock-free
+    # runs on the entry's thread and writes the guarded field bare
+    src = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+            self._t = threading.Thread(target=self._run, daemon=True)
+
+        def push(self, x):
+            with self._lock:
+                self._items.append(x)
+
+        def _drain(self):
+            self._items.clear()
+
+        def _run(self):
+            self._drain()
+    """
+    found = findings_of(src, "unguarded-field")
+    assert len(found) == 1 and "written" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# lock-order cycles (the injected-inversion acceptance fixture)
+# ---------------------------------------------------------------------------
+
+def test_injected_inversion_reports_cycle_with_both_sites():
+    src = """
+    import threading
+
+    _a = threading.Lock()
+    _b = threading.Lock()
+
+    def forward():
+        with _a:
+            with _b:
+                pass
+
+    def backward():
+        with _b:
+            with _a:
+                pass
+    """
+    model = model_of(src, "dalle_tpu/serve/_inv.py")
+    cycles = find_cycles(model.edges)
+    assert len(cycles) == 1
+    found = [f for f in rules_sync.run_sync(model)
+             if f.rule == "lock-order-cycle"]
+    assert len(found) == 1
+    # BOTH acquisition sites named, file::function form
+    assert "dalle_tpu/serve/_inv.py::forward" in found[0].message
+    assert "dalle_tpu/serve/_inv.py::backward" in found[0].message
+
+
+def test_transitive_acquisition_closes_the_cycle():
+    # backward()'s second acquire hides two calls deep — the may-acquire
+    # closure (not one-call-deep propagation) must still see the inversion
+    src = """
+    import threading
+
+    _a = threading.Lock()
+    _b = threading.Lock()
+
+    def forward():
+        with _a:
+            with _b:
+                pass
+
+    def _leaf():
+        with _a:
+            pass
+
+    def _mid():
+        _leaf()
+
+    def backward():
+        with _b:
+            _mid()
+    """
+    model = model_of(src, "dalle_tpu/serve/_deep.py")
+    assert any(e.src.endswith("::_b") and e.dst.endswith("::_a")
+               and e.site.endswith("::backward") for e in model.edges)
+    assert len(find_cycles(model.edges)) == 1
+
+
+def test_consistent_order_is_acyclic():
+    src = """
+    import threading
+
+    _a = threading.Lock()
+    _b = threading.Lock()
+
+    def one():
+        with _a:
+            with _b:
+                pass
+
+    def two():
+        with _a:
+            with _b:
+                pass
+    """
+    model = model_of(src)
+    assert model.edges and find_cycles(model.edges) == []
+    assert findings_of(src, "lock-order-cycle") == []
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock
+# ---------------------------------------------------------------------------
+
+def test_sleep_under_lock_flagged():
+    src = """
+    import threading
+    import time
+
+    class Poller:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def tick(self):
+            with self._lock:
+                time.sleep(1.0)
+    """
+    found = findings_of(src, "blocking-under-lock")
+    assert len(found) == 1
+    assert "time.sleep" in found[0].message
+    assert "_lock" in found[0].message
+
+
+def test_condition_wait_releases_own_lock_not_flagged():
+    # Condition.wait parks with its OWN lock released; only a second,
+    # still-held lock makes the wait a blocking hazard
+    src = """
+    import threading
+
+    class Q:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cond = threading.Condition(self._lock)
+
+        def pop(self):
+            with self._cond:
+                while True:
+                    self._cond.wait()
+    """
+    assert findings_of(src, "blocking-under-lock") == []
+
+
+def test_condition_wait_under_second_lock_flagged():
+    src = """
+    import threading
+
+    class Q:
+        def __init__(self):
+            self._other = threading.Lock()
+            self._lock = threading.Lock()
+            self._cond = threading.Condition(self._lock)
+
+        def pop(self):
+            with self._other:
+                with self._cond:
+                    while True:
+                        self._cond.wait()
+    """
+    found = findings_of(src, "blocking-under-lock")
+    assert len(found) == 1 and "_other" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# lifecycle hygiene
+# ---------------------------------------------------------------------------
+
+def test_non_daemon_unjoined_thread_flagged():
+    src = """
+    import threading
+
+    class Svc:
+        def start(self):
+            self._t = threading.Thread(target=self._run)
+            self._t.start()
+
+        def _run(self):
+            pass
+    """
+    found = findings_of(src, "thread-no-join")
+    assert len(found) == 1 and "no join" in found[0].message
+
+
+def test_daemon_or_joined_threads_clean():
+    src = """
+    import threading
+
+    class Svc:
+        def start(self):
+            self._d = threading.Thread(target=self._run, daemon=True)
+            self._j = threading.Thread(target=self._run)
+
+        def stop(self):
+            self._j.join(timeout=5)
+
+        def _run(self):
+            pass
+    """
+    assert findings_of(src, "thread-no-join") == []
+
+
+def test_cond_wait_outside_predicate_loop_flagged():
+    src = """
+    import threading
+
+    class Q:
+        def __init__(self):
+            self._cond = threading.Condition()
+
+        def bad(self):
+            with self._cond:
+                self._cond.wait(1.0)
+
+        def good(self):
+            with self._cond:
+                while True:
+                    self._cond.wait(1.0)
+    """
+    found = findings_of(src, "cond-wait-no-predicate")
+    assert len(found) == 1
+    assert "outside a while loop" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# waivers (through the full audit pipeline on a tmp repo)
+# ---------------------------------------------------------------------------
+
+SLEEPER = """\
+import threading
+import time
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def tick(self):
+        with self._lock:
+            {comment}
+            time.sleep(0.1)
+"""
+
+
+def _tmp_audit(tmp_path, source, update=False):
+    (tmp_path / "mod.py").write_text(source)
+    return rules_sync.audit(repo_root=str(tmp_path),
+                            contract_path=str(tmp_path / "sync.json"),
+                            update=update, paths=["mod.py"])
+
+
+def test_waiver_with_reason_suppresses_finding(tmp_path):
+    src = SLEEPER.format(
+        comment="# graftsync: allow=blocking-under-lock -- "
+                "bounded 100ms, per-instance lock")
+    report = _tmp_audit(tmp_path, src)
+    assert report.findings == [] and report.problems == []
+    assert len(report.waived) == 1
+    finding, reason = report.waived[0]
+    assert finding.rule == "blocking-under-lock"
+    assert "bounded 100ms" in reason
+
+
+def test_waiver_without_reason_is_a_problem(tmp_path):
+    src = SLEEPER.format(comment="# graftsync: allow=blocking-under-lock")
+    report = _tmp_audit(tmp_path, src)
+    assert report.failed
+    assert any("has no reason" in p for p in report.problems)
+    # the un-excused finding survives
+    assert len(report.findings) == 1
+
+
+def test_waiver_with_unknown_rule_is_a_problem(tmp_path):
+    src = SLEEPER.format(
+        comment="# graftsync: allow=blocking-underlock -- typo'd rule")
+    report = _tmp_audit(tmp_path, src)
+    assert report.failed
+    assert any("unknown graftsync rule" in p for p in report.problems)
+
+
+# ---------------------------------------------------------------------------
+# golden lock-graph workflow
+# ---------------------------------------------------------------------------
+
+NESTED = """\
+import threading
+
+_a = threading.Lock()
+_b = threading.Lock()
+
+def one():
+    with _a:
+        with _b:
+            pass
+"""
+
+
+def test_golden_roundtrip_then_drift(tmp_path):
+    report = _tmp_audit(tmp_path, NESTED, update=True)
+    assert report.updated and not report.failed
+    assert (tmp_path / "sync.json").exists()
+
+    # unchanged source: clean check, no drift
+    report = _tmp_audit(tmp_path, NESTED)
+    assert not report.failed and not report.missing
+    assert report.drift == []
+
+    # a new nested acquisition drifts the graph with a named edge
+    report = _tmp_audit(tmp_path, NESTED + textwrap.dedent("""
+        def two():
+            with _b:
+                with _a:
+                    pass
+    """))
+    assert report.failed
+    assert any(d.startswith("+ edge") and "two" in d for d in report.drift)
+
+    # a removed lock drifts too
+    report = _tmp_audit(tmp_path, "import threading\n_a = threading.Lock()\n")
+    assert report.failed
+    assert any(d.startswith("- lock") for d in report.drift)
+
+
+def test_missing_golden_is_distinct_from_drift(tmp_path):
+    report = _tmp_audit(tmp_path, NESTED)
+    assert report.missing and not report.failed
+
+
+def _run_audit_cli(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "sync_audit.py"),
+         *args],
+        cwd=ROOT, capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+
+def test_cli_exit_codes_missing_vs_drift(tmp_path):
+    # missing golden: the distinct exit 3 (needs --update, not a code fix)
+    r = _run_audit_cli("--check", "--contract",
+                       str(tmp_path / "nope.json"))
+    assert r.returncode == 3, r.stdout + r.stderr
+    assert "MISSING" in r.stdout
+
+    # doctored golden (one edge dropped): real drift, exit 1
+    golden = json.load(open(GOLDEN))
+    assert golden["edges"], "repo golden has no edges to doctor"
+    doctored = dict(golden, edges=golden["edges"][1:])
+    doctored_path = tmp_path / "doctored.json"
+    doctored_path.write_text(json.dumps(doctored))
+    r = _run_audit_cli("--check", "--contract", str(doctored_path))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "lock-graph drift: + edge" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# repo-level invariants
+# ---------------------------------------------------------------------------
+
+def test_repo_is_sync_clean():
+    """The real control plane carries no unwaived graftsync findings and
+    matches the committed golden — the same invariant ci_local's graftsync
+    stage and the ci.yml step enforce (mirrors test_repo_is_lint_clean)."""
+    report = rules_sync.audit(repo_root=ROOT, contract_path=GOLDEN)
+    msgs = [str(f) for f in report.findings] \
+        + [f"waiver-problem: {p}" for p in report.problems] \
+        + [f"drift: {d}" for d in report.drift]
+    assert not report.missing, "golden contracts/sync.json missing"
+    assert not report.failed, "\n".join(msgs)
+
+
+def test_repo_lock_graph_is_acyclic():
+    model = build_repo_model(ROOT)
+    assert find_cycles(model.edges) == []
+
+
+def test_golden_edges_reference_known_locks():
+    golden = json.load(open(GOLDEN))
+    lock_ids = {l["id"] for l in golden["locks"]}
+    for e in golden["edges"]:
+        assert e["src"] in lock_ids and e["dst"] in lock_ids
+    # every golden lock is resolvable to a creation site by the live
+    # model — the join key the smokes' runtime cross-check depends on
+    by_site = build_repo_model(ROOT).lock_by_site()
+    assert set(by_site.values()) == lock_ids
+
+
+# ---------------------------------------------------------------------------
+# runtime tracker (obs/lockorder.py)
+# ---------------------------------------------------------------------------
+
+FAKE_MODULE = """\
+import threading
+
+A = threading.Lock()
+B = threading.Lock()
+R = threading.RLock()
+
+def nest_ab():
+    with A:
+        with B:
+            pass
+
+def nest_ba():
+    with B:
+        with A:
+            pass
+
+def reenter():
+    with R:
+        with R:
+            pass
+"""
+
+
+def test_lockorder_tracker_records_edges_and_cycles(tmp_path):
+    from dalle_tpu.obs import lockorder
+    # locks "created from dalle_tpu code": compile the fixture with a
+    # filename under <tmp>/dalle_tpu/ and install with <tmp> as the root
+    fname = os.path.join(str(tmp_path), "dalle_tpu", "fake.py")
+    ns = {}
+    lockorder.install(repo_root=str(tmp_path))
+    try:
+        exec(compile(FAKE_MODULE, fname, "exec"), ns)
+        assert len(lockorder.observed_sites()) == 3
+        # a lock created OUTSIDE dalle_tpu/ stays a real primitive
+        import threading
+        outside = threading.Lock()
+        assert not isinstance(outside, lockorder._TrackedLock)
+
+        ns["nest_ab"]()
+        edges = lockorder.observed_edges()
+        assert len(edges) == 1
+        assert edges[0].src[0] == "dalle_tpu/fake.py"
+        assert lockorder.cycles() == []
+
+        # RLock re-entry is not an ordering fact
+        ns["reenter"]()
+        assert len(lockorder.observed_edges()) == 1
+
+        # the inversion closes the cycle — what the smokes assert against
+        ns["nest_ba"]()
+        assert len(lockorder.observed_edges()) == 2
+        cyc = lockorder.cycles()
+        assert len(cyc) == 1 and len(cyc[0]) == 2
+    finally:
+        lockorder.uninstall()
+    assert not lockorder.installed()
+
+
+def test_lockorder_condition_wraps_tracked_lock(tmp_path):
+    from dalle_tpu.obs import lockorder
+    src = """\
+import threading
+
+class Q:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._other = threading.Lock()
+
+    def use(self):
+        with self._other:
+            with self._cond:
+                pass
+"""
+    fname = os.path.join(str(tmp_path), "dalle_tpu", "cond.py")
+    ns = {}
+    lockorder.install(repo_root=str(tmp_path))
+    try:
+        exec(compile(src, fname, "exec"), ns)
+        q = ns["Q"]()
+        q.use()
+        edges = lockorder.observed_edges()
+        # Condition(self._lock) acquires the WRAPPED lock: the edge is
+        # _other -> _lock, keyed by both locks' creation sites
+        assert len(edges) == 1
+        src_site, dst_site = edges[0].src, edges[0].dst
+        assert src_site[0] == dst_site[0] == "dalle_tpu/cond.py"
+        assert src_site[1] > dst_site[1]  # _other created after _lock
+        with q._cond:
+            q._cond.notify_all()          # full Condition protocol works
+    finally:
+        lockorder.uninstall()
