@@ -21,6 +21,16 @@ a review comment:
     ...}`` is flagged via its value, not its name, so a bounded value under
     an unfortunate key stays legal.
 
+  * ``histogram-unbounded-buckets`` — a ``histogram_observe`` call whose
+    ``buckets`` argument is data-derived (computed at the call site rather
+    than a literal or a module-level ALL_CAPS constant) or a literal with
+    more than ``MAX_HISTOGRAM_BUCKETS`` (32) bounds. A native histogram is
+    one series PER BUCKET per family (``_bucket{le=}``): data-derived
+    bounds re-register the family with whatever the data says this time —
+    trace.py rejects a mismatch at runtime, but only on the code path that
+    runs — and oversized bucket lists multiply every scrape and every
+    fleet merge. Bounds belong in one named module constant.
+
 Syntactic by design (the rules_jit trade): the denylist names the
 identifiers this codebase uses for request-scoped data; a genuinely bounded
 value that happens to share a name takes a one-line suppression next to the
@@ -97,3 +107,77 @@ class UnboundedMetricLabel(Rule):
                     "cardinality; record per-request values as span args "
                     "or flight-recorder events (obs.record_span/"
                     "record_event) and aggregate into unlabeled gauges")
+
+
+# mirrors obs/trace.py MAX_HISTOGRAM_BUCKETS — duplicated here on purpose:
+# the linter must not import the runtime module it audits
+_MAX_HISTOGRAM_BUCKETS = 32
+
+
+def _literal_len(node: ast.expr) -> Optional[int]:
+    """Element count when ``node`` is a tuple/list of constants (a literal
+    bucket boundary list), else None."""
+    if isinstance(node, (ast.Tuple, ast.List)) and \
+            all(isinstance(e, ast.Constant) for e in node.elts):
+        return len(node.elts)
+    return None
+
+
+def _is_named_constant(node: ast.expr) -> bool:
+    """A bare ALL_CAPS name (or attribute, e.g. ``trace.DEFAULT_BUCKETS``)
+    — the sanctioned way to share bucket bounds across call sites."""
+    if isinstance(node, ast.Name):
+        return node.id.isupper()
+    if isinstance(node, ast.Attribute):
+        return node.attr.isupper()
+    return False
+
+
+@register_rule
+class HistogramUnboundedBuckets(Rule):
+    name = "histogram-unbounded-buckets"
+    description = ("histogram_observe buckets argument is data-derived "
+                   "(computed at the call site) or a literal with more "
+                   "than 32 bounds — each bound is a _bucket{le=} series "
+                   "per family and derived bounds re-register the family "
+                   "differently per code path; use one module-level "
+                   "ALL_CAPS constant with <=32 sorted bounds")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            fname = fn.attr if isinstance(fn, ast.Attribute) else \
+                fn.id if isinstance(fn, ast.Name) else None
+            if fname != "histogram_observe":
+                continue
+            # buckets is keyword-or-positional: histogram_observe(name,
+            # value, buckets=...) — positional index 2 must not evade
+            buckets = next((kw.value for kw in node.keywords
+                            if kw.arg == "buckets"), None)
+            if buckets is None and len(node.args) >= 3:
+                buckets = node.args[2]
+            if buckets is None:   # default bounds — always fine
+                continue
+            if isinstance(buckets, ast.Constant) and buckets.value is None:
+                continue          # explicit buckets=None, same thing
+            n = _literal_len(buckets)
+            if n is not None:
+                if n > _MAX_HISTOGRAM_BUCKETS:
+                    yield Finding(
+                        self.name, ctx.rel_path, node.lineno,
+                        f"histogram_observe registers {n} bucket bounds "
+                        f"(max {_MAX_HISTOGRAM_BUCKETS}) — every bound is "
+                        "a _bucket{le=} series in every scrape and every "
+                        "fleet merge; thin the boundary list")
+                continue
+            if _is_named_constant(buckets):
+                continue
+            yield Finding(
+                self.name, ctx.rel_path, node.lineno,
+                "histogram_observe buckets are data-derived (computed at "
+                "the call site, not a literal or ALL_CAPS module "
+                "constant) — bounds must be identical at every observe "
+                "or the family re-registers inconsistently across code "
+                "paths; hoist them into one named module-level constant")

@@ -162,8 +162,17 @@ class FleetManager:
                  heartbeat_s: float = 0.25, max_missed: int = 3,
                  progress_timeout_s: float = 0.0,
                  env: Optional[Dict[str, str]] = None,
-                 log_dir: Optional[str] = None):
+                 log_dir: Optional[str] = None,
+                 telemetry_dir: Optional[str] = None,
+                 collector=None):
         self.argv = list(argv)
+        # graftlens plumbing: with a telemetry_dir every spawn gets
+        # --telemetry_dir (serve_replica keys a subdir by replica_id), and
+        # with a TelemetryCollector every spawn is registered as a source —
+        # RPC fetch through its RemoteReplica (whose heartbeats feed the
+        # clock-offset estimate) plus the on-disk dir that survives SIGKILL.
+        self.telemetry_dir = telemetry_dir
+        self.collector = collector
         self.warm_pool = int(warm_pool)
         self.spawn_timeout_s = float(spawn_timeout_s)
         self.heartbeat_s = float(heartbeat_s)
@@ -197,6 +206,9 @@ class FleetManager:
         but NOT yet attached to any router."""
         rid = replica_id or self._next_id()
         argv = self.argv + ["--port", "0", "--replica_id", rid]
+        if self.telemetry_dir is not None and \
+                "--telemetry_dir" not in self.argv:
+            argv += ["--telemetry_dir", self.telemetry_dir]
         env = dict(os.environ)
         env.update(self.env)
         env.update(extra_env or {})
@@ -246,6 +258,11 @@ class FleetManager:
         rp = ReplicaProcess(proc, shake, remote)
         with self._lock:
             self._all.append(rp)
+        if self.collector is not None:
+            path = (os.path.join(self.telemetry_dir, rid)
+                    if self.telemetry_dir is not None else None)
+            self.collector.add_source(rid, fetch=remote.fetch_telemetry,
+                                      path=path, clock=remote.clock)
         counter_add("fleet.spawned_total", 1.0)
         record_event("replica_spawned", replica_id=rid, pid=rp.pid,
                      addr=shake["addr"],

@@ -59,6 +59,7 @@ import numpy as np
 
 from ..degrade.detector import frozen_progress
 from ..obs import counter_add, record_event
+from ..obs.collect import ClockOffsetEstimator, telemetry_payload
 from ..serve.queue import QueueFull
 from ..utils.retry import RetryBudgetExceeded, retry
 
@@ -383,7 +384,13 @@ class RemoteReplica:
         self._missed = 0
         self._closed = False
         self._draining = False
+        # graftlens: every health/telemetry exchange doubles as one NTP-style
+        # clock sample — t0/t1 wrap the RPC, the reply carries server_time,
+        # so the offset is bounded by half the observed round trip
+        self.clock = ClockOffsetEstimator()
+        t0 = time.time()
         first = call(addr, {"verb": "health"}, timeout=dial_timeout)
+        self._observe_clock(t0, first)
         self._last_health = first
         self.replica_id = (replica_id if replica_id is not None
                            else str(first.get("replica_id", addr)))
@@ -393,14 +400,21 @@ class RemoteReplica:
         self._hb.start()
 
     # -- liveness ----------------------------------------------------------
+    def _observe_clock(self, t0: float, reply: dict) -> None:
+        server_time = reply.get("server_time")
+        if server_time is not None:
+            self.clock.observe(t0, float(server_time), time.time())
+
     def _beat(self):
         while not self._closed:
             time.sleep(self.heartbeat_s)
             if self._closed:
                 return
             try:
+                t0 = time.time()
                 h = call(self.addr, {"verb": "health"},
                          timeout=self.probe_timeout, dialer=dial_fast)
+                self._observe_clock(t0, h)
             except (RetryBudgetExceeded, TransportError, OSError):
                 with self._lock:
                     self._missed += 1
@@ -493,6 +507,20 @@ class RemoteReplica:
                  missed_heartbeats=self.missed_heartbeats,
                  healthy=self.healthy, draining=self._draining)
         return h
+
+    # -- telemetry (graftlens) ---------------------------------------------
+    def fetch_telemetry(self, since_seq: int = 0) -> dict:
+        """Pull one telemetry flush over the live RPC (spans after
+        ``since_seq``, metrics snapshot, recorder events). The exchange is
+        also a clock sample — telemetry pulls tighten the offset bound for
+        free. Raises on a dead replica; the collector falls back to the
+        replica's on-disk telemetry dir."""
+        t0 = time.time()
+        reply = call(self.addr, {"verb": "telemetry",
+                                 "since_seq": int(since_seq)},
+                     timeout=self.probe_timeout, dialer=dial_fast)
+        self._observe_clock(t0, reply)
+        return reply
 
     # -- submission --------------------------------------------------------
     @staticmethod
@@ -656,6 +684,8 @@ class ReplicaServer:
                 self._handle_group(conn, msg)
             elif verb == "health":
                 send_frame(conn, self._health())
+            elif verb == "telemetry":
+                send_frame(conn, self._telemetry(msg))
             elif verb == "drain":
                 self._handle_drain(conn, msg)
             else:
@@ -669,12 +699,21 @@ class ReplicaServer:
                 pass
 
     # -- verbs -------------------------------------------------------------
+    def _telemetry(self, msg: dict) -> dict:
+        """graftlens pull: this process's spans (after the caller's
+        cursor), full metrics snapshot, and recorder events, stamped with
+        ``server_time``/``replica_id`` — the fleet collector's RPC source."""
+        reply = telemetry_payload(int(msg.get("since_seq", 0)))
+        reply["replica_id"] = getattr(self.replica, "replica_id", None)
+        return reply
+
     def _health(self) -> dict:
         from ..obs import metrics_snapshot
         h = self.replica.health()
         snap = metrics_snapshot()
         h.update(
             ok=True, pid=os.getpid(),
+            server_time=time.time(),   # graftlens clock-offset sample
             requests_served=self.requests_served,
             uptime_s=time.time() - self.started_at,
             backend_compiles=(self.compile_counter.count

@@ -31,14 +31,17 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 import numpy as np
 
-from ..obs import (BurnRateSentry, counter_add, dump_recorder, gauge_set,
+from ..obs import (BurnRateSentry, counter_add, dump_recorder,
+                   exemplars_snapshot, gauge_set, histogram_observe,
                    metrics_snapshot, record_event, render_textfile, span,
                    trace_context)
+from ..obs.collect import TelemetryCollector, UsageLedger
 from ..obs.context import new_trace_id
 from ..serve.queue import QueueFull
 from .admission import AdmissionController
@@ -73,7 +76,16 @@ class Gateway:
                  vae=None, clip=None, pipeline=None,
                  image_fmap_size: Optional[int] = None,
                  image_seq_len: Optional[int] = None,
-                 slo_sentry: Optional[BurnRateSentry] = None):
+                 slo_sentry: Optional[BurnRateSentry] = None,
+                 collector: Optional[TelemetryCollector] = None,
+                 usage_log: Optional[str] = None):
+        # graftlens: a collector turns GET /metrics into the FLEET view
+        # (remote counters summed, gauges labeled {replica=}); without one
+        # the endpoint renders the local registry exactly as before.
+        self.collector = collector
+        # per-tenant metering ledger (append-only JSONL, atomic rotation);
+        # None keeps metering as counters only
+        self.usage = UsageLedger(usage_log) if usage_log else None
         self.router = router
         self.admission = (admission if admission is not None
                           else AdmissionController())
@@ -205,7 +217,15 @@ def _make_handler(gw: Gateway):
                 self._json(code, health)
             elif self.path == "/metrics":
                 gauge_set("gateway.inflight", float(gw.inflight))
-                body = render_textfile(metrics_snapshot()).encode()
+                snap = metrics_snapshot()
+                if gw.collector is not None:
+                    # fleet aggregation (graftlens): refresh every remote
+                    # source, then fold its counters/histogram buckets into
+                    # the local registry (gauges get {replica=} labels)
+                    gw.collector.poll()
+                    snap = gw.collector.fleet_metrics(snap)
+                body = render_textfile(
+                    snap, exemplars=exemplars_snapshot()).encode()
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  "text/plain; version=0.0.4")
@@ -262,6 +282,8 @@ def _make_handler(gw: Gateway):
                                  "detail": repr(exc)})
                 return
             tenant = str(body.get("tenant", "default"))
+            self._usage_ctx = {"tenant": tenant, "kind": "generate",
+                               "tokens_in": int(text.shape[0]), "images": 0}
             req_tokens = (int(max_tokens) if max_tokens
                           else gw.image_seq_len)
 
@@ -355,8 +377,57 @@ def _make_handler(gw: Gateway):
                     n = (len(toks[0]) if payload.get("candidates")
                          else len(toks))
                     gw.admission.slo.observe(n, float(dec))
+                # graftlens: every engine-request completion this door
+                # observed, counted once per candidate — the fleet
+                # invariant gateway_smoke asserts is
+                # sum(serve.requests_completed_total over replicas)
+                # == gateway.completed_total
+                cands = payload.get("candidates")
+                completions = float(len(cands)) if cands else 1.0
+                counter_add("gateway.completed_total", completions)
+                if payload.get("ttft_s") is not None:
+                    histogram_observe("gateway.ttft_seconds",
+                                      float(payload["ttft_s"]))
+                self._meter_usage(payload, completions)
             else:
                 gw.slo_sentry.record(False, payload.get("reason", "error"))
+
+        def _meter_usage(self, payload: dict, completions: float) -> None:
+            """Per-tenant usage accounting for one completed request:
+            live ``usage.*_total{tenant=}`` counters (tenant is a bounded
+            label — quota config names the set) plus one ledger line when
+            the gateway has a metering log. ``queue_wait_s`` bills the
+            pre-decode wall time (queue + prefill: latency minus the
+            replica-measured decode slot time)."""
+            ctx = getattr(self, "_usage_ctx", None)
+            if ctx is None:
+                return
+            tenant = ctx["tenant"]
+            cands = payload.get("candidates")
+            tokens_out = (sum(len(c) for c in cands) if cands
+                          else len(payload.get("tokens") or ()))
+            latency = float(payload.get("latency_s") or 0.0)
+            decode_s = float(payload.get("decode_s") or 0.0)
+            queue_wait = max(0.0, latency - decode_s)
+            labels = {"tenant": tenant}
+            counter_add("usage.tokens_in_total",
+                        float(ctx["tokens_in"]), labels=labels)
+            counter_add("usage.tokens_out_total",
+                        float(tokens_out), labels=labels)
+            counter_add("usage.queue_wait_s_total", queue_wait,
+                        labels=labels)
+            if ctx.get("images"):
+                counter_add("usage.images_total",
+                            float(ctx["images"]), labels=labels)
+            if gw.usage is not None:
+                gw.usage.append({
+                    "ts": time.time(), "tenant": tenant,
+                    "kind": ctx["kind"], "trace_id": self._trace_id,
+                    "tokens_in": int(ctx["tokens_in"]),
+                    "tokens_out": int(tokens_out),
+                    "images": int(ctx.get("images", 0)),
+                    "queue_wait_s": round(queue_wait, 6),
+                    "completions": completions})
 
         def _blocking(self, routed, deadline_s):
             for kind, payload in routed.events():
@@ -451,6 +522,9 @@ def _make_handler(gw: Gateway):
                                  "detail": repr(exc)})
                 return
             tenant = str(body.get("tenant", "default"))
+            self._usage_ctx = {"tenant": tenant, "kind": "images",
+                               "tokens_in": int(text.shape[0]),
+                               "images": n_cand}
             seeds = [seed + i for i in range(n_cand)]
             per_cand = (int(max_tokens) if max_tokens
                         else gw.image_seq_len)

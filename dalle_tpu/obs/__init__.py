@@ -14,6 +14,9 @@ global ``None`` check until ``configure()`` enables tracing
 from .anomaly import (Breach, CodebookCollapseDetector, GradExplosionDetector,
                       HealthSentry, LossSpikeDetector, NaNPrecursorDetector,
                       split_health_key)
+from .collect import (ClockOffsetEstimator, TelemetryCollector,
+                      TelemetryExporter, UsageLedger, read_telemetry_dir,
+                      telemetry_payload)
 from .context import current_trace_id, new_trace_id, trace_context
 from .prometheus import render_textfile, sanitize_metric_name, write_textfile
 from .recorder import (FlightRecorder, collect_state, configure_recorder,
@@ -23,10 +26,12 @@ from .recorder import (FlightRecorder, collect_state, configure_recorder,
 from .report import (format_request_timeline, request_timeline,
                      span_overhead_s, summarize_run)
 from .slo import BurnRateSentry
-from .trace import (Tracer, configure, counter_add, disable, enabled,
-                    export_chrome_trace, export_spans_jsonl, gauge_set,
-                    get_tracer, labeled_name, metrics_snapshot, open_spans,
-                    record_span, span)
+from .trace import (DEFAULT_BUCKETS, MAX_HISTOGRAM_BUCKETS, Tracer,
+                    configure, counter_add, disable, enabled,
+                    exemplars_snapshot, export_chrome_trace,
+                    export_spans_jsonl, gauge_set, get_tracer,
+                    histogram_observe, labeled_name, metrics_snapshot,
+                    open_spans, record_span, span)
 from .watchdog import StallReport, StallWatchdog
 
 _DEVICE_NAMES = ("CompileCounter", "DeviceTelemetry", "device_memory_stats",
@@ -43,6 +48,8 @@ __all__ = [
     "Breach", "CodebookCollapseDetector", "GradExplosionDetector",
     "HealthSentry", "LossSpikeDetector", "NaNPrecursorDetector",
     "split_health_key",
+    "ClockOffsetEstimator", "TelemetryCollector", "TelemetryExporter",
+    "UsageLedger", "read_telemetry_dir", "telemetry_payload",
     "current_trace_id", "new_trace_id", "trace_context",
     "render_textfile", "sanitize_metric_name", "write_textfile",
     "FlightRecorder", "collect_state", "configure_recorder",
@@ -50,10 +57,11 @@ __all__ = [
     "install_signal_dump", "record_event", "register_state_provider",
     "unregister_state_provider", "format_request_timeline",
     "request_timeline", "span_overhead_s", "summarize_run",
-    "BurnRateSentry", "Tracer", "configure", "counter_add", "disable",
-    "enabled", "export_chrome_trace", "export_spans_jsonl", "gauge_set",
-    "get_tracer", "labeled_name", "metrics_snapshot", "open_spans",
-    "record_span", "span", "StallReport", "StallWatchdog",
+    "BurnRateSentry", "DEFAULT_BUCKETS", "MAX_HISTOGRAM_BUCKETS", "Tracer",
+    "configure", "counter_add", "disable", "enabled", "exemplars_snapshot",
+    "export_chrome_trace", "export_spans_jsonl", "gauge_set",
+    "get_tracer", "histogram_observe", "labeled_name", "metrics_snapshot",
+    "open_spans", "record_span", "span", "StallReport", "StallWatchdog",
 ]
 
 

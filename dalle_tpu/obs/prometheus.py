@@ -47,13 +47,27 @@ def sanitize_metric_name(name: str, prefix: str = "dalle_") -> str:
 
 
 def render_textfile(metrics: dict, *, prefix: str = "dalle_",
-                    timestamp: Optional[float] = None) -> str:
+                    timestamp: Optional[float] = None,
+                    exemplars: Optional[dict] = None) -> str:
     """Prometheus text exposition format for a flat {name: number} dict.
-    Non-numeric values are skipped (the format has no string samples)."""
+    Non-numeric values are skipped (the format has no string samples).
+
+    Histograms arrive pre-flattened (obs/trace.py): cumulative
+    ``name_bucket{le="b"}`` samples plus ``name_sum``/``name_count``. The
+    renderer recognizes a ``_bucket`` family, emits ONE
+    ``# TYPE name histogram`` header for the whole triple, and suppresses
+    the counter/gauge headers the ``_sum``/``_count`` samples would
+    otherwise get — lexical sort order (``_bucket`` < ``_count`` < ``_sum``)
+    guarantees the histogram header precedes every sample of its family.
+    ``exemplars`` maps a *registry* bucket key to ``(trace_id, value, ts)``;
+    matching bucket samples get an OpenMetrics exemplar suffix
+    (``# {trace_id="..."} value ts``) linking the bucket to one request
+    timeline."""
     lines = []
     ts = time.time() if timestamp is None else timestamp
     lines.append(f"# grafttrace export, unix_time={ts:.3f}")
     typed = set()
+    hist_bases = set()
     for name in sorted(metrics):
         v = metrics[name]
         if isinstance(v, bool):
@@ -62,6 +76,12 @@ def render_textfile(metrics: dict, *, prefix: str = "dalle_",
             continue
         pname = sanitize_metric_name(name, prefix)
         family = pname.partition("{")[0]
+        if family.endswith("_bucket"):
+            base = family[:-len("_bucket")]
+            if base not in hist_bases:
+                hist_bases.add(base)
+                typed.update((family, base + "_sum", base + "_count"))
+                lines.append(f"# TYPE {base} histogram")
         if family not in typed:
             # one TYPE line per family: labeled series of one metric sort
             # adjacently (the label block follows the shared name), so the
@@ -69,7 +89,13 @@ def render_textfile(metrics: dict, *, prefix: str = "dalle_",
             typed.add(family)
             mtype = "counter" if family.endswith("_total") else "gauge"
             lines.append(f"# TYPE {family} {mtype}")
-        lines.append(f"{pname} {v}")
+        sample = f"{pname} {v}"
+        ex = exemplars.get(name) if exemplars else None
+        if ex is not None:
+            trace_id, ex_value, ex_ts = ex
+            sample += (f' # {{trace_id="{trace_id}"}} '
+                       f"{ex_value} {ex_ts:.3f}")
+        lines.append(sample)
     return "\n".join(lines) + "\n"
 
 

@@ -131,6 +131,12 @@ class FlightRecorder:
                 self.events_dropped += 1
             self.events.append(rec)
 
+    def snapshot_events(self) -> List[dict]:
+        """Copy of the lifecycle-event ring (locked) — the telemetry
+        exporter's (graftlens) input for per-process ``events.jsonl``."""
+        with self._lock:
+            return list(self.events)
+
     def _sample_loop(self, interval_s: float) -> None:
         while not self._stop.wait(interval_s):
             sample = {"t": time.time(), "state": collect_state()}

@@ -161,7 +161,13 @@ def request_timeline(rows: List[dict], request: str) -> List[dict]:
         ts = s.get("ts", s.get("rel_s", 0.0))
         out.append({"name": s["name"], "t_rel_s": ts - t0,
                     "dur_s": float(s["dur_s"]), "ts": ts,
-                    "tid": s.get("tid"), "args": s.get("args")})
+                    "tid": s.get("tid"), "args": s.get("args"),
+                    # graftlens cross-process join: merged spans carry the
+                    # source process plus the clock-mapping uncertainty the
+                    # collector estimated for it (obs/collect.py)
+                    "proc": s.get("proc"),
+                    "clock_bound_s": s.get("clock_bound_s"),
+                    "clock_drift": s.get("clock_drift")})
     return out
 
 
@@ -175,15 +181,33 @@ def format_request_timeline(rows: List[dict], request: str) -> str:
     span_total = sum(e["dur_s"] for e in tl)
     end = max(e["t_rel_s"] + e["dur_s"] for e in tl)
     threads = sorted({str(e["tid"]) for e in tl})
-    lines = [f"== request {request}: {len(tl)} spans across "
-             f"{len(threads)} thread(s), wall {end:.4g}s "
-             f"(span time {span_total:.4g}s)"]
-    lines.append(f"  {'t+ (s)':>10} {'dur (s)':>10} {'tid':>16}  name")
+    procs = sorted({str(e["proc"]) for e in tl if e.get("proc")})
+    head = (f"== request {request}: {len(tl)} spans across "
+            f"{len(threads)} thread(s)")
+    if procs:
+        # the graftlens headline: one timeline spanning gateway thread →
+        # remote replica → failover target, joined across process clocks
+        head += f" in {len(procs)} process(es)"
+    head += f", wall {end:.4g}s (span time {span_total:.4g}s)"
+    lines = [head]
+    bounds = [e["clock_bound_s"] for e in tl
+              if e.get("clock_bound_s") is not None]
+    if bounds:
+        note = (f"  (cross-process clocks aligned via RPC offset "
+                f"estimation; worst offset bound ±{max(bounds):.4g}s — "
+                f"ordering within that window is approximate)")
+        if any(e.get("clock_drift") for e in tl):
+            note += " [CLOCK DRIFT flagged on ≥1 process]"
+        lines.append(note)
+    proc_col = f" {'proc':>14} " if procs else " "
+    lines.append(f"  {'t+ (s)':>10} {'dur (s)':>10}{proc_col}"
+                 f"{'tid':>16}  name")
     for e in tl:
         extra = {k: v for k, v in (e["args"] or {}).items()
                  if k not in ("trace_id", "request_id")}
-        lines.append(f"  {e['t_rel_s']:>10.4f} {e['dur_s']:>10.4f} "
-                     f"{str(e['tid']):>16}  {e['name']}"
+        pcol = f" {str(e.get('proc') or '-'):>14} " if procs else " "
+        lines.append(f"  {e['t_rel_s']:>10.4f} {e['dur_s']:>10.4f}"
+                     f"{pcol}{str(e['tid']):>16}  {e['name']}"
                      + (f" {extra}" if extra else ""))
     return "\n".join(lines)
 
@@ -201,6 +225,132 @@ _DEGRADE_ACTION_RE = re.compile(
     r'^degrade\.actions_total\{reason="([^"]+)"\}$')
 _DEGRADE_PAGE_RE = re.compile(
     r'^degrade\.pages_total\{reason="([^"]+)"\}$')
+_HIST_BUCKET_RE = re.compile(
+    r'^(?P<base>[\w.]+)_bucket\{(?:[^}]*,)?le="(?P<le>[^"]+)"(?:,[^}]*)?\}$')
+_USAGE_RE = re.compile(
+    r'^usage\.(?P<what>\w+)_total\{tenant="(?P<tenant>(?:[^"\\]|\\.)*)"\}$')
+
+
+def _bucket_quantile(bounds: List[float], cums: List[float],
+                     q: float) -> Optional[float]:
+    """Quantile by linear interpolation over CUMULATIVE bucket counts —
+    the Prometheus ``histogram_quantile`` estimate, computed from the
+    flattened ``X_bucket{le=}`` series rather than raw samples (raw
+    samples never leave the process; the buckets do). ``bounds`` are the
+    finite upper bounds in ascending order and ``cums`` the matching
+    cumulative counts with the +Inf count appended last."""
+    total = cums[-1]
+    if total <= 0:
+        return None
+    target = q * total
+    prev_bound, prev_cum = 0.0, 0.0
+    for i, cum in enumerate(cums):
+        if cum >= target:
+            if i >= len(bounds):       # landed in the +Inf bucket: the
+                return prev_bound      # last finite bound is the floor
+            bound = bounds[i]
+            if cum <= prev_cum:
+                return bound
+            frac = (target - prev_cum) / (cum - prev_cum)
+            return prev_bound + (bound - prev_bound) * frac
+        prev_cum = cum
+        if i < len(bounds):
+            prev_bound = bounds[i]
+    return prev_bound
+
+
+def histogram_accounting(metrics: List[dict]) -> Optional[List[dict]]:
+    """graftlens native histograms → quantiles. Scans metrics records for
+    flattened ``X_bucket{le="..."}`` families (obs/trace.py emits them
+    cumulatively, so the LAST record carrying a family is its final
+    state; fleet-merged snapshots sum bucket-by-bucket upstream of here)
+    and renders p50/p95 **from the buckets**, never from raw samples.
+    Returns ``None`` when no record carries a bucket key — untouched runs
+    keep their report byte-identical."""
+    fams: dict = {}               # base -> {le_str: count}
+    extras: dict = {}             # base -> {"sum": v, "count": v}
+    for r in metrics:
+        for key, val in r.items():
+            m = _HIST_BUCKET_RE.match(key)
+            if m:
+                fams.setdefault(m.group("base"), {})[m.group("le")] = \
+                    float(val)
+    if not fams:
+        return None
+    for r in metrics:
+        for base in fams:
+            if f"{base}_sum" in r:
+                extras.setdefault(base, {})["sum"] = float(r[f"{base}_sum"])
+            if f"{base}_count" in r:
+                extras.setdefault(base, {})["count"] = \
+                    float(r[f"{base}_count"])
+    out = []
+    for base in sorted(fams):
+        les = fams[base]
+        bounds = sorted(float(le) for le in les if le != "+Inf")
+        cums = [les[k] for k in sorted(
+            (k for k in les if k != "+Inf"), key=float)]
+        if "+Inf" in les:
+            cums.append(les["+Inf"])
+        if not cums:
+            continue
+        count = extras.get(base, {}).get("count", cums[-1])
+        total = extras.get(base, {}).get("sum")
+        out.append({
+            "name": base, "count": count, "sum": total,
+            "mean": (total / count) if total is not None and count else None,
+            "p50": _bucket_quantile(bounds, cums, 0.50),
+            "p95": _bucket_quantile(bounds, cums, 0.95)})
+    return out or None
+
+
+def usage_accounting(metrics: List[dict]) -> Optional[dict]:
+    """Per-tenant usage totals from the graftlens metering counters
+    (``usage.{tokens_in,tokens_out,images,queue_wait_s}_total{tenant=}``,
+    gateway/server.py ``_meter_usage``). Counters are cumulative, so the
+    last value seen per key is the total. ``None`` when no record carries
+    a usage key."""
+    tenants: dict = {}
+    for r in metrics:
+        for key, val in r.items():
+            m = _USAGE_RE.match(key)
+            if m:
+                t = tenants.setdefault(m.group("tenant"), {})
+                t[m.group("what")] = float(val)
+    if not tenants:
+        return None
+    return {"tenants": tenants}
+
+
+def telemetry_accounting(metrics: List[dict],
+                         spans: List[dict]) -> Optional[dict]:
+    """graftlens telemetry-plane health: how many processes contributed
+    spans to this report, how many sources the collector polled, and —
+    the part that must be LOUD — whether any ring overflowed and dropped
+    data (``obs.spans_dropped_total`` / ``obs.events_dropped_total``).
+    A lossy plane silently understates everything else in the report, so
+    the verdict leads with LOSSY. ``None`` when neither a dropped counter
+    nor a merged-span ``proc`` tag nor a collector gauge is present."""
+    spans_dropped = events_dropped = 0.0
+    sources = None
+    for r in metrics:
+        if "obs.spans_dropped_total" in r:
+            spans_dropped = max(spans_dropped,
+                                float(r["obs.spans_dropped_total"]))
+        if "obs.events_dropped_total" in r:
+            events_dropped = max(events_dropped,
+                                 float(r["obs.events_dropped_total"]))
+        if "fleet.telemetry_sources" in r:
+            sources = float(r["fleet.telemetry_sources"])
+    procs = sorted({str(s["proc"]) for s in spans if s.get("proc")})
+    if not procs and sources is None and not spans_dropped \
+            and not events_dropped:
+        return None
+    lossy = bool(spans_dropped or events_dropped)
+    return {"procs": procs, "sources": sources,
+            "spans_dropped": spans_dropped,
+            "events_dropped": events_dropped, "lossy": lossy,
+            "verdict": "LOSSY" if lossy else "complete"}
 
 
 def degrade_accounting(metrics: List[dict]) -> Optional[dict]:
@@ -375,7 +525,11 @@ def fleet_accounting(metrics: List[dict]) -> Optional[dict]:
     ``fleet.state`` posture gauge (0 steady / 1 scaling / 2 draining).
     ``None`` when no record carries a fleet key — single-process serving
     keeps its report unchanged."""
-    rows = [r for r in metrics if any(k.startswith("fleet.") for k in r)]
+    # fleet.telemetry_sources is the graftlens collector's gauge, not a
+    # controller signal — alone it must not conjure an empty fleet section
+    rows = [r for r in metrics
+            if any(k.startswith("fleet.")
+                   and k != "fleet.telemetry_sources" for k in r)]
     if not rows:
         return None
     last = rows[-1]
@@ -521,6 +675,31 @@ def format_report(rows: List[dict], *, topk: int = 10) -> str:
                 + f"; queue wait p50={fmt_num(gw['qwait_p50_s'], suffix='s')}"
                   f" p95={fmt_num(gw['qwait_p95_s'], suffix='s')}"
                 + f" → {gw['verdict']}")
+        hg = histogram_accounting(metrics)
+        if hg is not None:
+            lines.append(f"== latency histograms (graftlens): "
+                         f"{len(hg)} native families — quantiles from "
+                         f"buckets, not raw samples")
+            for h in hg:
+                lines.append(
+                    f"  {h['name']:<28} n={h['count']:<7.0f}"
+                    f" mean={fmt_num(h['mean'], suffix='s')}"
+                    f" p50={fmt_num(h['p50'], suffix='s')}"
+                    f" p95={fmt_num(h['p95'], suffix='s')}")
+        us = usage_accounting(metrics)
+        if us is not None:
+            lines.append(f"== usage metering (graftlens): "
+                         f"{len(us['tenants'])} tenant(s) → USAGE: metered")
+            lines.append(f"  {'tenant':<16}{'tokens_in':>11}"
+                         f"{'tokens_out':>12}{'images':>8}"
+                         f"{'queue_wait_s':>14}")
+            for tenant in sorted(us["tenants"]):
+                t = us["tenants"][tenant]
+                lines.append(
+                    f"  {tenant:<16}{t.get('tokens_in', 0):>11.0f}"
+                    f"{t.get('tokens_out', 0):>12.0f}"
+                    f"{t.get('images', 0):>8.0f}"
+                    f"{t.get('queue_wait_s', 0):>14.4g}")
         im = images_accounting(metrics, spans)
         if im is not None:
             parts = [f"{im['requests']:.0f} requests, "
@@ -593,6 +772,25 @@ def format_report(rows: List[dict], *, topk: int = 10) -> str:
                        if hl["verdict"] == "DEGRADED" else "MODEL-HEALTH: ok")
             lines.append("== model health (graftpulse): "
                          + ", ".join(parts) + f" → {verdict}")
+    tel = telemetry_accounting(metrics, spans)
+    if tel is not None:
+        parts = []
+        if tel["procs"]:
+            parts.append(f"spans from {len(tel['procs'])} process(es)")
+        if tel["sources"] is not None:
+            parts.append(f"{tel['sources']:.0f} source(s) polled")
+        if tel["lossy"]:
+            # the callout the ISSUE demands be impossible to miss: a ring
+            # overflowed, so every count above this line is a FLOOR
+            lines.append(
+                f"== WARNING: TELEMETRY LOSSY — "
+                f"spans_dropped={tel['spans_dropped']:.0f} "
+                f"events_dropped={tel['events_dropped']:.0f} "
+                f"(ring overflow: raise capacity or shorten the flush "
+                f"interval; counts in this report are floors)")
+        lines.append("== telemetry plane (graftlens): "
+                     + (", ".join(parts) if parts else "no sources")
+                     + f" → TELEMETRY: {tel['verdict']}")
     if spans:
         lines.append(f"== spans by total time ({len(spans)} spans)")
         lines.append(f"  {'name':<32}{'count':>7}{'total_s':>10}{'mean_s':>10}"

@@ -45,6 +45,45 @@ _TLS = threading.local()
 _STACKS: dict = {}          # thread ident -> (thread name, open-span stack)
 _tracer: Optional["Tracer"] = None
 
+# Native histogram discipline (graftlens): bucket boundaries are declared at
+# the call site (or defaulted), never derived from observed data, and capped
+# so one histogram can never explode the registry — the same bounded-
+# cardinality rule unbounded-metric-label enforces for label values.
+MAX_HISTOGRAM_BUCKETS = 32
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+def _fmt_le(bound: float) -> str:
+    return format(bound, "g")
+
+
+def _bucket_key(key: str, le: str) -> str:
+    """Flat registry key for one cumulative bucket: ``name_bucket{le="x"}``,
+    merging ``le`` into an existing sorted label block when the histogram
+    itself is labeled."""
+    base, brace, rest = key.partition("{")
+    if not brace:
+        return f'{base}_bucket{{le="{le}"}}'
+    items = rest[:-1].split(",")
+    items.append(f'le="{le}"')
+    items.sort()
+    return f'{base}_bucket{{{",".join(items)}}}'
+
+
+class _Histogram:
+    """One native histogram: fixed boundaries, per-bucket counts, sum/count,
+    and the latest (trace_id, value, ts) exemplar per bucket."""
+
+    __slots__ = ("buckets", "counts", "sum", "count", "exemplars")
+
+    def __init__(self, buckets):
+        self.buckets = tuple(buckets)
+        self.counts = [0] * (len(self.buckets) + 1)   # last = +Inf overflow
+        self.sum = 0.0
+        self.count = 0
+        self.exemplars: dict = {}                     # bucket idx -> exemplar
+
 
 def _stack() -> list:
     s = getattr(_TLS, "stack", None)
@@ -67,7 +106,9 @@ class Tracer:
         self.spans: deque = deque(maxlen=capacity)
         self.counters: dict = {}
         self.gauges: dict = {}
+        self.histograms: dict = {}   # labeled name -> _Histogram
         self.dropped = 0          # spans evicted from the ring (never silent)
+        self.total_recorded = 0   # monotonic span count (telemetry cursors)
         self._lock = threading.Lock()
         self.t_origin = time.perf_counter()
         self.epoch_origin = time.time()
@@ -79,6 +120,7 @@ class Tracer:
         with self._lock:
             if len(self.spans) == self.spans.maxlen:
                 self.dropped += 1
+            self.total_recorded += 1
             self.spans.append((name, t0 - self.t_origin, dur,
                                threading.get_ident(), depth, args))
 
@@ -86,13 +128,56 @@ class Tracer:
         with self._lock:
             return list(self.spans)
 
+    def spans_since(self, since_seq: int = 0):
+        """Incremental span read for the telemetry exporter: every span in
+        the ring carries an implicit monotonic sequence number (position in
+        ``total_recorded`` order); returns ``(cursor, rows)`` where rows are
+        the raw span tuples recorded after ``since_seq`` and ``cursor`` is
+        the value to pass next time. Spans that overflowed the ring before a
+        pull are gone (counted in ``dropped``) — the cursor still advances
+        past them, so a slow puller never re-reads or wedges."""
+        with self._lock:
+            total = self.total_recorded
+            rows = list(self.spans)
+        first_seq = total - len(rows) + 1
+        skip = max(0, since_seq - first_seq + 1)
+        return total, rows[skip:]
+
     def snapshot_metrics(self) -> dict:
-        """Counters + gauges as one flat dict (copied under the lock)."""
+        """Counters + gauges + flattened histograms as one flat dict (copied
+        under the lock). Histograms flatten to the Prometheus native-
+        histogram spelling — cumulative ``name_bucket{le="b"}`` counters
+        plus ``name_sum`` / ``name_count`` — so every existing consumer
+        (MetricsLogger, the textfile exporter, obs_report, the fleet
+        collector's counter merge) handles them with no schema change."""
         with self._lock:
             out = dict(self.counters)
             out.update(self.gauges)
+            for key, h in self.histograms.items():
+                running = 0
+                for i, bound in enumerate(h.buckets):
+                    running += h.counts[i]
+                    out[_bucket_key(key, _fmt_le(bound))] = float(running)
+                out[_bucket_key(key, "+Inf")] = float(h.count)
+                out[f"{key}_sum"] = h.sum
+                out[f"{key}_count"] = float(h.count)
         if self.dropped:
             out["obs.spans_dropped"] = self.dropped
+            out["obs.spans_dropped_total"] = float(self.dropped)
+        return out
+
+    def snapshot_exemplars(self) -> dict:
+        """Latest (trace_id, value, unix_ts) exemplar per histogram bucket,
+        keyed by the same flat ``name_bucket{le="b"}`` key the metrics
+        snapshot emits — obs/prometheus.py renders these as OpenMetrics
+        ``# {trace_id="..."} value ts`` exemplar suffixes."""
+        out = {}
+        with self._lock:
+            for key, h in self.histograms.items():
+                for idx, ex in h.exemplars.items():
+                    le = (_fmt_le(h.buckets[idx]) if idx < len(h.buckets)
+                          else "+Inf")
+                    out[_bucket_key(key, le)] = ex
         return out
 
 
@@ -233,10 +318,69 @@ def gauge_set(name: str, value: float,
         tr.gauges[name] = float(value)
 
 
-def metrics_snapshot() -> dict:
-    """Current counters+gauges ({} when tracing is disabled)."""
+def histogram_observe(name: str, value: float,
+                      buckets: Optional[tuple] = None,
+                      labels: Optional[dict] = None,
+                      trace_id: Optional[str] = None) -> None:
+    """Observe one sample into a native histogram (TTFT, queue wait, decode
+    step, chunk prefill — the latency shapes a single gauge cannot carry).
+    ``buckets`` fixes the boundaries on first observation (default
+    ``DEFAULT_BUCKETS``; must be sorted, ≤ ``MAX_HISTOGRAM_BUCKETS`` — the
+    histogram-unbounded-buckets lint enforces that they are also *literals*,
+    never data-derived). The sample's trace_id (explicit, else the thread's
+    ambient one) is kept as the bucket's exemplar, so a p95 spike on a
+    dashboard links straight back to one request timeline. No-op when
+    tracing is off."""
     tr = _tracer
-    return tr.snapshot_metrics() if tr is not None else {}
+    if tr is None:
+        return
+    if trace_id is None:
+        trace_id = current_trace_id()
+    key = labeled_name(name, labels)
+    value = float(value)
+    with tr._lock:
+        h = tr.histograms.get(key)
+        if h is None:
+            bounds = tuple(buckets) if buckets is not None else DEFAULT_BUCKETS
+            if len(bounds) > MAX_HISTOGRAM_BUCKETS:
+                raise ValueError(
+                    f"histogram {name!r}: {len(bounds)} buckets exceeds "
+                    f"MAX_HISTOGRAM_BUCKETS={MAX_HISTOGRAM_BUCKETS}")
+            if list(bounds) != sorted(bounds):
+                raise ValueError(f"histogram {name!r}: buckets not sorted")
+            h = tr.histograms[key] = _Histogram(bounds)
+        idx = len(h.buckets)
+        for i, bound in enumerate(h.buckets):
+            if value <= bound:
+                idx = i
+                break
+        h.counts[idx] += 1
+        h.sum += value
+        h.count += 1
+        if trace_id is not None:
+            h.exemplars[idx] = (trace_id, value, time.time())
+
+
+def metrics_snapshot() -> dict:
+    """Current counters+gauges ({} when tracing is disabled). Recorder-ring
+    overflow rides along as ``obs.events_dropped_total`` so telemetry loss
+    reaches Prometheus (graftlens satellite: the count existed, the export
+    path did not)."""
+    tr = _tracer
+    if tr is None:
+        return {}
+    out = tr.snapshot_metrics()
+    from .recorder import get_recorder   # lazy: recorder imports us in dump()
+    rec = get_recorder()
+    if rec is not None and rec.events_dropped:
+        out["obs.events_dropped_total"] = float(rec.events_dropped)
+    return out
+
+
+def exemplars_snapshot() -> dict:
+    """Current histogram exemplars ({} when tracing is disabled)."""
+    tr = _tracer
+    return tr.snapshot_exemplars() if tr is not None else {}
 
 
 def record_span(name: str, start_perf_s: float, duration_s: float,

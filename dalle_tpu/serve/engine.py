@@ -42,8 +42,9 @@ import numpy as np
 
 from ..chaos.faults import step_hook as chaos_step_hook
 from ..models.dalle import DALLE
-from ..obs import (counter_add, gauge_set, record_event, record_span,
-                   register_state_provider, unregister_state_provider)
+from ..obs import (counter_add, gauge_set, histogram_observe, record_event,
+                   record_span, register_state_provider,
+                   unregister_state_provider)
 from ..ops.sampling import gumbel_sample_rows
 from .queue import CompletedRequest, Request, RequestQueue
 from .scheduler import SlotScheduler
@@ -646,6 +647,8 @@ class DecodeEngine:
                     start=job.start, width=w,
                     step=self.stats.steps,
                     trace_id=job.pairs[0][1].trace_id)
+        histogram_observe("serve.prefill_chunk_seconds", t1 - t0,
+                          trace_id=job.pairs[0][1].trace_id)
         job.start += w
         if last:
             chunk_jobs.pop(0)
@@ -689,6 +692,9 @@ class DecodeEngine:
                                     trace_id=req.trace_id)
                         gauge_set("serve.queue_wait_s",
                                   now - req.submitted_at)
+                        histogram_observe("serve.queue_wait_seconds",
+                                          now - req.submitted_at,
+                                          trace_id=req.trace_id)
                         record_event("request_admitted", slot=slot,
                                      request_id=req.request_id,
                                      trace_id=req.trace_id)
@@ -826,6 +832,9 @@ class DecodeEngine:
                         record_span("serve/decode_row", t0r, now - t0r,
                                     request_id=req.request_id,
                                     trace_id=req.trace_id, row=row)
+                        histogram_observe("serve.decode_row_seconds",
+                                          now - t0r,
+                                          trace_id=req.trace_id)
                         row_t0[slot] = now
                         if on_rows is not None:
                             on_rows(req, row, buf[row * self.row_len:])
@@ -893,6 +902,12 @@ class DecodeEngine:
                     record_span("serve/request_ttft", req.submitted_at,
                                 cr.ttft_s, request_id=req.request_id,
                                 trace_id=req.trace_id)
+                    # native histogram (graftlens): the latency SHAPE a
+                    # single gauge cannot carry — p50/p95 render from the
+                    # cumulative buckets (obs_report), fleet-wide because
+                    # the collector sums buckets across processes
+                    histogram_observe("serve.ttft_seconds", cr.ttft_s,
+                                      trace_id=req.trace_id)
                     record_event("request_completed",
                                  request_id=req.request_id,
                                  trace_id=req.trace_id,

@@ -32,11 +32,23 @@ controller closing the loop. Asserts, end to end over real processes:
     [min_replicas, max_replicas];
   * **observability** — every decision is a ``fleet_action`` event and a
     ``fleet.actions_total{action=}`` counter; ``obs_report`` renders the
-    ``FLEET:`` verdict line and attributes failovers by reason.
+    ``FLEET:`` verdict line and attributes failovers by reason;
+  * **graftlens telemetry plane** — a request served on a remote replica,
+    SIGKILLed mid-stream and failed over to a second process yields ONE
+    ``obs_report --request`` timeline holding spans from all three
+    processes (gateway thread → dead victim → failover target) in causal
+    order under a single trace_id — the victim's half read from its
+    atomically-exported telemetry dir, the rest over the ``telemetry``
+    RPC verb, clocks joined by the heartbeat offset estimator. The
+    gateway's ``/metrics`` serves the fleet-aggregated counters, the
+    native TTFT histogram (quantiles rendered from buckets by
+    ``obs_report``), ``{replica=}``-labeled gauges, and the per-tenant
+    usage counters backed by the append-only metering ledger.
 
 Artifacts (smoke.json, decisions.json, metrics.jsonl, fleet_spans.jsonl,
-flight/, replica logs + per-replica flight bundles) land in ``--outdir``
-— the dir ci.yml uploads.
+flight/, telemetry_artifacts/ with the merged cross-process spans,
+usage.jsonl, replica logs + per-replica flight bundles) land in
+``--outdir`` — the dir ci.yml uploads.
 Run: JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
 """
 
@@ -116,6 +128,12 @@ def main(argv=None):
     flight_dir = os.path.join(args.outdir, "flight")
     obs.configure_recorder(flight_dir, min_dump_interval_s=0.0,
                            sample_interval_s=0.5)
+    # graftlens: one collector joins every replica process's telemetry —
+    # RPC fetch while alive, the atomic export dir after a SIGKILL — and
+    # backs the gateway's fleet-aggregated /metrics
+    coll = obs.TelemetryCollector()
+    tel_dir = os.path.join(args.outdir, "telemetry")
+    usage_log = os.path.join(args.outdir, "usage.jsonl")
     failures = []
 
     def check(ok, msg):
@@ -160,7 +178,8 @@ def main(argv=None):
         "--flight_dir", os.path.join(args.outdir, "replica_flight")]
     manager = FleetManager(argv_base, warm_pool=1,
                            env={"JAX_PLATFORMS": "cpu"},
-                           log_dir=os.path.join(args.outdir, "replica_logs"))
+                           log_dir=os.path.join(args.outdir, "replica_logs"),
+                           telemetry_dir=tel_dir, collector=coll)
     try:
         rp0 = manager.spawn()
         check(rp0.handshake.get("aot_loaded") is True,
@@ -181,7 +200,8 @@ def main(argv=None):
             objective=0.9, windows=((3.0, 1.5), (10.0, 1.5)),
             on_breach=lambda v: obs.dump_recorder(
                 "slo_breach", extra={"dominating": v["dominating"]}))
-        gw = Gateway(router, admission, slo_sentry=sentry).start()
+        gw = Gateway(router, admission, slo_sentry=sentry,
+                     collector=coll, usage_log=usage_log).start()
         # down_sustain deliberately dwarfs up_sustain (add capacity fast,
         # remove it slowly): the oscillating-load phase's idle gaps must
         # never accumulate into a shrink
@@ -462,6 +482,18 @@ def main(argv=None):
         finally:
             wm.shutdown()
 
+        # replica-SIDE postmortem (graftlens satellite): the wedge trips
+        # inside the victim process, whose --flight_dir subtree lives in
+        # the artifact dir — a bundle from the replica's own recorder must
+        # have landed there (the gateway-side bundles above can never hold
+        # the stuck process's final state)
+        replica_bundles = sorted(glob.glob(os.path.join(
+            args.outdir, "replica_flight", "*", "postmortem_*")))
+        check(bool(replica_bundles),
+              f"wedged replica dumped its own flight bundle into the "
+              f"artifact dir ({len(replica_bundles)} replica-side "
+              f"bundle(s))")
+
         # -- cross-process AOT fingerprint refusal: a replica handed a
         # bundle built under a mismatched config must refuse LOUDLY in its
         # handshake and serve on the jit fallback (cold, correct)
@@ -534,6 +566,135 @@ def main(argv=None):
               "obs_report renders the DEGRADE verdict naming the wedged "
               "response")
 
+        # -- phase E (graftlens): ONE timeline across three processes -----
+        # a fresh victim, paced by a slow fault so its telemetry exporter
+        # flushes mid-stream, then SIGKILLed between row relays: the
+        # request fails over to a second replica process, and the
+        # collector must join gateway thread + dead victim + failover
+        # target into a single --request timeline, while the gateway's
+        # /metrics serves the fleet-aggregated counters and histograms
+        tel_plan = FaultPlan([Fault(kind="slow", step=3, duration_s=0.4,
+                                    span_steps=8),
+                              Fault(kind="kill", step=9,
+                                    signal="SIGKILL")])
+        tm = FleetManager(argv_base + ["--telemetry_interval_s", "0.05"],
+                          env={"JAX_PLATFORMS": "cpu"},
+                          log_dir=os.path.join(args.outdir, "replica_logs"),
+                          telemetry_dir=tel_dir, collector=coll)
+        try:
+            tv = tm.spawn(replica_id="lens-victim",
+                          extra_env=tel_plan.env())
+            router.add_replica(tv.remote)
+            others = [r for r in router.replicas
+                      if r.replica_id != tv.replica_id]
+            for r in others:
+                router.remove_replica(r)
+            post_box = {}
+
+            def tel_post():
+                st, body = _post(gw.address, {"text": texts[3].tolist(),
+                                              "seed": 9500,
+                                              "tenant": "lens"})
+                post_box["status"], post_box["body"] = st, body
+
+            pt = threading.Thread(target=tel_post)
+            pt.start()
+            time.sleep(0.5)        # routed (instantly) onto the victim;
+            for r in others:       # bring the failover targets back in
+                router.add_replica(r)
+            pt.join(timeout=180.0)
+            body = post_box.get("body") or {}
+            tel_tid = body.get("trace_id")
+            check(post_box.get("status") == 200
+                  and body.get("failovers") == 1
+                  and body.get("replica") != tv.replica_id
+                  and body.get("tokens") == ref_for(3, 9500),
+                  "telemetry-phase request: served on the victim, "
+                  "SIGKILLed mid-stream, failed over bitwise-exact")
+            time.sleep(0.3)        # the target's engine-loop spans land
+            coll.poll()
+            tel_art = os.path.join(args.outdir, "telemetry_artifacts")
+            n_merged = coll.export_merged_jsonl(
+                os.path.join(tel_art, "merged_spans.jsonl"))
+            fleet_snap = coll.fleet_metrics()
+            with open(os.path.join(tel_art, "metrics.jsonl"), "w") as fh:
+                fh.write(json.dumps({"step": 0, **fleet_snap}) + "\n")
+            with open(os.path.join(tel_art,
+                                   "merged_spans.jsonl")) as fh:
+                merged = [json.loads(line) for line in fh]
+            tid_procs = {r.get("proc") for r in merged
+                         if (r.get("args") or {}).get("trace_id")
+                         == tel_tid}
+            check({"gateway", tv.replica_id,
+                   body.get("replica")} <= tid_procs,
+                  f"merged spans carry the trace across gateway + victim "
+                  f"+ failover target ({sorted(tid_procs)}; {n_merged} "
+                  f"spans merged)")
+
+            # the REAL CLI over the merged export: one wall-clock-ordered
+            # timeline spanning all three processes, victim before target
+            rep3 = subprocess.run(
+                [sys.executable, os.path.join(os.path.dirname(__file__),
+                                              "obs_report.py"),
+                 tel_art, "--request", tel_tid],
+                capture_output=True, text=True,
+                env=dict(os.environ, JAX_PLATFORMS="cpu"))
+            out3 = rep3.stdout
+            check(rep3.returncode == 0 and "in 3 process(es)" in out3,
+                  "obs_report --request joins ONE timeline across 3 "
+                  "processes")
+            vpos = out3.find(tv.replica_id)
+            fpos = out3.find(str(body.get("replica")))
+            check(0 <= vpos < fpos
+                  and out3.count("serve/request_queue_wait") == 2,
+                  "causal order: the dead victim's spans precede the "
+                  "failover target's (two admissions, one identity)")
+
+            # fleet-aggregated /metrics over the real socket: the gateway
+            # process runs no engine, so serve.* series can only have come
+            # from replica processes via the collector
+            import http.client
+            host, port = gw.address.split("//")[1].rsplit(":", 1)
+            mc = http.client.HTTPConnection(host, int(port), timeout=30)
+            mc.request("GET", "/metrics")
+            mtext = mc.getresponse().read().decode()
+            mc.close()
+            check("dalle_serve_requests_completed_total" in mtext
+                  and 'dalle_serve_ttft_seconds_bucket{le="' in mtext
+                  and "# TYPE dalle_serve_ttft_seconds histogram" in mtext,
+                  "gateway /metrics serves fleet-aggregated remote "
+                  "counters + the native TTFT histogram")
+            check('{replica="' in mtext
+                  and "dalle_fleet_telemetry_sources" in mtext,
+                  "remote gauges labeled {replica=} under the source-count "
+                  "gauge")
+
+            # obs_report over the fleet snapshot: TTFT quantiles computed
+            # from the merged cumulative buckets, never raw samples
+            rep4 = subprocess.run(
+                [sys.executable, os.path.join(os.path.dirname(__file__),
+                                              "obs_report.py"), tel_art],
+                capture_output=True, text=True,
+                env=dict(os.environ, JAX_PLATFORMS="cpu"))
+            check("latency histograms" in rep4.stdout
+                  and "serve.ttft_seconds" in rep4.stdout
+                  and "p50=" in rep4.stdout and "p95=" in rep4.stdout,
+                  "obs_report renders fleet TTFT p50/p95 from merged "
+                  "buckets")
+            check("TELEMETRY:" in rep4.stdout,
+                  "obs_report prints the TELEMETRY plane verdict")
+            check("USAGE: metered" in rep4.stdout
+                  and "lens" in rep4.stdout,
+                  "obs_report renders the per-tenant usage table")
+            with open(usage_log) as fh:
+                ledger = [json.loads(line) for line in fh]
+            check(any(r.get("tenant") == "lens" and r.get("tokens_out")
+                      for r in ledger),
+                  f"usage ledger metered the request ({len(ledger)} "
+                  f"ledger lines)")
+        finally:
+            tm.shutdown()
+
         # graftsync cross-check: the lock-acquisition order this real
         # multi-threaded run exhibited must be acyclic and a subgraph of
         # the static golden (contracts/sync.json)
@@ -576,6 +737,13 @@ def main(argv=None):
                         if k.startswith("degrade.")},
             "flight_bundles": sorted(os.path.basename(p) for p in glob.glob(
                 os.path.join(flight_dir, "postmortem_*"))),
+            "replica_bundles": sorted(
+                os.path.relpath(p, args.outdir) for p in glob.glob(
+                    os.path.join(args.outdir, "replica_flight", "*",
+                                 "postmortem_*"))),
+            "telemetry": {"merged_spans": n_merged,
+                          "trace_procs": sorted(tid_procs),
+                          "sources": coll.sources()},
             "spans_exported": n_spans,
             "failures": failures,
         }

@@ -341,6 +341,49 @@ def main(argv=None):
           "counter")
     check('dalle_slo_burn_rate{window="5m"}' in metrics_text,
           "/metrics exposes the dalle_slo_* burn-rate gauge family")
+
+    # graftlens: /metrics is fleet-aggregated — the replica-side completion
+    # counter sums to exactly the gateway's own completion count (here the
+    # replicas share the process; fleet_smoke proves the cross-process sum)
+    def metric_value(text, name):
+        for line in text.splitlines():
+            if line.startswith(name + " "):
+                return float(line.split()[1])
+        return None
+
+    def fetch_metrics():
+        c = http.client.HTTPConnection(host, int(port), timeout=10)
+        c.request("GET", "/metrics")
+        text = c.getresponse().read().decode()
+        c.close()
+        return text
+
+    # the engine loop commits its completion counter a beat after the
+    # client sees `done` — poll instead of racing it
+    served = gw_done = None
+    deadline = _time.time() + 5.0
+    while _time.time() < deadline:
+        served = metric_value(metrics_text,
+                              "dalle_serve_requests_completed_total")
+        gw_done = metric_value(metrics_text, "dalle_gateway_completed_total")
+        if served is not None and served == gw_done:
+            break
+        _time.sleep(0.05)
+        metrics_text = fetch_metrics()
+    check(served is not None and gw_done is not None and served > 0
+          and served == gw_done,
+          f"/metrics: sum of per-replica completions ({served}) == gateway "
+          f"completion count ({gw_done})")
+    check("# TYPE dalle_serve_ttft_seconds histogram" in metrics_text
+          and 'dalle_serve_ttft_seconds_bucket{le="' in metrics_text
+          and metric_value(metrics_text, "dalle_serve_ttft_seconds_count")
+          == served,
+          "/metrics: native TTFT histogram (typed, cumulative buckets, "
+          "count == completions)")
+    check('# {trace_id="' in metrics_text,
+          "/metrics: histogram buckets carry trace_id exemplars")
+    check('dalle_usage_tokens_out_total{tenant="' in metrics_text,
+          "/metrics: per-tenant usage counters rendered")
     gw.shutdown(drain=True, timeout=60)
 
     # mid-stream replica kill: the victim dies after 2 committed rows; the
@@ -491,6 +534,12 @@ def main(argv=None):
     check("images product loop" in rep2.stdout
           and "IMAGES: RERANKING" in rep2.stdout,
           "obs_report prints the graftloom IMAGES verdict (RERANKING)")
+    check("latency histograms" in rep2.stdout
+          and "serve.ttft_seconds" in rep2.stdout
+          and "p95=" in rep2.stdout,
+          "obs_report renders TTFT p50/p95 from the native buckets")
+    check("USAGE: metered" in rep2.stdout,
+          "obs_report prints the per-tenant USAGE section")
 
     # graftsync cross-check: the lock-acquisition order this real
     # multi-threaded run exhibited must be acyclic and a subgraph of the

@@ -19,10 +19,21 @@ replica — reassembled into one wall-clock-ordered timeline
 (queue-wait → prefill → per-row decode → SSE flush). The id is the
 ``X-Request-Id`` response header / the ``trace_id`` in SSE events.
 
+With graftlens fleet telemetry the same view crosses PROCESSES: point it
+at a merged-spans export (``TelemetryCollector.export_merged_jsonl``, the
+fleet smoke drops one under ``telemetry_artifacts/``) and the timeline
+spans gateway thread → remote replica → failover target, with a ``proc``
+column and a clock-offset-bound note. Summary mode additionally renders
+native-histogram quantiles (p50/p95 from the ``_bucket{le=}`` series, not
+raw samples), the per-tenant USAGE table, and the TELEMETRY verdict (a
+loud LOSSY warning when a span/event ring overflowed).
+
 Examples:
   python scripts/obs_report.py ./checkpoints/obs
   python scripts/obs_report.py ./metrics.jsonl --top 20
   python scripts/obs_report.py gateway_artifacts --request 8f2a9c0d1e2f3a4b
+  python scripts/obs_report.py fleet_artifacts/telemetry_artifacts \\
+      --request 8f2a9c0d1e2f3a4b   # cross-process merged timeline
 """
 
 import argparse

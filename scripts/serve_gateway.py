@@ -94,6 +94,12 @@ def build_parser():
     scope.add_argument("--slo_objective", type=float, default=0.999,
                        help="availability objective for the burn-rate "
                             "sentry (error budget = 1 - objective)")
+    scope.add_argument("--usage_log", type=str, default=None,
+                       help="graftlens per-tenant usage ledger: append-only "
+                            "JSONL (one record per completion: tenant, "
+                            "trace_id, token counts, queue wait) with "
+                            "size-based atomic rotation; the "
+                            "usage.*_total{tenant=} counters are always on")
     scope.add_argument("--decode_health", action="store_true",
                        help="graftpulse decode-quality gauges: per-request "
                             "token entropy / top-k mass / repeated-token "
@@ -207,7 +213,8 @@ def main(argv=None):
     gw = Gateway(ReplicaRouter(replicas), admission,
                  host=args.host, port=args.port, vae=dv.vae, clip=dv.clip,
                  slo_sentry=obs.BurnRateSentry(
-                     objective=args.slo_objective, on_breach=on_breach))
+                     objective=args.slo_objective, on_breach=on_breach),
+                 usage_log=args.usage_log)
     gw.start()
     print(f"gateway listening on {gw.address} "
           f"({args.replicas} replica(s) × {args.slots} slots, "

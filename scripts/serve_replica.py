@@ -101,6 +101,15 @@ def build_parser():
                        help="flight-recorder bundle dir ('off' disables); "
                             "a per-replica subdir keyed by replica_id "
                             "keeps fleet postmortems separable")
+    scope.add_argument("--telemetry_dir", type=str, default=None,
+                       help="graftlens per-process telemetry dir (a "
+                            "replica_id subdir is created): a daemon "
+                            "thread atomically rewrites spans/metrics/"
+                            "events every --telemetry_interval_s, so the "
+                            "fleet collector can join this process's "
+                            "timeline even after a SIGKILL")
+    scope.add_argument("--telemetry_interval_s", type=float, default=0.2,
+                       help="telemetry flush period (seconds)")
     add_compile_cache_args(ap)
     add_profiler_args(ap)
     return ap
@@ -160,6 +169,11 @@ def main(argv=None):
         obs.configure_recorder(os.path.join(args.flight_dir, rid),
                                sample_interval_s=1.0)
         obs.install_signal_dump()
+    exporter = None
+    if args.telemetry_dir:
+        exporter = obs.TelemetryExporter(
+            os.path.join(args.telemetry_dir, rid),
+            interval_s=args.telemetry_interval_s, proc=rid)
     # a parent-scripted fault plan (kill/hang/slow keyed on the engine's
     # decode-iteration counter — serve/engine.py fires chaos.step_hook at
     # every step dispatch, so a fault lands mid-stream, between row
@@ -184,10 +198,20 @@ def main(argv=None):
         # healthy goes False, the health verb carries reason="wedged", and
         # the fleet controller's next tick migrate-drains this process.
         from dalle_tpu.degrade import WedgeWatchdog
+
+        def _on_wedge(detail):
+            replica.mark_wedged()
+            # the replica-side postmortem CI could never see before
+            # graftlens: the wedge trips in THIS process, so dump the
+            # bundle here (force: the wedge reason must never be
+            # rate-limited away) — fleet_smoke collects the replica
+            # flight dir into its artifact dir and asserts one lands
+            obs.dump_recorder("wedged", force=True)
+
         watchdog = WedgeWatchdog(
             lambda: (replica.progress or 0, replica.inflight > 0),
             args.wedge_timeout_s,
-            on_wedge=replica.mark_wedged).start()
+            on_wedge=_on_wedge).start()
     server = ReplicaServer(replica, host=args.host, port=args.port,
                            compile_counter=counter).start()
 
@@ -211,6 +235,8 @@ def main(argv=None):
         watchdog.stop()
     server.shutdown()
     replica.drain(timeout=60)
+    if exporter is not None:
+        exporter.close()          # final flush: the drain's spans land too
     obs.disable_recorder()
     return 0
 

@@ -1,0 +1,312 @@
+"""graftlens fleet telemetry plane (dalle_tpu/obs/collect.py): clock-offset
+estimation, cross-process span merging, exporter-dir roundtrips, fleet
+metric aggregation, native histograms, and the usage ledger."""
+
+import json
+import os
+
+import pytest
+
+from dalle_tpu import obs
+from dalle_tpu.obs import prometheus as prom
+from dalle_tpu.obs import report as obs_report
+from dalle_tpu.obs.collect import (ClockOffsetEstimator, TelemetryCollector,
+                                   TelemetryExporter, UsageLedger,
+                                   read_telemetry_dir, telemetry_payload)
+
+
+@pytest.fixture
+def tracer():
+    """A fresh enabled tracer, disabled again afterwards (the global default
+    must stay off: other test modules measure span cost as one None check)."""
+    obs.disable()
+    tr = obs.configure(capacity=256)
+    yield tr
+    obs.disable()
+
+
+# -- clock-offset estimation ------------------------------------------------
+
+def test_clock_offset_from_symmetric_exchange():
+    est = ClockOffsetEstimator()
+    # local sends at 100.0, remote clock reads 105.0 mid-exchange, reply
+    # lands at 100.010: the remote runs ~5s ahead, known to ± RTT/2
+    est.observe(100.0, 105.0, 100.010)
+    assert est.samples == 1 and not est.drift_flagged
+    assert est.offset == pytest.approx(4.995)
+    assert est.bound == pytest.approx(0.005)
+    assert est.to_local(105.0) == pytest.approx(100.005)
+
+
+def test_clock_keeps_tightest_bound():
+    est = ClockOffsetEstimator()
+    est.observe(100.0, 105.0, 100.010)        # bound 5ms
+    # a consistent but sloppier exchange (100ms RTT) must not displace the
+    # tight estimate
+    est.observe(200.0, 205.0, 200.100)
+    assert est.bound == pytest.approx(0.005)
+    assert est.offset == pytest.approx(4.995)
+    assert est.samples == 2 and not est.drift_flagged
+
+
+def test_clock_step_beyond_rtt_bound_flags_drift():
+    est = ClockOffsetEstimator()
+    est.observe(100.0, 105.0, 100.010)
+    # the remote clock stepped ~15s — the new confidence interval is
+    # disjoint from the best one (an offset error far beyond the RPC
+    # round-trip bound), so drift latches and the estimator re-anchors
+    est.observe(300.0, 320.0, 300.010)
+    assert est.drift_flagged
+    assert est.offset == pytest.approx(19.995)
+
+
+def test_clock_ignores_negative_rtt():
+    est = ClockOffsetEstimator()
+    est.observe(100.0, 105.0, 99.0)           # t1 < t0: clock went back
+    assert est.samples == 0 and est.bound is None and est.offset == 0.0
+
+
+# -- cross-process span merge (satellite: skewed-base causal order) ---------
+
+def _write_source_dir(dirpath, proc, spans):
+    """Hand-rolled exporter dir: what TelemetryExporter.flush writes, but
+    with fully synthetic timestamps."""
+    os.makedirs(dirpath, exist_ok=True)
+    with open(os.path.join(dirpath, "spans.jsonl"), "w") as fh:
+        for s in spans:
+            fh.write(json.dumps(s) + "\n")
+    with open(os.path.join(dirpath, "metrics.json"), "w") as fh:
+        fh.write("{}")
+    open(os.path.join(dirpath, "events.jsonl"), "w").close()
+    with open(os.path.join(dirpath, "meta.json"), "w") as fh:
+        json.dump({"proc": proc, "pid": 1, "server_time": 0.0,
+                   "seq": len(spans), "spans_dropped": 0,
+                   "events_dropped": 0, "flushes": 1}, fh)
+
+
+def test_merged_spans_correct_causal_order_across_skewed_clocks(tmp_path):
+    # true causal order (local wall clock): a1 @1000.0 → b1 @1000.2 →
+    # a2 @1000.4; process B's clock runs 50s AHEAD, so its file says
+    # 1050.2 — a naive sort puts b1 last, the offset-corrected one must not
+    tid = "t1"
+    _write_source_dir(tmp_path / "A", "A", [
+        {"name": "a1", "ts": 1000.0, "dur_s": 0.1, "tid": 1, "depth": 0,
+         "args": {"trace_id": tid}},
+        {"name": "a2", "ts": 1000.4, "dur_s": 0.1, "tid": 1, "depth": 0,
+         "args": {"trace_id": tid}}])
+    _write_source_dir(tmp_path / "B", "B", [
+        {"name": "b1", "ts": 1050.2, "dur_s": 0.1, "tid": 2, "depth": 0,
+         "args": {"trace_id": tid}}])
+    clock_b = ClockOffsetEstimator()
+    # heartbeat exchange: remote reads 1049.0005 while local is at ~999.0005
+    clock_b.observe(999.0, 1049.0005, 999.001)
+    assert clock_b.offset == pytest.approx(50.0, abs=1e-3)
+
+    coll = TelemetryCollector()
+    coll.add_source("A", path=str(tmp_path / "A"))
+    coll.add_source("B", path=str(tmp_path / "B"), clock=clock_b)
+    assert coll.poll() == 2
+    rows = coll.merged_spans(include_local=False)
+    assert [r["name"] for r in rows] == ["a1", "b1", "a2"]
+    b1 = rows[1]
+    assert b1["proc"] == "B" and b1["ts"] == pytest.approx(1000.2, abs=1e-2)
+    assert b1["clock_bound_s"] == pytest.approx(0.0005)
+    # the merged rows feed obs_report --request directly: one timeline,
+    # both processes, with the offset-bound caveat printed
+    text = obs_report.format_request_timeline(rows, tid)
+    assert "in 2 process(es)" in text
+    assert text.index("b1") < text.index("a2")
+    assert "offset bound" in text
+
+
+def test_uncorrected_merge_would_misorder(tmp_path):
+    # the control: without a clock estimate the same files sort wrong —
+    # proving the offset correction (not luck) produces the causal order
+    _write_source_dir(tmp_path / "A", "A", [
+        {"name": "a1", "ts": 1000.0, "dur_s": 0.1, "tid": 1, "depth": 0},
+        {"name": "a2", "ts": 1000.4, "dur_s": 0.1, "tid": 1, "depth": 0}])
+    _write_source_dir(tmp_path / "B", "B", [
+        {"name": "b1", "ts": 1050.2, "dur_s": 0.1, "tid": 2, "depth": 0}])
+    coll = TelemetryCollector()
+    coll.add_source("A", path=str(tmp_path / "A"))
+    coll.add_source("B", path=str(tmp_path / "B"))   # no clock
+    coll.poll()
+    rows = coll.merged_spans(include_local=False)
+    assert [r["name"] for r in rows] == ["a1", "a2", "b1"]
+
+
+def test_dead_rpc_source_keeps_last_telemetry():
+    calls = {"n": 0}
+
+    def fetch(since_seq):
+        if calls["n"]:
+            raise OSError("replica died")
+        calls["n"] += 1
+        return {"ok": True, "seq": 1, "pid": 7, "metrics": {},
+                "spans": [{"name": "x", "ts": 1.0, "dur_s": 0.1,
+                           "tid": 1, "depth": 0}]}
+
+    obs.disable()
+    coll = TelemetryCollector()
+    coll.add_source("r1", fetch=fetch)
+    assert coll.poll() == 1
+    assert coll.poll() == 0                  # dead now — but retained:
+    rows = coll.merged_spans(include_local=False)
+    assert [r["name"] for r in rows] == ["x"] and rows[0]["proc"] == "r1"
+
+
+# -- exporter dir / payload cursor ------------------------------------------
+
+def test_exporter_roundtrip(tmp_path, tracer):
+    with obs.span("work", step=1):
+        pass
+    obs.counter_add("serve.requests_completed_total", 2.0)
+    exp = TelemetryExporter(str(tmp_path / "r1"), proc="r1", start=False)
+    exp.flush()
+    payload = read_telemetry_dir(str(tmp_path / "r1"))
+    assert payload is not None and payload["meta"]["proc"] == "r1"
+    assert [s["name"] for s in payload["spans"]] == ["work"]
+    assert payload["spans"][0]["args"] == {"step": 1}
+    assert payload["metrics"]["serve.requests_completed_total"] == 2.0
+    assert read_telemetry_dir(str(tmp_path / "empty")) is None
+
+
+def test_telemetry_payload_span_cursor(tracer):
+    with obs.span("a"):
+        pass
+    p1 = telemetry_payload(0)
+    assert [s["name"] for s in p1["spans"]] == ["a"] and p1["seq"] == 1
+    with obs.span("b"):
+        pass
+    p2 = telemetry_payload(p1["seq"])        # incremental: only the new one
+    assert [s["name"] for s in p2["spans"]] == ["b"] and p2["seq"] == 2
+    assert telemetry_payload(p2["seq"])["spans"] == []
+
+
+# -- fleet metric aggregation -----------------------------------------------
+
+def _static_fetch(metrics):
+    def fetch(since_seq):
+        return {"ok": True, "seq": 0, "pid": 1, "metrics": metrics,
+                "spans": []}
+    return fetch
+
+
+def test_fleet_metrics_sums_counters_labels_gauges(tracer):
+    obs.counter_add("serve.requests_completed_total", 1.0)
+    obs.gauge_set("serve.queue_depth", 3.0)
+    coll = TelemetryCollector()
+    coll.add_source("r1", fetch=_static_fetch(
+        {"serve.requests_completed_total": 2.0, "serve.queue_depth": 5.0,
+         'serve.ttft_seconds_bucket{le="0.1"}': 4.0}))
+    coll.add_source("r2", fetch=_static_fetch(
+        {"serve.requests_completed_total": 3.0, "serve.queue_depth": 7.0,
+         'serve.ttft_seconds_bucket{le="0.1"}': 1.0}))
+    coll.poll()
+    out = coll.fleet_metrics()
+    # counters (and histogram buckets) sum across processes
+    assert out["serve.requests_completed_total"] == 6.0
+    assert out['serve.ttft_seconds_bucket{le="0.1"}'] == 5.0
+    # gauges stay per-process under a replica label; local stays unlabeled
+    assert out['serve.queue_depth{replica="r1"}'] == 5.0
+    assert out['serve.queue_depth{replica="r2"}'] == 7.0
+    assert out["serve.queue_depth"] == 3.0
+    assert out["fleet.telemetry_sources"] == 2.0
+
+
+# -- native histograms end to end -------------------------------------------
+
+def test_histogram_flatten_prometheus_and_quantiles(tracer):
+    for v in (0.003, 0.02, 0.02, 0.2):
+        obs.histogram_observe("serve.ttft_seconds", v, trace_id="t1")
+    snap = obs.metrics_snapshot()
+    # flattened cumulative buckets on the DEFAULT_BUCKETS bounds
+    assert snap['serve.ttft_seconds_bucket{le="0.005"}'] == 1
+    assert snap['serve.ttft_seconds_bucket{le="0.025"}'] == 3
+    assert snap['serve.ttft_seconds_bucket{le="+Inf"}'] == 4
+    assert snap["serve.ttft_seconds_count"] == 4
+    assert snap["serve.ttft_seconds_sum"] == pytest.approx(0.243)
+
+    text = prom.render_textfile(snap, exemplars=obs.exemplars_snapshot())
+    assert "# TYPE dalle_serve_ttft_seconds histogram" in text
+    assert 'dalle_serve_ttft_seconds_bucket{le="0.025"} 3' in text
+    assert 'trace_id="t1"' in text           # OpenMetrics exemplar
+
+    # obs_report renders p50/p95 from the buckets, never raw samples
+    snap["step"] = 1
+    hg = obs_report.histogram_accounting([snap])
+    assert hg is not None and hg[0]["name"] == "serve.ttft_seconds"
+    h = hg[0]
+    assert h["count"] == 4 and h["mean"] == pytest.approx(0.243 / 4)
+    assert 0.005 <= h["p50"] <= 0.025        # interpolated inside a bucket
+    assert 0.1 <= h["p95"] <= 0.25
+
+
+def test_histogram_rejects_oversized_and_unsorted_buckets(tracer):
+    with pytest.raises(ValueError):
+        obs.histogram_observe("bad_seconds", 0.1,
+                              buckets=tuple(i / 100 for i in range(40)))
+    with pytest.raises(ValueError):
+        obs.histogram_observe("bad2_seconds", 0.1, buckets=(0.5, 0.1))
+
+
+# -- lossy-plane counters ----------------------------------------------------
+
+def test_spans_dropped_total_counter():
+    obs.disable()
+    tr = obs.configure(capacity=4)
+    try:
+        for i in range(10):
+            with obs.span(f"s{i}"):
+                pass
+        snap = obs.metrics_snapshot()
+        assert snap["obs.spans_dropped_total"] == 6.0
+        assert snap["obs.spans_dropped"] == 6      # legacy spelling stays
+        assert tr.dropped == 6
+    finally:
+        obs.disable()
+
+
+def test_events_dropped_total_counter(tmp_path, tracer):
+    obs.configure_recorder(str(tmp_path), capacity=2)
+    try:
+        for i in range(5):
+            obs.record_event("tick", i=i)
+        snap = obs.metrics_snapshot()
+        assert snap["obs.events_dropped_total"] == 3.0
+        # and the report screams about it
+        text = obs_report.format_report([dict(snap, step=1)])
+        assert "TELEMETRY LOSSY" in text
+    finally:
+        obs.disable_recorder()
+
+
+# -- usage ledger -------------------------------------------------------------
+
+def test_usage_ledger_appends_and_rotates(tmp_path):
+    path = str(tmp_path / "usage.jsonl")
+    led = UsageLedger(path, max_bytes=256, keep=2)
+    for i in range(20):
+        led.append({"ts": float(i), "tenant": "acme", "kind": "generate",
+                    "tokens_in": 6, "tokens_out": 16})
+    assert led.records == 20 and led.rotations >= 1
+    assert os.path.exists(path + ".1")
+    rows = []
+    for p in (path, path + ".1"):
+        with open(p) as fh:
+            rows.extend(json.loads(line) for line in fh)   # no torn lines
+    assert all(r["tenant"] == "acme" for r in rows)
+    # rotation keeps at most `keep` files: .3 never appears
+    assert not os.path.exists(path + f".{led.keep + 1}")
+
+
+def test_usage_accounting_report_section():
+    row = {"step": 1,
+           'usage.tokens_in_total{tenant="acme"}': 12.0,
+           'usage.tokens_out_total{tenant="acme"}': 48.0,
+           'usage.images_total{tenant="beta"}': 2.0}
+    us = obs_report.usage_accounting([row])
+    assert us is not None and sorted(us["tenants"]) == ["acme", "beta"]
+    assert us["tenants"]["acme"]["tokens_out"] == 48.0
+    text = obs_report.format_report([row])
+    assert "USAGE: metered" in text and "acme" in text

@@ -917,6 +917,70 @@ def test_unbounded_label_suppression_and_scope():
 
 
 # ---------------------------------------------------------------------------
+# histogram-unbounded-buckets (rules_obs)
+# ---------------------------------------------------------------------------
+
+def test_histogram_buckets_flags_data_derived():
+    # bounds computed at the call site: different code paths register the
+    # family differently — trace.py only catches the mismatch at runtime
+    src = """
+    from dalle_tpu.obs import histogram_observe
+    def f(latency, samples):
+        histogram_observe("serve.lat_seconds", latency,
+                          buckets=sorted(samples))
+    """
+    found = lint_source("histogram-unbounded-buckets", src)
+    assert len(found) == 1 and "data-derived" in found[0].message
+
+
+def test_histogram_buckets_flags_oversized_literal():
+    bounds = ", ".join(str(i / 100) for i in range(1, 35))   # 34 > 32
+    src = f"""
+    from dalle_tpu.obs import histogram_observe
+    def f(v):
+        histogram_observe("serve.lat_seconds", v, buckets=({bounds}))
+    """
+    found = lint_source("histogram-unbounded-buckets", src)
+    assert len(found) == 1 and "34 bucket bounds" in found[0].message
+
+
+def test_histogram_buckets_catches_positional_arg():
+    src = """
+    from dalle_tpu.obs import histogram_observe
+    def f(v, data):
+        histogram_observe("serve.lat_seconds", v, [x for x in data])
+    """
+    assert len(lint_source("histogram-unbounded-buckets", src)) == 1
+
+
+def test_histogram_buckets_clean_on_constants():
+    # the sanctioned shapes: default bounds, explicit None, a small
+    # literal, and an ALL_CAPS module constant (bare or dotted)
+    src = """
+    from dalle_tpu.obs import DEFAULT_BUCKETS, histogram_observe
+    from dalle_tpu import obs
+    MY_BOUNDS = (0.01, 0.1, 1.0)
+    def f(v):
+        histogram_observe("a_seconds", v)
+        histogram_observe("b_seconds", v, buckets=None)
+        histogram_observe("c_seconds", v, buckets=(0.01, 0.1, 1.0))
+        histogram_observe("d_seconds", v, buckets=DEFAULT_BUCKETS)
+        histogram_observe("e_seconds", v, buckets=MY_BOUNDS)
+        histogram_observe("f_seconds", v, buckets=obs.DEFAULT_BUCKETS)
+    """
+    assert lint_source("histogram-unbounded-buckets", src) == []
+
+
+def test_histogram_buckets_suppression():
+    src = """
+    from dalle_tpu.obs import histogram_observe
+    def f(v, bounds):
+        histogram_observe("a_seconds", v, buckets=tuple(bounds))  # graftlint: disable=histogram-unbounded-buckets
+    """
+    assert lint_source("histogram-unbounded-buckets", src) == []
+
+
+# ---------------------------------------------------------------------------
 # unguarded-distributed-io (rules_distributed)
 # ---------------------------------------------------------------------------
 
