@@ -35,6 +35,7 @@ from . import rules_except  # noqa: F401,E402
 from . import rules_jit  # noqa: F401,E402
 from . import rules_vmem  # noqa: F401,E402
 from . import rules_scatter  # noqa: F401,E402
+from . import rules_paged  # noqa: F401,E402
 from . import rules_weaktype  # noqa: F401,E402
 from . import rules_precision  # noqa: F401,E402
 from . import rules_obs  # noqa: F401,E402

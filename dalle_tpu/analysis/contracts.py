@@ -337,6 +337,87 @@ def _build_serve_refill_shared() -> BuiltEntry:
                       donated=_tree_leaves(state), compile=True)
 
 
+@functools.lru_cache(maxsize=None)
+def _paged_engine():
+    # the graftpage serve configuration: the production int8 engine of
+    # _engine() with the dense slab swapped for the paged pool (block
+    # size 4 on the tiny calibration shapes → multiple blocks per row, so
+    # the gather really walks the page table). Host-side radix/COW control
+    # flow is data-only by design; these entries pin the static half of
+    # that claim — the paged programs' primitive sets, dtype boundaries
+    # and donation maps, which admission must never change.
+    import jax.numpy as jnp
+    from ..ops.quantize_weights import quantize_params_int8
+    from ..serve.engine import DecodeEngine
+    model, params = _dalle_model()
+    return DecodeEngine(model, quantize_params_int8(params), slots=4,
+                        cache_dtype=jnp.int8, kv_block_tokens=4)
+
+
+@register_entry("serve_decode_paged", "dalle_tpu/serve/engine.py")
+def _build_serve_decode_paged() -> BuiltEntry:
+    # the paged decode step: page-table gather → dense attend math → paged
+    # scatter. vs ``serve_decode`` the contract adds the gather/scatter
+    # primitives and the CFG merge, and must NOT add host transfers — the
+    # page table is a donated device leaf, not a host round-trip.
+    eng = _paged_engine()
+    state = eng._init_state()
+    return BuiltEntry(fn=eng._step_fn, args=(eng.params, state),
+                      donated=_tree_leaves(state), compile=True)
+
+
+@register_entry("serve_refill_paged", "dalle_tpu/serve/engine.py")
+def _build_serve_refill_paged() -> BuiltEntry:
+    # the paged bulk prefill (radix-miss admission): same window math as
+    # ``serve_refill``, writes routed through the page table
+    import jax.numpy as jnp
+    eng = _paged_engine()
+    state = eng._init_state()
+    texts = jnp.zeros((4, eng.text_seq_len), jnp.int32)
+    seeds = jnp.zeros((4,), jnp.int32)
+    n_rows = jnp.full((4,), eng.n_steps, jnp.int32)
+    mask = jnp.ones((4,), bool)
+    return BuiltEntry(fn=eng._refill_fn,
+                      args=(eng.params, state, texts, seeds, n_rows, mask),
+                      donated=_tree_leaves(state), compile=True)
+
+
+@register_entry("serve_refill_chunk_paged", "dalle_tpu/serve/engine.py")
+def _build_serve_refill_chunk_paged() -> BuiltEntry:
+    # the fixed-width suffix window of a radix PARTIAL hit (and the w=1
+    # full-hit logits recompute shares the same program at width 1): one
+    # block_tokens-wide masked prefill window through the page table. The
+    # width set is static (chunk_widths), which is what keeps partial-hit
+    # admission AOT-exportable and recompile-free.
+    import jax.numpy as jnp
+    eng = _paged_engine()
+    state = eng._init_state()
+    w = eng.kv_block_tokens
+    ids = jnp.zeros((4, w), jnp.int32)
+    seeds = jnp.zeros((4,), jnp.int32)
+    n_rows = jnp.full((4,), eng.n_steps, jnp.int32)
+    mask = jnp.ones((4,), bool)
+    return BuiltEntry(fn=eng._refill_chunk_fn,
+                      args=(eng.params, state, ids, jnp.int32(0), seeds,
+                            n_rows, mask, jnp.bool_(True)),
+                      donated=_tree_leaves(state), compile=True)
+
+
+@register_entry("serve_cow_copy", "dalle_tpu/serve/engine.py")
+def _build_serve_cow_copy() -> BuiltEntry:
+    # the copy-on-write fork: per-layer pool block copies (int8 scale
+    # planes ride along), fixed lane count, OOB-dst drop for inactive
+    # lanes. The contract pins that a fork is pure device block moves —
+    # no host transfer, no reshape of the pool, donation fully aliased.
+    import jax.numpy as jnp
+    eng = _paged_engine()
+    state = eng._init_state()
+    src = jnp.zeros((4,), jnp.int32)
+    dst = jnp.full((4,), eng.kv_pool_blocks, jnp.int32)
+    return BuiltEntry(fn=eng._cow_copy_fn, args=(state, src, dst),
+                      donated=_tree_leaves(state), compile=True)
+
+
 @register_entry("clip_rerank", "dalle_tpu/serve/pipeline.py")
 def _build_clip_rerank() -> BuiltEntry:
     # the /v1/images rerank stage: the jitted batched CLIP scorer the

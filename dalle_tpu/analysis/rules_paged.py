@@ -1,0 +1,147 @@
+"""page-table-dynamic-shape: the page table must stay device DATA.
+
+graftpage's no-recompile invariant rests on one property: the ``(B,
+max_blocks)`` page table enters every serve program as an ordinary int32
+array operand.  Block remaps, COW forks, and radix hits then change only
+the VALUES flowing through a fixed executable.  The moment page-table
+contents leak into Python — an ``int()`` on a table entry, a branch on
+mapped-block values, a shape computed from them — the program signature
+starts tracking admission state and every prefix-cache hit pattern
+compiles its own executable (the exact failure the dense slab was paged
+out to avoid: one program per occupancy layout).
+
+Three statically certain leak shapes are flagged (same zero-false-positive
+contract as the other rules — no dataflow inference, only syntax):
+
+1. **Host conversion of page-table values** — ``int(pages[...])``,
+   ``state["pages"].item()``, ``.tolist()``: a blocking device sync whose
+   result is a Python scalar; one step from a shape or a static arg.
+2. **Python control flow on page-table values** — ``if``/``while`` tests
+   mentioning the table (``is None`` / ``is not None`` structure probes
+   are exempt: they test which ENGINE is running, not which blocks are
+   mapped, and resolve identically on every call).
+3. **Page-table values in a shape position** — the table appearing inside
+   the shape argument of ``jnp.zeros/ones/full/empty`` or a ``reshape``
+   call.  ``pages.shape`` itself is fine (the table's OWN shape is static
+   config); its element values are not.
+
+Naming contract: the rule keys on the identifiers ``pages`` /
+``page_table(s)`` / ``block_table(s)`` and the ``state["pages"]`` leaf.
+Host-side numpy mirrors are deliberately exempt — keep the engine's
+``_pages_host`` suffix convention so the mirror (where Python ints are
+the whole point) stays visibly distinct from the device leaf.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List
+
+from .core import FileContext, Finding, Rule, register_rule
+
+_PAGE_NAME = re.compile(r"^(pages|page_tables?|block_tables?)$")
+
+# constructors whose first argument is a shape
+_SHAPE_CTORS = {"zeros", "ones", "full", "empty"}
+
+
+def _is_page_ref(node: ast.expr) -> bool:
+    """``pages`` / ``self.pages`` / ``state["pages"]`` and friends."""
+    if isinstance(node, ast.Name):
+        return bool(_PAGE_NAME.match(node.id))
+    if isinstance(node, ast.Attribute):
+        return bool(_PAGE_NAME.match(node.attr))
+    if isinstance(node, ast.Subscript):
+        sl = node.slice
+        return isinstance(sl, ast.Constant) and isinstance(sl.value, str) \
+            and bool(_PAGE_NAME.match(sl.value))
+    return False
+
+
+def _page_refs(node: ast.AST) -> List[ast.expr]:
+    """Page-table references anywhere under ``node``, skipping subtrees
+    rooted at ``<ref>.shape`` — the table's own (static) shape is fine."""
+    out: List[ast.expr] = []
+
+    def walk(n: ast.AST) -> None:
+        if isinstance(n, ast.Attribute) and n.attr == "shape" \
+                and _is_page_ref(n.value):
+            return                          # static-shape access: exempt
+        if isinstance(n, ast.expr) and _is_page_ref(n):
+            out.append(n)
+        for child in ast.iter_child_nodes(n):
+            walk(child)
+
+    walk(node)
+    return out
+
+
+def _is_none_probe(test: ast.expr) -> bool:
+    """``X is None`` / ``X is not None`` (possibly under not/and/or) —
+    an engine-mode structure probe, not a value branch."""
+    if isinstance(test, ast.BoolOp):
+        return all(_is_none_probe(v) for v in test.values)
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _is_none_probe(test.operand)
+    return (isinstance(test, ast.Compare)
+            and len(test.ops) == 1
+            and isinstance(test.ops[0], (ast.Is, ast.IsNot))
+            and isinstance(test.comparators[0], ast.Constant)
+            and test.comparators[0].value is None)
+
+
+@register_rule
+class PageTableDynamicShape(Rule):
+    name = "page-table-dynamic-shape"
+    description = ("page-table values leaking into Python (int()/.item(), "
+                   "branch tests, shape arguments) — the table must stay a "
+                   "device array operand or every block layout compiles its "
+                   "own serve program")
+    include = ("dalle_tpu/ops/", "dalle_tpu/serve/")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+
+        def flag(node: ast.AST, what: str) -> None:
+            findings.append(Finding(
+                self.name, ctx.rel_path, node.lineno,
+                f"{what} — page-table contents must stay device data; "
+                "a Python-visible value here ties the program signature "
+                "to the block layout and retraces per admission pattern"))
+
+        for node in ast.walk(ctx.tree):
+            # 1. host conversions: int()/float() of a page ref,
+            #    <page ref>.item()/.tolist()
+            if isinstance(node, ast.Call):
+                fn = node.func
+                if isinstance(fn, ast.Name) and fn.id in ("int", "float") \
+                        and len(node.args) == 1 \
+                        and _page_refs(node.args[0]):
+                    flag(node, f"{fn.id}() of page-table values")
+                elif isinstance(fn, ast.Attribute) \
+                        and fn.attr in ("item", "tolist") \
+                        and _page_refs(fn.value):
+                    flag(node, f".{fn.attr}() on page-table values")
+
+            # 2. Python control flow on page-table values
+            if isinstance(node, (ast.If, ast.While)) \
+                    and not _is_none_probe(node.test) \
+                    and _page_refs(node.test):
+                kind = "if" if isinstance(node, ast.If) else "while"
+                flag(node, f"`{kind}` test reads page-table values")
+
+            # 3. page-table values in a shape position
+            if isinstance(node, ast.Call):
+                fn = node.func
+                shape_args: List[ast.expr] = []
+                if isinstance(fn, ast.Attribute) and fn.attr == "reshape":
+                    shape_args = list(node.args)
+                elif isinstance(fn, ast.Attribute) \
+                        and fn.attr in _SHAPE_CTORS and node.args:
+                    shape_args = [node.args[0]]
+                for arg in shape_args:
+                    if _page_refs(arg):
+                        flag(node, "page-table values in a shape argument")
+                        break
+        return findings

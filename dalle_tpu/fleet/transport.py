@@ -563,27 +563,29 @@ class RemoteReplica:
     def submit(self, text, seed: int, *, max_tokens: Optional[int] = None,
                tenant: str = "default", priority: int = 0,
                deadline_at: Optional[float] = None,
-               trace_id: Optional[str] = None) -> RemoteResultStream:
+               trace_id: Optional[str] = None,
+               cond_scale: float = 1.0) -> RemoteResultStream:
         return self._open_stream(
             {"verb": "submit", "text": np.asarray(text, np.int32).tolist(),
              "seed": int(seed), "max_tokens": max_tokens, "tenant": tenant,
              "priority": int(priority),
              "deadline_left_s": self._deadline_left(deadline_at),
-             "trace_id": trace_id},
+             "trace_id": trace_id, "cond_scale": float(cond_scale)},
             RemoteResultStream)
 
     def submit_group(self, text, seeds, *,
                      max_tokens: Optional[int] = None,
                      tenant: str = "default", priority: int = 0,
                      deadline_at: Optional[float] = None,
-                     trace_id: Optional[str] = None) -> RemoteGroupStream:
+                     trace_id: Optional[str] = None,
+                     cond_scale: float = 1.0) -> RemoteGroupStream:
         return self._open_stream(
             {"verb": "submit_group",
              "text": np.asarray(text, np.int32).tolist(),
              "seeds": [int(s) for s in seeds], "max_tokens": max_tokens,
              "tenant": tenant, "priority": int(priority),
              "deadline_left_s": self._deadline_left(deadline_at),
-             "trace_id": trace_id},
+             "trace_id": trace_id, "cond_scale": float(cond_scale)},
             RemoteGroupStream)
 
     # -- lifecycle ---------------------------------------------------------
@@ -741,7 +743,9 @@ class ReplicaServer:
             # deadline_at against it)
             deadline_at=(time.perf_counter() + float(deadline_left)
                          if deadline_left is not None else None),
-            trace_id=msg.get("trace_id"))
+            trace_id=msg.get("trace_id"),
+            # pre-graftpage clients omit the key → 1.0 (no CFG cohort)
+            cond_scale=float(msg.get("cond_scale", 1.0)))
 
     @staticmethod
     def _failed_frame(payload) -> dict:

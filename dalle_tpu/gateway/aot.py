@@ -2,8 +2,9 @@
 
 Autoscaling under a traffic spike is only real if a new replica reaches
 "serving" in seconds. A fresh ``DecodeEngine`` pays trace + XLA compile for
-its three device programs (step scan, bulk refill window, per-row
-scatter-prefill) on first dispatch — minutes at flagship scale. This module
+its device programs (step scan, bulk refill window, per-row scatter-prefill,
+shared-prefix refill, fixed-width prefill chunks, paged COW fork) on first
+dispatch — minutes at flagship scale. This module
 exports those programs ONCE (``jax.jit(...).lower(...).compile()`` +
 ``jax.experimental.serialize_executable``) and lets a cold replica load the
 serialized executables straight into the engine
@@ -45,6 +46,27 @@ _BUNDLE = "programs.pkl"
 _MANIFEST = "manifest.json"
 
 
+def engine_programs(engine) -> tuple:
+    """The full program list THIS engine configuration dispatches: the four
+    base programs, plus one ``refill_chunk_w{w}`` per fixed chunk width
+    (``DecodeEngine.chunk_widths`` — nonempty for chunk-on AND paged
+    engines; the fixed-width set is what made chunked prefill AOT-
+    exportable), plus the paged ``cow_copy`` fork program."""
+    if engine.paged:
+        # paged admission never dispatches the dense trickle/shared-prefix
+        # programs (radix hits subsume shared prefills; staggered admission
+        # goes through the fixed-width chunk programs), and their bodies
+        # assume a dense slab — so paged bundles carry step + bulk refill
+        # + the chunk widths + the COW fork, nothing else
+        names = ["step", "refill"]
+    else:
+        names = list(PROGRAMS)
+    names += [f"refill_chunk_w{w}" for w in engine.chunk_widths()]
+    if engine.paged:
+        names.append("cow_copy")
+    return tuple(names)
+
+
 def _aval_digest(tree) -> str:
     """Order-stable digest of a pytree's (path, shape, dtype) leaves — the
     part of the fingerprint that catches a changed param tree (different
@@ -81,20 +103,23 @@ def engine_fingerprint(engine) -> dict:
         # engine expecting them (and vice versa). Pre-graftpulse bundles
         # lack the key entirely → mismatch → loud jit fallback.
         "decode_health": engine.decode_health,
-        # graftloom: chunked-prefill engines dispatch width-dynamic chunk
-        # programs this module cannot serialize, so only chunk-off bundles
-        # exist and a chunk-on engine refuses them (jit fallback) instead
-        # of claiming a cold-start guarantee its admission path would break.
-        # Pre-graftloom bundles also lack the refill_shared program — this
-        # key makes them mismatch loudly rather than fail at dispatch.
+        # graftloom/graftpage: chunked prefill decomposes into a FIXED
+        # width set (``chunk_widths()``), one serialized program per width,
+        # so chunk-on and paged engines export like any other — but the
+        # width set (hence the bundle's program list) is shaped by these
+        # knobs, and a bundle built for different ones must not load.
+        # Pre-graftloom bundles lack refill_shared, pre-graftpage ones lack
+        # the kv keys — both mismatch loudly rather than fail at dispatch.
         "prefill_chunk": engine.prefill_chunk,
+        "kv_block_tokens": engine.kv_block_tokens,
+        "kv_pool_blocks": engine.kv_pool_blocks,
         "param_avals": _aval_digest(engine.params),
     }
 
 
 def _program_args(engine):
-    """Abstract (ShapeDtypeStruct) call signatures for the three engine
-    programs — the avals the host loop passes at every dispatch. Built via
+    """Abstract (ShapeDtypeStruct) call signatures for the engine programs —
+    the avals the host loop passes at every dispatch. Built via
     ``jax.eval_shape`` so export never allocates a second KV cache."""
     import jax
     import jax.numpy as jnp
@@ -103,14 +128,22 @@ def _program_args(engine):
     state = jax.eval_shape(engine._init_state)
     B, T = engine.slots, engine.text_seq_len
     i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)  # noqa: E731
-    return {
+    boo = lambda *s: jax.ShapeDtypeStruct(s, jnp.bool_)  # noqa: E731
+    args = {
         "step": (params, state),
-        "refill": (params, state, i32(B, T), i32(B),
-                   i32(B), jax.ShapeDtypeStruct((B,), jnp.bool_)),
+        "refill": (params, state, i32(B, T), i32(B), i32(B), boo(B)),
         "refill_row": (params, state, i32(1, T), i32(), i32(), i32()),
-        "refill_shared": (params, state, i32(1, T), i32(B), i32(B),
-                          jax.ShapeDtypeStruct((B,), jnp.bool_)),
+        "refill_shared": (params, state, i32(1, T), i32(B), i32(B), boo(B)),
     }
+    for w in engine.chunk_widths():
+        # (params, state, ids_chunk, start, seeds, n_rows, mask, last) —
+        # start/last are traced scalars so one program per WIDTH covers
+        # every chunk position of that width
+        args[f"refill_chunk_w{w}"] = (params, state, i32(B, w), i32(),
+                                      i32(B), i32(B), boo(B), boo())
+    if engine.paged:
+        args["cow_copy"] = (state, i32(B), i32(B))
+    return args
 
 
 def step_lowering(engine):
@@ -131,27 +164,28 @@ def save_engine_aot(engine, out_dir: str) -> dict:
         # from a jit engine so the bundle is compiled fresh for this config
         raise ValueError("cannot export from an AOT-loaded engine; build a "
                          "fresh DecodeEngine and export that")
-    if engine.prefill_chunk:
-        # chunk widths are runtime-dynamic (chunk, remainder), so the chunk
-        # program can't be serialized ahead of time — refusing here beats
-        # shipping a bundle whose "zero-compile" claim the first chunked
-        # admission would falsify
-        raise ValueError("cannot export an AOT bundle from a chunked-"
-                         "prefill engine (prefill_chunk > 0); export with "
-                         "chunking off")
     os.makedirs(out_dir, exist_ok=True)
     args = _program_args(engine)
+    programs = engine_programs(engine)
     fns = {"step": engine._step_fn, "refill": engine._refill_fn,
            "refill_row": engine._refill_row_fn,
            "refill_shared": engine._refill_shared_fn}
+    for w in engine.chunk_widths():
+        # the chunk program is ONE jit function; each fixed width lowers to
+        # its own executable (graftloom's width-dynamic dispatch is exactly
+        # the set chunk_widths() enumerates, so the bundle covers every
+        # window the admission path can ever issue)
+        fns[f"refill_chunk_w{w}"] = engine._refill_chunk_fn
+    if engine.paged:
+        fns["cow_copy"] = engine._cow_copy_fn
     bundle = {}
-    for name in PROGRAMS:
+    for name in programs:
         compiled = fns[name].lower(*args[name]).compile()
         payload, in_tree, out_tree = serialize(compiled)
         bundle[name] = (payload, in_tree, out_tree)
     manifest = {"fingerprint": engine_fingerprint(engine),
-                "programs": list(PROGRAMS),
-                "payload_bytes": {n: len(bundle[n][0]) for n in PROGRAMS}}
+                "programs": list(programs),
+                "payload_bytes": {n: len(bundle[n][0]) for n in programs}}
     with open(os.path.join(out_dir, _BUNDLE), "wb") as fh:
         pickle.dump(bundle, fh)
     tmp = os.path.join(out_dir, _MANIFEST + ".tmp")
@@ -202,10 +236,27 @@ def load_engine_aot(engine, aot_dir: str, *, strict: bool = False) -> bool:
         return False
     with open(os.path.join(aot_dir, _BUNDLE), "rb") as fh:
         bundle = pickle.load(fh)
-    loaded = {name: deserialize_and_load(*bundle[name]) for name in PROGRAMS}
+    programs = engine_programs(engine)
+    missing = [n for n in programs if n not in bundle]
+    if missing:
+        # a matching fingerprint with missing programs means a truncated or
+        # hand-edited bundle — treat like a mismatch, never half-install
+        if strict:
+            raise ValueError(f"AOT bundle {aot_dir} lacks programs "
+                             f"{missing}")
+        import warnings
+        warnings.warn(f"AOT bundle {aot_dir} lacks programs {missing}; "
+                      "falling back to jit", stacklevel=2)
+        counter_add("gateway.aot_miss_total", 1.0)
+        return False
+    loaded = {name: deserialize_and_load(*bundle[name]) for name in programs}
+    chunks = {w: loaded[f"refill_chunk_w{w}"]
+              for w in engine.chunk_widths()} or None
     engine.install_executables(step=loaded["step"], refill=loaded["refill"],
-                               refill_row=loaded["refill_row"],
-                               refill_shared=loaded["refill_shared"])
+                               refill_row=loaded.get("refill_row"),
+                               refill_shared=loaded.get("refill_shared"),
+                               refill_chunks=chunks,
+                               cow_copy=loaded.get("cow_copy"))
     counter_add("gateway.aot_load_total", 1.0)
     return True
 

@@ -305,7 +305,8 @@ class Replica:
     def submit(self, text, seed: int, *, max_tokens: Optional[int] = None,
                tenant: str = "default", priority: int = 0,
                deadline_at: Optional[float] = None,
-               trace_id: Optional[str] = None) -> ResultStream:
+               trace_id: Optional[str] = None,
+               cond_scale: float = 1.0) -> ResultStream:
         if not self.healthy:
             raise ReplicaFailure(f"{self.replica_id} is not serving")
         # register the stream BEFORE the request becomes takeable: the
@@ -323,7 +324,8 @@ class Replica:
                                         max_tokens=max_tokens, tenant=tenant,
                                         priority=priority,
                                         deadline_at=deadline_at,
-                                        trace_id=trace_id)
+                                        trace_id=trace_id,
+                                        cond_scale=cond_scale)
             except BaseException:  # noqa: BLE001 - re-raised; the
                 # pre-registered stream must be unwound for ANY submit
                 # failure (incl. KeyboardInterrupt) or the id leaks a dead
@@ -337,7 +339,8 @@ class Replica:
                      tenant: str = "default", priority: int = 0,
                      deadline_at: Optional[float] = None,
                      trace_id: Optional[str] = None,
-                     group_id: Optional[int] = None) -> GroupStream:
+                     group_id: Optional[int] = None,
+                     cond_scale: float = 1.0) -> GroupStream:
         """Submit all N candidates of one shared-prefix group atomically:
         consecutive request ids (FIFO keeps them adjacent, so the engine
         admits them together and pays ONE text prefill), one merged event
@@ -368,7 +371,7 @@ class Replica:
                         max_tokens=max_tokens, tenant=tenant,
                         priority=priority, deadline_at=deadline_at,
                         trace_id=trace_id, group_id=gid, group_size=n,
-                        group_index=i)
+                        group_index=i, cond_scale=cond_scale)
             except BaseException:  # noqa: BLE001 - re-raised; the capacity
                 # precheck rules out mid-group QueueFull, leaving only a
                 # racing close(). Unwind every registration: already-queued
@@ -438,4 +441,10 @@ class Replica:
                 "slots": self.engine.slots,
                 "image_seq_len": self.engine.n_steps,
                 "image_fmap_size": self.engine.row_len,
+                # graftpage: page-pool occupancy + radix hit counters — the
+                # fleet controller's cache-pressure signal; a dense engine
+                # (or a test fake without kv_stats) answers {"paged": False}
+                "kv": (self.engine.kv_stats()
+                       if hasattr(self.engine, "kv_stats")
+                       else {"paged": False}),
                 "error": repr(self.failed) if self.failed else None}

@@ -339,7 +339,8 @@ class ReplicaRouter:
     def submit(self, text, seed: int, *, max_tokens: Optional[int] = None,
                tenant: str = "default", priority: int = 0,
                deadline_s: Optional[float] = None,
-               trace_id: Optional[str] = None) -> RoutedStream:
+               trace_id: Optional[str] = None,
+               cond_scale: float = 1.0) -> RoutedStream:
         """Dispatch one request; raises QueueFull / NoReplicaAvailable when
         nothing can take it (the gateway maps those to 429/503).
         ``trace_id`` is the propagated graftscope identity (minted here for
@@ -353,7 +354,7 @@ class ReplicaRouter:
                        if deadline_s is not None else None)
         kw = dict(text=text, seed=seed, max_tokens=max_tokens,
                   tenant=tenant, priority=priority, deadline_at=deadline_at,
-                  trace_id=trace_id)
+                  trace_id=trace_id, cond_scale=cond_scale)
         replica, stream = self._dispatch(**kw)
         return RoutedStream(self, stream, replica, kw, next(_gids))
 
@@ -378,7 +379,8 @@ class ReplicaRouter:
                       max_tokens: Optional[int] = None,
                       tenant: str = "default", priority: int = 0,
                       deadline_s: Optional[float] = None,
-                      trace_id: Optional[str] = None) -> "RoutedGroup":
+                      trace_id: Optional[str] = None,
+                      cond_scale: float = 1.0) -> "RoutedGroup":
         """Dispatch one multi-candidate request (the /v1/images fan-out):
         ``seeds`` fixes every candidate's sampling stream, so the group —
         including its failover resubmission — is deterministic end to
@@ -391,7 +393,7 @@ class ReplicaRouter:
                        if deadline_s is not None else None)
         kw = dict(text=text, seeds=list(seeds), max_tokens=max_tokens,
                   tenant=tenant, priority=priority, deadline_at=deadline_at,
-                  trace_id=trace_id)
+                  trace_id=trace_id, cond_scale=cond_scale)
         replica, stream = self._dispatch_group(**kw)
         return RoutedGroup(self, stream, replica, kw, next(_gids))
 

@@ -11,7 +11,11 @@ API (docs/SERVING.md is the operator guide):
 
   POST /v1/generate     JSON body: {"text": [token ids...], "seed": int,
                         "max_tokens"?, "tenant"?, "priority"?,
-                        "deadline_s"?, "stream"?: bool, "pixels"?: bool}
+                        "deadline_s"?, "stream"?: bool, "pixels"?: bool,
+                        "cond_scale"?: float (classifier-free guidance;
+                        != 1.0 admits a cond/uncond slot pair engine-side,
+                        tokens match generate_images_tokens(cond_scale=...)
+                        bitwise — /v1/images takes it too, per candidate)}
       stream=false → 200 JSON {request_id, tokens, ttft_s, latency_s, ...}
       stream=true  → 200 text/event-stream of row/done/error events
                      (gateway/sse.py wire format; pixels=true adds dVAE
@@ -277,6 +281,11 @@ def _make_handler(gw: Gateway):
                 deadline_s = body.get("deadline_s")
                 if deadline_s is not None:
                     deadline_s = float(deadline_s)
+                cond_scale = float(body.get("cond_scale", 1.0))
+                if not (cond_scale == cond_scale and
+                        abs(cond_scale) < 1e6):
+                    raise ValueError(
+                        f"cond_scale must be finite, got {cond_scale}")
             except (KeyError, TypeError, ValueError, OverflowError) as exc:
                 self._json(400, {"error": "bad_request",
                                  "detail": repr(exc)})
@@ -302,7 +311,8 @@ def _make_handler(gw: Gateway):
                     lambda: gw.router.submit(
                         text, seed, max_tokens=max_tokens, tenant=tenant,
                         priority=int(body.get("priority", 0)),
-                        deadline_s=deadline_s, trace_id=tid))
+                        deadline_s=deadline_s, trace_id=tid,
+                        cond_scale=cond_scale))
                 if routed is None:
                     return
                 record_event("request_submitted", trace_id=tid,
@@ -517,6 +527,11 @@ def _make_handler(gw: Gateway):
                 deadline_s = body.get("deadline_s")
                 if deadline_s is not None:
                     deadline_s = float(deadline_s)
+                cond_scale = float(body.get("cond_scale", 1.0))
+                if not (cond_scale == cond_scale and
+                        abs(cond_scale) < 1e6):
+                    raise ValueError(
+                        f"cond_scale must be finite, got {cond_scale}")
             except (KeyError, TypeError, ValueError, OverflowError) as exc:
                 self._json(400, {"error": "bad_request",
                                  "detail": repr(exc)})
@@ -548,7 +563,8 @@ def _make_handler(gw: Gateway):
                     lambda: gw.router.submit_images(
                         text, seeds, max_tokens=max_tokens, tenant=tenant,
                         priority=int(body.get("priority", 0)),
-                        deadline_s=deadline_s, trace_id=tid))
+                        deadline_s=deadline_s, trace_id=tid,
+                        cond_scale=cond_scale))
                 if routed is None:
                     return
                 record_event("images_submitted", trace_id=tid,

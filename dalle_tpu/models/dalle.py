@@ -506,6 +506,16 @@ class DALLE(nn.Module):
         return self.transformer.init_cache(batch, self.cfg.total_seq_len,
                                            dtype)
 
+    def serve_init_cache_paged(self, num_blocks: int, block_tokens: int,
+                               dtype=jnp.float32):
+        """Paged serve cache (graftpage): per-layer block pools; reads
+        gather back to a dense total_seq_len view so reduce widths — and
+        therefore every request's tokens — stay bitwise identical to the
+        dense slab and to single-request generation. The engine injects its
+        single page-table leaf into each layer per dispatch."""
+        return self.transformer.init_cache_paged(
+            num_blocks, block_tokens, self.cfg.total_seq_len, dtype)
+
     def serve_refill(self, text, cache, refill_mask, use_kernel=None):
         """Admission: prefill new prompts into SELECTED rows of the live
         multi-slot cache in one multi-row window. ``text`` (b, text_seq_len)
@@ -513,7 +523,7 @@ class DALLE(nn.Module):
         write their prompt k/v at [0, prefix_len) — overwriting the previous
         occupant — while every other row parks at offset max_seq. Returns
         (logits (b, V) for each refilled row's first image token, cache)."""
-        S = cache["kv_0"].kv.shape[1]   # max_seq == the park offset
+        S = cache["kv_0"].max_seq       # max_seq == the park offset
         text_b = self.remap_and_bos(text)
         tokens = self._stabilize(self.embed_text(text_b))
         offsets = jnp.where(refill_mask, 0, S)
@@ -563,7 +573,7 @@ class DALLE(nn.Module):
         prefix the full window would have shown it, at the same reduce
         widths. Returns (logits (b, V) from the window's LAST position —
         meaningful only on the final chunk — and the cache)."""
-        S = cache["kv_0"].kv.shape[1]   # max_seq == the park offset
+        S = cache["kv_0"].max_seq       # max_seq == the park offset
         n = ids.shape[1]
         tok = self._embed_text_ids(ids)
         if not self.cfg.rotary_emb:

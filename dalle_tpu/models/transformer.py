@@ -671,6 +671,23 @@ class Transformer(nn.Module):
                     batch, c.image_fmap_size, d4, shift_dtype)
         return cache
 
+    def init_cache_paged(self, num_blocks: int, block_tokens: int,
+                         max_seq: int, dtype=jnp.float32) -> Dict[str, Any]:
+        """Paged twin of ``init_cache``: per-layer block pools instead of
+        per-slot slabs. The page table is NOT allocated here — the engine
+        owns exactly one ``(B, max_blocks)`` table as a state leaf and
+        injects it into every layer per dispatch (a per-layer copy would
+        donate the same buffer depth times). Serve mode requires
+        shift_tokens off (Transformer.decode_window asserts it), so no
+        shift states."""
+        c = self.cfg
+        assert not c.shift_tokens, "paged serve cache requires shift_tokens off"
+        from ..ops.paged_kv import PagedKVCache
+        return {f"kv_{ind}": PagedKVCache.init(num_blocks, block_tokens,
+                                               c.heads, max_seq, c.dim_head,
+                                               dtype)
+                for ind in range(c.depth)}
+
     def prefill(self, x, cache: Dict[str, Any]):
         """Run the full prefix, filling every layer's caches. Returns (y, cache)."""
         c = self.cfg

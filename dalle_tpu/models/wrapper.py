@@ -157,7 +157,8 @@ class DalleWithVae:
                      filter_thres: float = 0.5, temperature: float = 1.0,
                      topk_approx: bool = False, steps_per_sync: int = 1,
                      use_kernel=None, decode_health: bool = False,
-                     prefill_chunk: int = 0):
+                     prefill_chunk: int = 0, kv_block_tokens: int = 0,
+                     kv_pool_blocks=None, radix_cache: bool = True):
         """Continuous-batching decode engine over this wrapper's model —
         the serving-side sibling of ``generate_images``. ``slots`` is the
         fixed device batch; precision modes are the same fast paths
@@ -190,7 +191,10 @@ class DalleWithVae:
                             steps_per_sync=steps_per_sync,
                             use_kernel=use_kernel,
                             decode_health=decode_health,
-                            prefill_chunk=prefill_chunk)
+                            prefill_chunk=prefill_chunk,
+                            kv_block_tokens=kv_block_tokens,
+                            kv_pool_blocks=kv_pool_blocks,
+                            radix_cache=radix_cache)
 
     def generate_images(self, text, key, *, filter_thres: float = 0.5,
                         temperature: float = 1.0, cond_scale: float = 1.0,

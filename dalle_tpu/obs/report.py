@@ -580,6 +580,44 @@ def images_accounting(metrics: List[dict],
     }
 
 
+def paged_kv_accounting(metrics: List[dict],
+                        spans: List[dict]) -> Optional[dict]:
+    """graftpage paged-KV health from the ``kv.*`` page-pool gauges +
+    prefix-hit counter and the mode-tagged ``serve/prefill`` spans. ``None``
+    when no record carries a kv key — dense-slab serving keeps its report
+    unchanged. The radix hit rate is per ADMISSION (spans tagged paged-hit /
+    paged-partial over all paged prefill spans); ``hit_tokens`` is the
+    prompt-KV compute the cache actually skipped. The verdict names whether
+    the prefix cache earned its pool: prefix-sharing when any admission
+    mapped resident blocks, cold otherwise — a persistently cold cache on
+    repeated-prompt traffic usually means the pool is sized with zero
+    residency headroom (every resident evicted before its repeat arrives)."""
+    kv_rows = [r for r in metrics if any(k.startswith("kv.") for k in r)]
+    if not kv_rows:
+        return None
+    last = kv_rows[-1]
+    modes = {"paged-hit": 0, "paged-partial": 0, "paged": 0}
+    for s in spans:
+        mode = (s.get("args") or {}).get("mode")
+        if mode in modes:
+            modes[mode] += 1
+    admissions = sum(modes.values())
+    hits = modes["paged-hit"] + modes["paged-partial"]
+    hit_tokens = float(last.get("kv.prefix_hit_tokens_total", 0))
+    return {
+        "pages_free": float(last.get("kv.pages_free", 0)),
+        "pages_used": float(last.get("kv.pages_used", 0)),
+        "pages_shared": float(last.get("kv.pages_shared", 0)),
+        "cow_copies": float(last.get("kv.pages_cow_copies", 0)),
+        "hit_tokens": hit_tokens,
+        "admissions": admissions,
+        "full_hits": modes["paged-hit"],
+        "partial_hits": modes["paged-partial"],
+        "hit_rate": (hits / admissions) if admissions else None,
+        "verdict": ("prefix-sharing" if hit_tokens > 0 else "cold"),
+    }
+
+
 def format_report(rows: List[dict], *, topk: int = 10) -> str:
     spans, metrics = split_rows(rows)
     lines: List[str] = []
@@ -717,6 +755,27 @@ def format_report(rows: List[dict], *, topk: int = 10) -> str:
                        else "IMAGES: tokens-only (no reranker scored)")
             lines.append("== images product loop (graftloom): "
                          + ", ".join(parts) + f" → {verdict}")
+        pk = paged_kv_accounting(metrics, spans)
+        if pk is not None:
+            parts = [f"pool {pk['pages_used']:.0f} used / "
+                     f"{pk['pages_free']:.0f} free"]
+            if pk["pages_shared"]:
+                parts.append(f"{pk['pages_shared']:.0f} shared")
+            if pk["cow_copies"]:
+                parts.append(f"{pk['cow_copies']:.0f} COW copies")
+            if pk["hit_rate"] is not None:
+                parts.append(
+                    f"radix hit-rate {pk['hit_rate']:.0%} over "
+                    f"{pk['admissions']} admissions "
+                    f"({pk['full_hits']} full, {pk['partial_hits']} partial)")
+            parts.append(f"{pk['hit_tokens']:.0f} prompt tokens served "
+                         "from cache")
+            verdict = ("PAGED-KV: prefix-sharing"
+                       if pk["verdict"] == "prefix-sharing"
+                       else "PAGED-KV: cold (no prefix reuse — check pool "
+                            "residency headroom)")
+            lines.append("== paged KV (graftpage): " + ", ".join(parts)
+                         + f" → {verdict}")
         fl = fleet_accounting(metrics)
         if fl is not None:
             parts = []

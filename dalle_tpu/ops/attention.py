@@ -109,6 +109,14 @@ class KVCache:
     scale: Optional[jnp.ndarray] = None   # (b, 2h, max_seq) f32; int8 only
     heads: int = flax.struct.field(pytree_node=False, default=1)
 
+    @property
+    def max_seq(self) -> int:
+        """Sequence capacity == the park offset. A property (not a field)
+        so the dense slab and the paged pool (ops/paged_kv.PagedKVCache,
+        where capacity is NOT a storage dim) answer the same question
+        through one attribute."""
+        return self.kv.shape[1]
+
     @classmethod
     def init(cls, batch: int, heads: int, max_seq: int, dim_head: int,
              dtype=jnp.float32) -> "KVCache":
@@ -279,6 +287,25 @@ def cached_attend_window(q: jnp.ndarray, cache: KVCache, starts, *,
     """
     from .decode_attention import (decode_attend_window_kernel,
                                    decode_window_kernel_supported)
+    if hasattr(cache, "pool"):
+        # graftpage: paged block-pool cache — gather the page-table view
+        # back into the exact dense slab layout, then run the IDENTICAL
+        # math below (bitwise exactness by construction: same lanes, same
+        # reduce widths, same masks). The TPU kernel path gathers first
+        # too (decode_attention.decode_attend_window_paged) — the gather
+        # is one take per dispatch vs the O(B) private-slab HBM the pool
+        # replaces.
+        dense = cache.gather_dense()
+        if use_kernel is None:
+            use_kernel = (jax.default_backend() == "tpu"
+                          and decode_window_kernel_supported(q, dense,
+                                                             stable=stable))
+        if use_kernel:
+            from .decode_attention import decode_attend_window_paged
+            return decode_attend_window_paged(q, cache, starts, scale=scale,
+                                              out_dtype=q.dtype)
+        return cached_attend_window(q, dense, starts, stable=stable,
+                                    scale=scale, use_kernel=False)
     if use_kernel is None:
         use_kernel = (jax.default_backend() == "tpu"
                       and decode_window_kernel_supported(q, cache,

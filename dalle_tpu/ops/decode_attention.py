@@ -475,3 +475,27 @@ def decode_kernel_chunk_supported(q, cache, *, stable: bool,
     return (i == 1 and not stable and S % blk == 0 and S // blk >= 2
             and (hd2 // 2) % 128 == 0 and d % 8 == 0
             and vmem <= _VMEM_BUDGET)
+
+
+def decode_attend_window_paged(q, cache, starts, *,
+                               scale=None, out_dtype=None, interpret=None):
+    """Windowed decode attention over a PAGED cache (graftpage,
+    ops/paged_kv.PagedKVCache): gather the block pool through the page table
+    back into the dense (b, max_seq, 2hd) slab layout, then launch the SAME
+    windowed kernel as the dense path — per-row starts still ride the
+    prefetched scalar vector, the page table stays device data (an int32
+    gather operand, never a shape), so admission/COW/eviction never change
+    this program's signature.
+
+    The gather-then-kernel split is deliberate: XLA fuses the take into the
+    kernel's operand stream, and keeping the kernel body page-oblivious
+    means the dense and paged paths share one Mosaic program — the bitwise
+    exactness argument (identical attend math on identical valid lanes)
+    holds at the kernel level too. An in-kernel per-block DMA gather is the
+    follow-on once Mosaic's dynamic-slice-from-SMEM lands for this shape
+    family; the graftir entry ``decode_attend_window_paged`` pins today's
+    gather so that swap shows up as an intentional golden diff."""
+    dense = cache.gather_dense()
+    return decode_attend_window_kernel(q, dense, starts, scale=scale,
+                                       out_dtype=out_dtype,
+                                       interpret=interpret)

@@ -1,0 +1,167 @@
+"""Paged KV storage — block-pool cache behind the dense attend math.
+
+graftpage: the serve engine's dense per-slot KV slab (ops/attention.KVCache,
+one private (max_seq, 2hd) stripe per slot) becomes a fixed pool of
+``block_tokens``-position blocks shared by every slot, addressed through a
+``(B, max_blocks)`` int32 page table. The page table is device DATA, not
+shape: admission, copy-on-write forks and radix-cache hits mutate it on the
+host and upload the new table between dispatches, so no compiled program
+ever changes signature (the no-recompile invariant serve_smoke asserts).
+
+Exactness by construction: reads gather the paged pool back into the exact
+dense ``(b, max_seq, 2hd)`` layout (``gather_dense``) and run the SAME
+attend math as the dense slab — same reduce widths, same mask lanes, same
+softmax — so every request's tokens are bitwise what the dense engine (and
+the sequential ``generate_images_tokens``) produces. Unmapped page entries
+gather as zeros, exactly what a dense slab holds at never-written
+positions; both are masked before the softmax either way.
+
+Write discipline (the engine's invariant, stated here because the scatter
+relies on it): a block is written by AT MOST ONE row. Shared (radix-
+resident) blocks are read-only; the first divergent token lands in a
+copy-on-write fork the engine allocates at admission. Parked rows write at
+``offset == max_seq`` which maps out of the pool — dropped by the scatter,
+the same contract the dense slab's park offset uses.
+
+int8 KV pages its f32 scale planes WITH the blocks — a block move (COW
+copy, eviction, reuse) always carries quant scales alongside the quantized
+rows, so the gathered dequant is bitwise the dense dequant.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.struct
+import jax.numpy as jnp
+
+from .attention import KVCache, _quantize_int8
+
+
+@flax.struct.dataclass
+class PagedKVCache:
+    """One attention layer's block-pool KV store.
+
+    ``pool``: (num_blocks, block_tokens, 2*h*d) storage — K in the first
+    h*d lanes, V in the rest (the dense KVCache lane layout, per block).
+    ``scale``: (num_blocks, block_tokens, 2h) f32 per-position quant scales
+    (int8 storage only) — sequence-major per block so a block copy moves
+    rows and scales with the same index arithmetic.
+    ``pages``: (b, max_blocks) int32 page table, -1 = unmapped. Stored as
+    ``None`` in engine state and injected per dispatch from the state's
+    single ``pages`` leaf (one upload covers every layer; a per-layer copy
+    would donate the same buffer depth times).
+    ``max_seq``: the dense reduce width / park offset — every gather
+    reconstructs exactly this many positions so softmax widths match the
+    dense slab bitwise.
+    """
+    pool: jnp.ndarray
+    scale: Optional[jnp.ndarray] = None
+    pages: Optional[jnp.ndarray] = None
+    heads: int = flax.struct.field(pytree_node=False, default=1)
+    block_tokens: int = flax.struct.field(pytree_node=False, default=16)
+    max_seq: int = flax.struct.field(pytree_node=False, default=1)
+
+    @classmethod
+    def init(cls, num_blocks: int, block_tokens: int, heads: int,
+             max_seq: int, dim_head: int, dtype=jnp.float32) -> "PagedKVCache":
+        z = jnp.zeros((num_blocks, block_tokens, 2 * heads * dim_head),
+                      dtype=dtype)
+        s = None
+        if dtype == jnp.int8:
+            s = jnp.zeros((num_blocks, block_tokens, 2 * heads), jnp.float32)
+        return cls(z, s, None, heads=heads, block_tokens=block_tokens,
+                   max_seq=max_seq)
+
+    @property
+    def num_blocks(self) -> int:
+        return self.pool.shape[0]
+
+    # -- write path --------------------------------------------------------
+    def _flat_targets(self, offsets, w: int):
+        """(b, w) flat pool-row indices for positions offsets[b]..+w-1.
+        Unmapped pages and positions ≥ max_seq resolve to UNIQUE
+        out-of-bounds indices (dropped by the scatter) — unique so the
+        ``unique_indices`` scatter hint stays honest even for parked
+        rows, which all share the park offset."""
+        b = offsets.shape[0]
+        bt = self.block_tokens
+        idx = offsets[:, None] + jnp.arange(w)[None, :]          # (b, w)
+        blk = jnp.clip(idx // bt, 0, self.pages.shape[1] - 1)
+        page = jnp.take_along_axis(self.pages, blk, axis=1)      # (b, w)
+        valid = (idx < self.max_seq) & (page >= 0)
+        oob = (self.num_blocks * bt
+               + jnp.arange(b)[:, None] * w + jnp.arange(w)[None, :])
+        return jnp.where(valid, page * bt + idx % bt, oob)
+
+    def append_rows(self, k_new: jnp.ndarray, v_new: jnp.ndarray,
+                    offsets: jnp.ndarray) -> "PagedKVCache":
+        """Write (b,h,w,d) keys/values at PER-ROW absolute positions through
+        the page table — the paged twin of ``KVCache.append_rows`` (the only
+        write the serve path uses: every refill/decode goes through
+        ``Transformer.decode_window``)."""
+        assert self.pages is not None, (
+            "PagedKVCache.append_rows needs the page table injected "
+            "(engine programs bind state['pages'] before model.apply)")
+        b, _, w, _ = k_new.shape
+        bt = self.block_tokens
+        flat = self._flat_targets(offsets, w)
+        pool_flat = self.pool.reshape(self.num_blocks * bt, -1)
+        if self.pool.dtype == jnp.int8:
+            kq, ks = _quantize_int8(k_new)
+            vq, vs = _quantize_int8(v_new)
+            rows = jnp.concatenate(
+                [KVCache._flatten(kq), KVCache._flatten(vq)], axis=2)
+            sc = jnp.concatenate([ks[..., 0], vs[..., 0]], axis=1)  # (b,2h,w)
+            pool_flat = pool_flat.at[flat].set(
+                rows, mode="drop", unique_indices=True)
+            sc_flat = self.scale.reshape(self.num_blocks * bt, -1)
+            sc_flat = sc_flat.at[flat].set(
+                sc.transpose(0, 2, 1), mode="drop", unique_indices=True)
+            return self.replace(
+                pool=pool_flat.reshape(self.pool.shape),
+                scale=sc_flat.reshape(self.scale.shape))
+        rows = jnp.concatenate(
+            [KVCache._flatten(k_new.astype(self.pool.dtype)),
+             KVCache._flatten(v_new.astype(self.pool.dtype))], axis=2)
+        pool_flat = pool_flat.at[flat].set(
+            rows, mode="drop", unique_indices=True)
+        return self.replace(pool=pool_flat.reshape(self.pool.shape))
+
+    # -- read path ---------------------------------------------------------
+    def gather_dense(self) -> KVCache:
+        """Materialize the dense (b, max_seq, 2hd) slab view the attend math
+        expects — one gather per dispatch, then literally the dense code
+        path (bitwise exactness for free). Unmapped positions fill with 0,
+        the dense slab's never-written value; they are masked by the per-row
+        validity window before the softmax regardless."""
+        assert self.pages is not None, (
+            "PagedKVCache.gather_dense needs the page table injected")
+        bt = self.block_tokens
+        pos = jnp.arange(self.max_seq)
+        page = self.pages[:, pos // bt]                   # (b, max_seq)
+        flat = jnp.where(page >= 0, page * bt + pos % bt,
+                         self.num_blocks * bt)            # OOB → fill
+        pool_flat = self.pool.reshape(self.num_blocks * bt, -1)
+        kv = pool_flat.at[flat].get(mode="fill", fill_value=0)
+        scale = None
+        if self.scale is not None:
+            sc_flat = self.scale.reshape(self.num_blocks * bt, -1)
+            scale = sc_flat.at[flat].get(
+                mode="fill", fill_value=0).transpose(0, 2, 1)
+        return KVCache(kv=kv, scale=scale, heads=self.heads)
+
+    # -- block ops (engine host-driven) ------------------------------------
+    def copy_blocks(self, src: jnp.ndarray, dst: jnp.ndarray) -> "PagedKVCache":
+        """Copy-on-write fork: pool[dst[i]] = pool[src[i]] for every lane.
+        Inactive lanes pass dst >= num_blocks, UNIQUE per lane (out of
+        bounds → scatter drops, uniqueness keeps the ``unique_indices``
+        hint honest), so ONE fixed-width program serves any number of
+        forks per admission pass. Scales ride with their blocks."""
+        pool = self.pool.at[dst].set(self.pool[src], mode="drop",
+                                     unique_indices=True)
+        if self.scale is not None:
+            scale = self.scale.at[dst].set(self.scale[src], mode="drop",
+                                           unique_indices=True)
+            return self.replace(pool=pool, scale=scale)
+        return self.replace(pool=pool)

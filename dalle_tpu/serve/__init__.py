@@ -10,6 +10,7 @@ scripts/serve_smoke.py.
 """
 
 from .engine import DecodeEngine, EngineStats
+from .paged import BlockPool, Match, RadixCache
 from .pipeline import (CandidateGroup, ImagePipeline, PendingResult,
                        RankedGroup, prepare_clip_text)
 from .queue import CompletedRequest, QueueFull, Request, RequestQueue
@@ -19,5 +20,6 @@ from .scheduler import (FifoPolicy, PolicyQueue, PriorityDeadlinePolicy,
 __all__ = ["DecodeEngine", "EngineStats", "CompletedRequest", "QueueFull",
            "Request", "RequestQueue", "SlotScheduler", "SchedulingPolicy",
            "FifoPolicy", "PriorityDeadlinePolicy", "PolicyQueue",
+           "BlockPool", "Match", "RadixCache",
            "CandidateGroup", "ImagePipeline", "PendingResult", "RankedGroup",
            "prepare_clip_text"]

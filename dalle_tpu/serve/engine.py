@@ -46,6 +46,7 @@ from ..obs import (counter_add, gauge_set, histogram_observe, record_event,
                    record_span, register_state_provider,
                    unregister_state_provider)
 from ..ops.sampling import gumbel_sample_rows
+from .paged import BlockPool, RadixCache
 from .queue import CompletedRequest, Request, RequestQueue
 from .scheduler import SlotScheduler
 
@@ -68,6 +69,16 @@ class EngineStats:
     # Sum/count (not a sample list) so a long-lived serve loop stays O(1).
     occupancy_sum: float = 0.0
     occupancy_n: int = 0
+    # paged-KV ledger (graftpage): radix prefix-cache outcomes, COW forks
+    # and LRU evictions of the block pool. ``prefix_hit_tokens`` counts the
+    # prompt positions admission mapped from resident blocks instead of
+    # recomputing — the prefill compute the radix cache saved, in tokens.
+    radix_full_hits: int = 0
+    radix_partial_hits: int = 0
+    radix_misses: int = 0
+    prefix_hit_tokens: int = 0
+    cow_forks: int = 0
+    pages_evicted: int = 0
     # request ids still mid-decode when a max_steps bound tripped — they
     # were consumed from the queue and will never complete (empty on drain)
     aborted_in_flight: List[int] = dataclasses.field(default_factory=list)
@@ -141,9 +152,45 @@ def _shared_programs(eng: "DecodeEngine") -> tuple:
                jax.jit(DecodeEngine._refill_chunk.__get__(standin),
                        donate_argnums=(1,)),
                jax.jit(DecodeEngine._multi_step.__get__(standin),
-                       donate_argnums=(1,)))
+                       donate_argnums=(1,)),
+               jax.jit(DecodeEngine._cow_copy.__get__(standin),
+                       donate_argnums=(0,)))
         per_model[key] = fns
     return fns
+
+
+# -- paged-state plumbing (graftpage) ---------------------------------------
+# The page table is ONE state leaf (``state["pages"]``), bound into every
+# layer's PagedKVCache inside the traced program bodies and stripped before
+# the state is returned: a per-layer pages field would make donation alias
+# the same buffer ``depth`` times, and the host would have to upload depth
+# copies per admission instead of one. Dense engines have no "pages" key and
+# both helpers are identity on their cache.
+
+def _bind_cache(state):
+    pages = state.get("pages")
+    if pages is None:
+        return state["cache"]
+    return {name: c.replace(pages=pages)
+            for name, c in state["cache"].items()}
+
+
+def _unbind_cache(cache):
+    return {name: (c.replace(pages=None) if hasattr(c, "pool") else c)
+            for name, c in cache.items()}
+
+
+def _carry(state, new):
+    """Program-body return helper: the explicit per-program updates plus
+    pass-through of the admission-data leaves (page table, CFG pairing) the
+    host mutates between dispatches. Keeping them state leaves — data, not
+    shape — is what lets admission, COW forks and radix hits happen with
+    zero recompiles."""
+    out = dict(new)
+    for k in ("pages", "pair", "cfg", "uncond"):
+        if k in state:
+            out[k] = state[k]
+    return out
 
 
 @dataclasses.dataclass
@@ -186,7 +233,10 @@ class DecodeEngine:
                  cache_dtype=jnp.float32, filter_thres: float = 0.5,
                  temperature: float = 1.0, topk_approx: bool = False,
                  steps_per_sync: int = 1, use_kernel=None,
-                 decode_health: bool = False, prefill_chunk: int = 0):
+                 decode_health: bool = False, prefill_chunk: int = 0,
+                 kv_block_tokens: int = 0,
+                 kv_pool_blocks: Optional[int] = None,
+                 radix_cache: bool = True):
         c = model.cfg
         attn_types = tuple(c.attn_types) or ("full",)
         if any(t != "full" for t in attn_types) or c.shift_tokens:
@@ -248,38 +298,148 @@ class DecodeEngine:
         assert prefill_chunk >= 0
         self.prefill_chunk = int(prefill_chunk)
 
+        # paged KV (graftpage): kv_block_tokens > 0 swaps the dense per-slot
+        # slab for a shared block pool + (B, max_blocks) page table. Pool
+        # size is in BLOCKS (the HBM knob: blocks × block_tokens × 2hd ×
+        # itemsize bytes per layer); the default gives every slot its full
+        # private footprint — the interesting deployments size it SMALLER
+        # and let the radix cache make up the difference. Admission walks
+        # the radix tree per prompt, maps resident blocks, COW-forks the
+        # divergent tail and prefills only the miss suffix; the admission
+        # suffix rides _refill_chunk at the fixed width set {block_tokens,
+        # prefix_len % block_tokens, 1}, so paged engines and the explicit
+        # prefill_chunk knob are mutually exclusive (the block size IS the
+        # chunk bound).
+        assert kv_block_tokens >= 0
+        self.kv_block_tokens = int(kv_block_tokens)
+        self.paged = self.kv_block_tokens > 0
+        self.radix_cache = bool(radix_cache)
+        if self.paged:
+            if self.prefill_chunk:
+                raise ValueError(
+                    "kv_block_tokens and prefill_chunk are mutually "
+                    "exclusive: paged admission already dispatches prefill "
+                    "in block-width chunks")
+            bt = self.kv_block_tokens
+            self.max_blocks = -(-self.park // bt)      # blocks per slot
+            pool_blocks = (int(kv_pool_blocks) if kv_pool_blocks
+                           else self.slots * self.max_blocks)
+            # progress guarantee: the largest admission unit (a CFG pair =
+            # two full rows) must fit the pool outright, else it can never
+            # be admitted no matter what eviction frees
+            min_need = self.max_blocks * (2 if self.slots >= 2 else 1)
+            if pool_blocks < min_need:
+                raise ValueError(
+                    f"kv_pool_blocks={pool_blocks} cannot hold one "
+                    f"admission unit ({min_need} blocks of "
+                    f"{bt} tokens)")
+            self.kv_pool_blocks = pool_blocks
+        else:
+            self.max_blocks = 0
+            self.kv_pool_blocks = 0
+
         (self._refill_fn, self._refill_row_fn, self._refill_shared_fn,
-         self._refill_chunk_fn, self._step_fn) = _shared_programs(self)
+         self._refill_chunk_fn, self._step_fn,
+         self._cow_copy_fn) = _shared_programs(self)
         self.aot_loaded = False
         self.stats = EngineStats()
+        # host-side paged control plane — (re)built per run()
+        self.block_pool: Optional[BlockPool] = None
+        self.radix: Optional[RadixCache] = None
 
     def install_executables(self, *, step=None, refill=None,
-                            refill_row=None, refill_shared=None) -> None:
+                            refill_row=None, refill_shared=None,
+                            refill_chunks=None, cow_copy=None) -> None:
         """Swap the engine's jitted programs for AOT-compiled executables
         (gateway/aot.py): a cold replica then serves without retracing or
         recompiling any device program. Executables must have been lowered
         from THIS engine configuration — the aot module's fingerprint check
         enforces that; calling one with mismatched shapes/dtypes fails loudly
-        at dispatch, never silently."""
-        if (step is None or refill is None or refill_row is None
-                or refill_shared is None):
-            # a partial install would leave some programs on jit while
-            # health/smoke report aot_loaded=true — the flag must mean
-            # "the WHOLE cold-start path is executable-backed"
-            raise ValueError("install_executables requires all four "
-                             "programs (step, refill, refill_row, "
-                             "refill_shared)")
+        at dispatch, never silently.
+
+        ``refill_chunks`` maps chunk WIDTH → executable for every width the
+        engine's admission path can dispatch (the fixed set
+        ``chunk_widths()``); ``cow_copy`` is the paged fork program. Both
+        are required exactly when the engine's configuration uses them —
+        the aot_loaded flag must mean the WHOLE cold-start path is
+        executable-backed."""
+        if step is None or refill is None:
+            raise ValueError("install_executables requires the step and "
+                             "refill programs")
+        if not self.paged and (refill_row is None or refill_shared is None):
+            # dense engines dispatch the trickle and shared-prefix programs;
+            # paged ones never do (radix hits subsume shared prefills,
+            # staggered admission goes through the chunk programs), and
+            # their bodies assume a dense slab — so paged bundles omit them
+            raise ValueError("install_executables requires refill_row and "
+                             "refill_shared for dense engines")
+        widths = self.chunk_widths()
+        if widths:
+            missing = [w for w in widths if w not in (refill_chunks or {})]
+            if missing:
+                raise ValueError(
+                    f"install_executables: refill_chunk widths {missing} "
+                    f"required by this engine (chunk_widths={widths})")
+            exes = dict(refill_chunks)
+
+            def _chunk_dispatch(params, state, ids_chunk, start, seeds,
+                                n_rows, mask, last, _exes=exes):
+                return _exes[int(ids_chunk.shape[1])](
+                    params, state, ids_chunk, start, seeds, n_rows, mask,
+                    last)
+
+            self._refill_chunk_fn = _chunk_dispatch
+        if self.paged:
+            if cow_copy is None:
+                raise ValueError("install_executables: paged engines "
+                                 "require the cow_copy program")
+            self._cow_copy_fn = cow_copy
         self._step_fn = step
         self._refill_fn = refill
-        self._refill_row_fn = refill_row
-        self._refill_shared_fn = refill_shared
+        if refill_row is not None:
+            self._refill_row_fn = refill_row
+        if refill_shared is not None:
+            self._refill_shared_fn = refill_shared
         self.aot_loaded = True
+
+    def chunk_widths(self) -> tuple:
+        """The FIXED set of prefill-chunk widths this engine can dispatch —
+        what makes chunk-on and paged engines AOT-serializable: every
+        admission decomposes into windows from this set, so the aot bundle
+        carries one executable per width and a cold replica never compiles.
+        Dense chunk-off engines return () (the one-shot programs cover
+        admission)."""
+        if self.paged:
+            bt = self.kv_block_tokens
+            widths = {1}                        # full-hit logits recompute
+            if bt < self.prefix_len:
+                widths.add(bt)                  # miss-suffix body chunks
+                if self.prefix_len % bt:
+                    widths.add(self.prefix_len % bt)   # suffix tail
+            return tuple(sorted(widths))
+        if 0 < self.prefill_chunk < self.prefix_len:
+            widths = {self.prefill_chunk}
+            if self.prefix_len % self.prefill_chunk:
+                widths.add(self.prefix_len % self.prefill_chunk)
+            return tuple(sorted(widths))
+        return ()
 
     # -- device programs ---------------------------------------------------
     def _init_state(self) -> Dict:
-        cache = self.model.apply(self.params, self.slots, self.cache_dtype,
-                                 method=DALLE.serve_init_cache)
         B = self.slots
+        if self.paged:
+            cache = self.model.apply(
+                self.params, self.kv_pool_blocks, self.kv_block_tokens,
+                self.cache_dtype, method=DALLE.serve_init_cache_paged)
+            pages = jnp.full((B, self.max_blocks), -1, jnp.int32)
+            probe_cache = {n: c.replace(pages=pages)
+                           for n, c in cache.items()}
+        else:
+            cache = self.model.apply(self.params, self.slots,
+                                     self.cache_dtype,
+                                     method=DALLE.serve_init_cache)
+            pages = None
+            probe_cache = cache
         texts = jax.ShapeDtypeStruct((B, self.text_seq_len), jnp.int32)
         mask = jax.ShapeDtypeStruct((B,), jnp.bool_)
         # logits dtype must match what the model emits (bf16 params emit
@@ -288,9 +448,9 @@ class DecodeEngine:
         out_shape = jax.eval_shape(
             lambda p, t, cc, m: self.model.apply(
                 p, t, cc, m, method=DALLE.serve_refill),
-            self.params, texts, cache, mask)
+            self.params, texts, probe_cache, mask)
         logits_dtype = out_shape[0].dtype
-        return {
+        state = {
             "cache": cache,
             "logits": jnp.zeros((B, out_shape[0].shape[-1]), logits_dtype),
             "cur_key": jnp.zeros((B, 2), jnp.uint32),
@@ -302,23 +462,33 @@ class DecodeEngine:
             # first n of the full single-request generation
             "n_row": jnp.full((B,), self.n_steps, jnp.int32),
             "active": jnp.zeros((B,), jnp.bool_),
+            # CFG pairing (graftpage satellite): per-row partner index, cond
+            # scale and uncond flag — DATA leaves the host rewrites at
+            # admission. pair[i] == i / cfg == 1.0 rows sample their raw
+            # logits bitwise unchanged, so non-CFG traffic is untouched.
+            "pair": jnp.arange(B, dtype=jnp.int32),
+            "cfg": jnp.ones((B,), jnp.float32),
+            "uncond": jnp.zeros((B,), jnp.bool_),
         }
+        if pages is not None:
+            state["pages"] = pages
+        return state
 
     def _refill(self, params, state, texts, seeds, n_rows, mask):
         new_keys = jax.vmap(jax.random.PRNGKey)(seeds)       # (B, 2) u32
         logits_r, cache = self.model.apply(
-            params, texts, state["cache"], mask, self.use_kernel,
+            params, texts, _bind_cache(state), mask, self.use_kernel,
             method=DALLE.serve_refill)
         m1 = mask[:, None]
-        return {
-            "cache": cache,
+        return _carry(state, {
+            "cache": _unbind_cache(cache),
             "logits": jnp.where(m1, logits_r, state["logits"]),
             "cur_key": jnp.where(m1, new_keys, state["cur_key"]),
             "orig_key": jnp.where(m1, new_keys, state["orig_key"]),
             "t_idx": jnp.where(mask, 0, state["t_idx"]),
             "n_row": jnp.where(mask, n_rows, state["n_row"]),
             "active": state["active"] | mask,
-        }
+        })
 
     def _refill_row(self, params, state, text1, seed, n_tok, row):
         """Admit ONE request into slot ``row`` (traced scalar — one
@@ -340,7 +510,7 @@ class DecodeEngine:
             else:
                 cache[name] = big.replace(kv=kv)
         key1 = jax.random.PRNGKey(seed)
-        return {
+        return _carry(state, {
             "cache": cache,
             "logits": jax.lax.dynamic_update_slice(
                 state["logits"], logits1.astype(state["logits"].dtype),
@@ -352,12 +522,12 @@ class DecodeEngine:
             "t_idx": state["t_idx"].at[row].set(0),
             "n_row": state["n_row"].at[row].set(n_tok),
             "active": state["active"].at[row].set(True),
-        }
+        })
 
-    # graftir: allow=precision -- the shared-prefix refill is an
-    # admission-only program: it WRITES the broadcast b=1 prefill into the
-    # multi-slot int8 cache but never attends over it, so the incoming
-    # rows' KV scales legitimately pass through as moved data without a
+    # graftir: allow=precision -- the shared-prefix refill and the paged
+    # COW fork are admission-only programs: they WRITE (or block-move) KV
+    # into the int8 cache but never attend over it, so the rows' quant
+    # scales legitimately pass through as moved data without a
     # dequantizing multiply (graftnum orphaned-scale); the scales are
     # consumed by the very next serve_decode step, whose entry pins the
     # dequant sites.
@@ -373,7 +543,7 @@ class DecodeEngine:
             params, text1, state["cache"], mask, self.cache_dtype,
             method=DALLE.serve_refill_shared)
         m1 = mask[:, None]
-        return {
+        return _carry(state, {
             "cache": cache,
             "logits": jnp.where(m1, logits1.astype(state["logits"].dtype),
                                 state["logits"]),
@@ -382,7 +552,7 @@ class DecodeEngine:
             "t_idx": jnp.where(mask, 0, state["t_idx"]),
             "n_row": jnp.where(mask, n_rows, state["n_row"]),
             "active": state["active"] | mask,
-        }
+        })
 
     def _refill_chunk(self, params, state, ids_chunk, start, seeds, n_rows,
                       mask, last):
@@ -393,13 +563,13 @@ class DecodeEngine:
         traced scalar so one program serves every chunk of a given
         width)."""
         logits_r, cache = self.model.apply(
-            params, ids_chunk, state["cache"], mask, start, self.use_kernel,
-            method=DALLE.serve_refill_window)
+            params, ids_chunk, _bind_cache(state), mask, start,
+            self.use_kernel, method=DALLE.serve_refill_window)
         new_keys = jax.vmap(jax.random.PRNGKey)(seeds)
         lm = mask & last
         m1 = lm[:, None]
-        return {
-            "cache": cache,
+        return _carry(state, {
+            "cache": _unbind_cache(cache),
             "logits": jnp.where(m1, logits_r.astype(state["logits"].dtype),
                                 state["logits"]),
             "cur_key": jnp.where(m1, new_keys, state["cur_key"]),
@@ -407,7 +577,21 @@ class DecodeEngine:
             "t_idx": jnp.where(lm, 0, state["t_idx"]),
             "n_row": jnp.where(lm, n_rows, state["n_row"]),
             "active": state["active"] | lm,
-        }
+        })
+
+    def _cow_copy(self, state, src, dst):
+        """Copy-on-write fork (graftpage): duplicate shared blocks into
+        fresh ones in every layer's pool — ``pool[dst[i]] = pool[src[i]]``,
+        fixed lane count B with inactive lanes' dst out of bounds (scatter
+        drop). Runs BEFORE the forked row's first write, so radix-resident
+        blocks are never mutated; int8 scale planes ride with their
+        blocks."""
+        cache = {name: (c.copy_blocks(src, dst) if hasattr(c, "pool")
+                        else c)
+                 for name, c in state["cache"].items()}
+        out = dict(state)
+        out["cache"] = cache
+        return out
 
     def _step(self, params, state):
         n_steps = self.n_steps
@@ -431,6 +615,24 @@ class DecodeEngine:
         sample_key = jnp.where(uses_fold[:, None], fin_key, sub)
 
         img_logits = logits[:, self.num_text_tokens:]
+        # classifier-free guidance on paired rows: the stored per-row logits
+        # stay RAW (cond rows hold conditioned logits, their partners hold
+        # null-text logits); the merge is recomputed at every sample site —
+        # exactly the sequential ``null + (cond − null) * cond_scale`` on
+        # the image band (slicing commutes with the elementwise merge).
+        # Both rows of a pair sample from the COND row's merged logits with
+        # the same key chain (same seed), so they emit identical tokens in
+        # lockstep and free together. The scale is cast to the logits dtype
+        # first: a strong f32 scalar would promote bf16 logits and break
+        # bitwise parity with the weak-typed sequential constant. cfg==1.0
+        # rows keep their raw logits bitwise untouched (x + 0*s is NOT a
+        # bitwise identity for -0.0 — hence the where, not the arithmetic).
+        pair, cfg, uncond = state["pair"], state["cfg"], state["uncond"]
+        s = cfg.astype(img_logits.dtype)[:, None]
+        partner = img_logits[pair]
+        merged = partner + (img_logits - partner) * s
+        merged = jnp.where(uncond[:, None], merged[pair], merged)
+        img_logits = jnp.where((cfg == 1.0)[:, None], img_logits, merged)
         stats = {}
         if self.decode_health:
             # per-row quality of the distribution being sampled FROM (the
@@ -446,11 +648,11 @@ class DecodeEngine:
         decode_rows = active & ~final
         offsets = jnp.where(decode_rows, self.prefix_len + j, self.park)
         new_logits, cache = self.model.apply(
-            params, tok, j, offsets, state["cache"], self.use_kernel,
+            params, tok, j, offsets, _bind_cache(state), self.use_kernel,
             method=DALLE.serve_decode)
         finished = active & final
-        state = {
-            "cache": cache,
+        state = _carry(state, {
+            "cache": _unbind_cache(cache),
             "logits": jnp.where(decode_rows[:, None], new_logits, logits),
             "cur_key": jnp.where(uses_fold[:, None], state["cur_key"],
                                  new_key),
@@ -458,7 +660,7 @@ class DecodeEngine:
             "t_idx": jnp.where(active, t_idx + 1, t_idx),
             "n_row": n_row,
             "active": decode_rows,
-        }
+        })
         return tok, finished, stats, state
 
     def _multi_step(self, params, state):
@@ -501,6 +703,324 @@ class DecodeEngine:
         out = np.where(texts == 0, pad_ids[None, :], texts).astype(np.int32)
         return np.concatenate([np.zeros((B, 1), np.int32), out], axis=1)
 
+    # -- admission units (CFG pairing + paged planning) --------------------
+    def _expand_unit(self, req: Request) -> List[Request]:
+        """A request is admitted as a UNIT of slots that must activate in
+        lockstep: one row normally, two for cond_scale != 1.0 — the request
+        itself plus a synthetic null-text partner (negative request_id,
+        never surfaced to callers) whose logits feed the per-step CFG
+        merge. The null row shares the seed so both rows' key chains — and
+        therefore their sampled tokens — stay bitwise identical."""
+        if req.cond_scale == 1.0:
+            return [req]
+        if self.slots < 2:
+            raise ValueError(
+                "cond_scale != 1.0 needs an engine with slots >= 2 (the "
+                "CFG pair occupies two decode slots)")
+        null = dataclasses.replace(
+            req, request_id=-req.request_id - 1,
+            text=np.zeros_like(np.asarray(req.text)),
+            group_id=None, group_size=1, group_index=0)
+        return [req, null]
+
+    def _take_units(self, queue, n_free: int):
+        """Deferred units first (strict FIFO — a deferred CFG pair or
+        pool-starved unit is never overtaken), then fresh queue takes,
+        expanded into units. Units that don't fit ``n_free`` rows go back
+        to the overflow deque intact. Returns (placeable units, number of
+        requests newly taken from the queue)."""
+        units = self._overflow
+        self._overflow = []
+        taken = 0
+        have = sum(len(u) for u in units)
+        if have < n_free:
+            for req in queue.take(n_free - have):
+                taken += 1
+                units.append(self._expand_unit(req))
+        placed, rows = [], 0
+        for i, u in enumerate(units):
+            if rows + len(u) > n_free:
+                self._overflow = units[i:]
+                break
+            placed.append(u)
+            rows += len(u)
+        return placed, taken
+
+    def _set_pair_state(self, pairs_u) -> None:
+        """Write the CFG pairing mirrors for one admitted unit; dirty only
+        when something actually changes, so non-CFG workloads never upload
+        (their admission path is byte-identical to the pre-CFG engine)."""
+        if len(pairs_u) == 2:
+            (cs, creq), (ns, _) = pairs_u
+            self._pair_host[cs], self._pair_host[ns] = ns, cs
+            self._cfg_host[cs] = self._cfg_host[ns] = creq.cond_scale
+            self._uncond_host[cs], self._uncond_host[ns] = False, True
+            self._cfg_dirty = True
+        else:
+            slot = pairs_u[0][0]
+            if (self._pair_host[slot] != slot
+                    or self._cfg_host[slot] != 1.0
+                    or self._uncond_host[slot]):
+                self._pair_host[slot] = slot
+                self._cfg_host[slot] = 1.0
+                self._uncond_host[slot] = False
+                self._cfg_dirty = True
+
+    def _upload_cfg(self, state):
+        if self._cfg_dirty:
+            state["pair"] = jnp.asarray(self._pair_host)
+            state["cfg"] = jnp.asarray(self._cfg_host)
+            state["uncond"] = jnp.asarray(self._uncond_host)
+            self._cfg_dirty = False
+        return state
+
+    # -- paged admission (graftpage) ---------------------------------------
+    def _plan_row(self, req: Request) -> dict:
+        """Radix-match one row's prompt and size its block demand: the
+        blocks it can MAP from resident KV (read-only shares), the block it
+        must COW-fork (full hit), and the fresh blocks it needs for the
+        unmatched prompt suffix plus its decode tokens. Written positions
+        span [0, prefix_len + n_tok - 1) — the final token's KV is never
+        written (the dense engine's decode_rows contract), so a full-length
+        row needs ceil((total_seq_len - 1) / bt) blocks."""
+        bt = self.kv_block_tokens
+        ids = self._remap_bos_host(self._pad_text(req.text)[None])[0]
+        key = tuple(int(x) for x in ids)
+        n_tok = self._n_tokens(req)
+        total = -(-(self.prefix_len + n_tok - 1) // bt)
+        pr = {"req": req, "key": key, "ids": ids, "n_tok": n_tok,
+              "shared": [], "fork_src": None, "fresh_n": total,
+              "full": False, "hit_tok": 0, "match": None}
+        if not self.radix_cache:
+            return pr
+        # record=False: a unit the pool defers is re-planned every retry
+        # iteration (its matched blocks are unprotected while it waits, so
+        # the match CANNOT be cached across evictions) — the ledger commits
+        # once, in _plan_unit, when the unit actually admits
+        m = self.radix.match(key, record=False)
+        pr["match"] = m
+        if m.full:
+            # the block holding position prefix_len-1 must be forked before
+            # the width-1 logits recompute rewrites it: with a partial tail
+            # that's the tail block, with a block-aligned prompt it's the
+            # LAST full block — either way the fork dst is the row's first
+            # fresh block and the remaining matched blocks stay shared
+            shared = list(m.blocks) if self.prefix_len % bt else \
+                list(m.blocks[:-1])
+            pr.update(shared=shared, fork_src=m.tail_block,
+                      fresh_n=total - len(shared), full=True,
+                      hit_tok=m.hit_tokens)
+        elif m.blocks:
+            pr.update(shared=list(m.blocks),
+                      fresh_n=total - len(m.blocks), hit_tok=m.hit_tokens)
+        return pr
+
+    def _plan_unit(self, unit) -> Optional[dict]:
+        """Block-feasibility for one admission unit, atomically: retain
+        every block the unit reads FIRST (matched shares and fork sources
+        — protecting them from the eviction this very pass may run), evict
+        radix-only leaves for the remainder, then allocate every fresh
+        block the unit's rows will ever write (prompt suffix AND decode) up
+        front — a row that starts decoding can never run out mid-stream.
+        Returns None (with retains rolled back) when the pool can't cover
+        the unit; the caller defers the whole unit FIFO-fairly."""
+        pool = self.block_pool
+        rows = [self._plan_row(r) for r in unit]
+        retained = []
+        for pr in rows:
+            for bid in pr["shared"]:
+                pool.retain(bid)
+                retained.append(bid)
+            if pr["fork_src"] is not None:
+                pool.retain(pr["fork_src"])
+                retained.append(pr["fork_src"])
+        need = sum(pr["fresh_n"] for pr in rows)
+        if pool.free_count < need and self.radix_cache:
+            self.stats.pages_evicted += self.radix.evict(
+                need - pool.free_count)
+        if pool.free_count < need:
+            for bid in retained:
+                pool.release(bid)
+            return None
+        bt = self.kv_block_tokens
+        n_full = self.prefix_len // bt
+        t = self.prefix_len % bt
+        tmp = []
+        for pr in rows:
+            pr["fresh"] = [pool.alloc() for _ in range(pr["fresh_n"])]
+            if pr["fork_src"] is not None:
+                pr["fork_dst"] = pr["fresh"][0]
+                tmp.append(pr["fork_src"])   # held only until the copy runs
+            elif self.radix_cache:
+                # register the prompt's blocks NOW (content is prompt-
+                # deterministic; this pass's dispatches write it), so
+                # same-pass siblings — candidate fan-outs, repeated
+                # templates — already hit; insert() retains one tree ref
+                # per NEW node and keeps incumbents for already-resident
+                # prefixes
+                combined = pr["shared"] + pr["fresh"]
+                self.radix.insert(pr["key"], combined[:n_full],
+                                  combined[n_full] if t else None)
+        # the unit is definitely admitting: commit its matches to the hit
+        # ledgers exactly once (planning retries of deferred units don't
+        # count — see _plan_row)
+        for pr in rows:
+            if pr["match"] is not None:
+                self.radix.record(pr["match"])
+            if pr["full"]:
+                self.stats.radix_full_hits += 1
+                self.stats.shared_prefills_saved += 1
+                self.stats.prefix_hit_tokens += pr["hit_tok"]
+            elif pr["shared"]:
+                self.stats.radix_partial_hits += 1
+                self.stats.prefix_hit_tokens += pr["hit_tok"]
+            else:
+                self.stats.radix_misses += 1
+        return {"rows": rows, "tmp": tmp}
+
+    def _admit_paged(self, state, placed, row_t0):
+        """Dispatch one paged admission pass. Order is load-bearing:
+        page-table upload → full-miss windows → partial-hit suffix chunks →
+        COW forks → full-hit width-1 recomputes. Forks must follow every
+        prefill that WRITES a block being forked (same-pass siblings fork
+        blocks the pass itself fills) and precede the full-hit write into
+        the fork; device dispatch order makes each step see the previous
+        one's pool."""
+        B = self.slots
+        bt = self.kv_block_tokens
+        pool = self.block_pool
+        tmp = []
+        miss_mask = np.zeros((B,), bool)
+        texts = np.zeros((B, self.text_seq_len), np.int32)
+        seeds = np.zeros((B,), np.int32)
+        n_rows_arr = np.full((B,), self.n_steps, np.int32)
+        suffix: Dict[int, list] = {}
+        forks = []
+        hit_rows = []
+        all_rows = []
+        for pairs_u, plan in placed:
+            tmp.extend(plan["tmp"])
+            for (slot, req), pr in zip(pairs_u, plan["rows"]):
+                blocks = pr["shared"] + pr["fresh"]
+                self._pages_host[slot, :] = -1
+                self._pages_host[slot, :len(blocks)] = blocks
+                self._slot_blocks[slot] = blocks
+                seeds[slot] = req.seed
+                n_rows_arr[slot] = pr["n_tok"]
+                all_rows.append((slot, req, pr))
+                if pr["full"]:
+                    forks.append((pr["fork_src"], pr["fork_dst"]))
+                    hit_rows.append((slot, pr))
+                elif pr["shared"]:
+                    suffix.setdefault(len(pr["shared"]) * bt,
+                                      []).append((slot, pr))
+                else:
+                    miss_mask[slot] = True
+                    texts[slot] = self._pad_text(req.text)
+        # one upload covers every layer and every dispatch below — the
+        # page table is device DATA, so nothing here can recompile
+        state["pages"] = jnp.asarray(self._pages_host)
+        state = self._upload_cfg(state)
+        t0 = time.perf_counter()
+        if miss_mask.any():
+            state = self._refill_fn(self.params, state, texts, seeds,
+                                    n_rows_arr, miss_mask)
+            self.stats.refills += 1
+        for start in sorted(suffix):
+            mask = np.zeros((B,), bool)
+            ids = np.zeros((B, self.prefix_len), np.int32)
+            for slot, pr in suffix[start]:
+                mask[slot] = True
+                ids[slot] = pr["ids"]
+            pos = start
+            while pos < self.prefix_len:
+                w = min(bt, self.prefix_len - pos)
+                last = pos + w >= self.prefix_len
+                state = self._refill_chunk_fn(
+                    self.params, state, ids[:, pos:pos + w], np.int32(pos),
+                    seeds, n_rows_arr, mask, np.bool_(last))
+                self.stats.prefill_chunks += 1
+                pos += w
+            self.stats.refills += 1
+        if forks:
+            src = np.zeros((B,), np.int32)
+            # unused lanes get UNIQUE out-of-range dst (scatter drop)
+            dst = pool.num_blocks + np.arange(B, dtype=np.int32)
+            for i, (s, d) in enumerate(forks):
+                src[i] = s
+                dst[i] = d
+            state = self._cow_copy_fn(state, src, dst)
+            self.stats.cow_forks += len(forks)
+            pool.cow_copies += len(forks)
+        if hit_rows:
+            # full-prefix hits recompute ONLY position prefix_len-1 — a
+            # width-1 window whose logits are bitwise the one-shot window's
+            # last position (same gathered prefix, same reduce widths); its
+            # KV write is an idempotent rewrite into the row's private fork
+            mask = np.zeros((B,), bool)
+            ids = np.zeros((B, self.prefix_len), np.int32)
+            for slot, pr in hit_rows:
+                mask[slot] = True
+                ids[slot] = pr["ids"]
+            state = self._refill_chunk_fn(
+                self.params, state, ids[:, self.prefix_len - 1:],
+                np.int32(self.prefix_len - 1), seeds, n_rows_arr, mask,
+                np.bool_(True))
+            self.stats.refills += 1
+        t1 = time.perf_counter()
+        for bid in tmp:
+            pool.release(bid)
+        for slot, req, pr in all_rows:
+            if req.request_id >= 0:
+                mode = ("paged-hit" if pr["full"] else
+                        "paged-partial" if pr["shared"] else "paged")
+                record_span("serve/prefill", t0, t1 - t0,
+                            request_id=req.request_id,
+                            trace_id=req.trace_id, mode=mode)
+            row_t0[slot] = t1
+        gauge_set("kv.pages_free", float(pool.free_count))
+        gauge_set("kv.pages_used", float(pool.used_count))
+        gauge_set("kv.pages_shared", float(pool.shared_count))
+        gauge_set("kv.pages_cow_copies", float(pool.cow_copies))
+        counter_add("kv.prefix_hit_tokens_total",
+                    float(sum(pr["hit_tok"] for _, _, pr in all_rows)))
+        return state
+
+    def _release_slot_blocks(self, slot: int) -> None:
+        """Completion: drop the row's refs on every block it mapped —
+        shared blocks fall back to tree-only (evictable), private blocks
+        free outright unless the radix tree adopted them at admission. The
+        device page table keeps its stale row until the slot's next
+        admission overwrites it: an inactive row's writes drop at the park
+        offset and its outputs are discarded, so stale mappings are
+        unreachable."""
+        for bid in self._slot_blocks.pop(slot, ()):
+            self.block_pool.release(bid)
+        self._pages_host[slot, :] = -1
+
+    def kv_stats(self) -> dict:
+        """Page-pool + radix counters for the health verb and obs_report."""
+        if not self.paged:
+            return {"paged": False}
+        out = {"paged": True, "block_tokens": self.kv_block_tokens,
+               "pool_blocks": self.kv_pool_blocks,
+               "blocks_per_slot": self.max_blocks,
+               "radix_cache": self.radix_cache}
+        pool, rx = self.block_pool, self.radix
+        if pool is not None:
+            out.update(pages_free=pool.free_count,
+                       pages_used=pool.used_count,
+                       pages_shared=pool.shared_count,
+                       cow_copies=pool.cow_copies)
+        if rx is not None:
+            out.update(radix_nodes=rx.resident_nodes,
+                       radix_lookups=rx.lookups,
+                       radix_full_hits=rx.full_hits,
+                       radix_partial_hits=rx.partial_hits,
+                       prefix_hit_tokens=rx.hit_tokens_total,
+                       radix_evictions=rx.evictions)
+        return out
+
     @staticmethod
     def _split_cohorts(pairs):
         """Partition one admission pass into shared-prefix cohorts (≥2
@@ -513,7 +1033,11 @@ class DecodeEngine:
         by_gid: Dict[int, list] = {}
         singles = []
         for slot, req in pairs:
-            if req.group_id is not None:
+            # CFG members (cond_scale != 1.0) ride the single paths: the
+            # broadcast-prefill cohort would activate a cond row in one
+            # dispatch and its synthetic null partner in another, breaking
+            # the pair's lockstep key chain
+            if req.group_id is not None and req.cond_scale == 1.0:
                 by_gid.setdefault(req.group_id, []).append((slot, req))
             else:
                 singles.append((slot, req))
@@ -559,6 +1083,18 @@ class DecodeEngine:
         recorded in ``stats.aborted_in_flight`` so the loss is visible."""
         B = self.slots
         sched = SlotScheduler(B)
+        # paged control plane + CFG mirrors, fresh per serve loop (the
+        # device cache below starts empty, so host residency must too)
+        if self.paged:
+            self.block_pool = BlockPool(self.kv_pool_blocks)
+            self.radix = RadixCache(self.kv_block_tokens, self.block_pool)
+            self._pages_host = np.full((B, self.max_blocks), -1, np.int32)
+            self._slot_blocks: Dict[int, List[int]] = {}
+        self._pair_host = np.arange(B, dtype=np.int32)
+        self._cfg_host = np.ones((B,), np.float32)
+        self._uncond_host = np.zeros((B,), bool)
+        self._cfg_dirty = False
+        self._overflow: List[List[Request]] = []
         state = self._init_state()
         buffers: Dict[int, List[int]] = {}
         row_t0: Dict[int, float] = {}      # per-slot start of the open row
@@ -666,38 +1202,66 @@ class DecodeEngine:
         B = self.slots
         chunk_jobs: List[_ChunkJob] = []
         pending: set = set()       # slots admitted but mid-chunked-prefill
-        while not (queue.drained and not sched.any_active):
+        # drain also requires the overflow deque empty: units deferred for
+        # slots (a CFG pair against one free slot) or for pool pressure were
+        # already consumed from the queue and still owe completions
+        while not (queue.drained and not sched.any_active
+                   and not self._overflow):
             if max_steps is not None and self.stats.steps >= max_steps:
                 break
 
-            # admission: fill every free slot the queue can cover, FIFO
+            # admission: fill every free slot the queue can cover, FIFO,
+            # in lockstep UNITS (single rows, or cond+null CFG pairs)
             pre_q = queue.qsize()
             free = sched.free_slots()
             admitted = 0
             if free:
-                reqs = queue.take(len(free))
-                admitted = len(reqs)
-                if reqs:
-                    pairs = sched.admit(reqs)
+                units, admitted = self._take_units(queue, len(free))
+                placed = []
+                for i, unit in enumerate(units):
+                    plan = None
+                    if self.paged:
+                        plan = self._plan_unit(unit)
+                        if plan is None:
+                            # pool can't cover the unit even after
+                            # eviction: defer it AND everything behind it
+                            # (FIFO — no overtaking), retry when
+                            # completions release blocks
+                            self._overflow = units[i:] + self._overflow
+                            break
+                    placed.append((sched.admit(unit), plan))
+                if placed:
+                    pairs = []
                     now = time.perf_counter()
-                    for slot, req in pairs:
-                        req.admitted_at = now
-                        buffers[slot] = []
-                        qual[slot] = [0.0, 0.0, 0]
-                        # queue wait as its own span (admission SLO input:
-                        # TTFT = queue wait + prefill + first step) + gauge
-                        record_span("serve/request_queue_wait",
-                                    req.submitted_at, now - req.submitted_at,
-                                    request_id=req.request_id,
-                                    trace_id=req.trace_id)
-                        gauge_set("serve.queue_wait_s",
-                                  now - req.submitted_at)
-                        histogram_observe("serve.queue_wait_seconds",
-                                          now - req.submitted_at,
-                                          trace_id=req.trace_id)
-                        record_event("request_admitted", slot=slot,
-                                     request_id=req.request_id,
-                                     trace_id=req.trace_id)
+                    for pairs_u, _ in placed:
+                        self._set_pair_state(pairs_u)
+                        for slot, req in pairs_u:
+                            req.admitted_at = now
+                            buffers[slot] = []
+                            qual[slot] = [0.0, 0.0, 0]
+                            pairs.append((slot, req))
+                            if req.request_id < 0:
+                                continue   # synthetic CFG-null row
+                            # queue wait as its own span (admission SLO
+                            # input: TTFT = queue wait + prefill + first
+                            # step) + gauge
+                            record_span("serve/request_queue_wait",
+                                        req.submitted_at,
+                                        now - req.submitted_at,
+                                        request_id=req.request_id,
+                                        trace_id=req.trace_id)
+                            gauge_set("serve.queue_wait_s",
+                                      now - req.submitted_at)
+                            histogram_observe("serve.queue_wait_seconds",
+                                              now - req.submitted_at,
+                                              trace_id=req.trace_id)
+                            record_event("request_admitted", slot=slot,
+                                         request_id=req.request_id,
+                                         trace_id=req.trace_id)
+                if placed and self.paged:
+                    state = self._admit_paged(state, placed, row_t0)
+                elif placed:
+                    state = self._upload_cfg(state)
                     # shared-prefix cohorts first (one prefill per group),
                     # then singles through the classic window/trickle split
                     cohorts, singles = self._split_cohorts(pairs)
@@ -783,6 +1347,8 @@ class DecodeEngine:
             if not any(s not in pending for s in sched.active_slots()):
                 if chunk_jobs:
                     continue          # keep driving the pending prefill
+                if self._overflow:
+                    continue          # free slots admit the deferred units
                 if queue.drained:
                     break
                 queue.wait_nonempty(timeout=poll_s)
@@ -823,7 +1389,8 @@ class DecodeEngine:
                         acc[0] += float(q_ent[k, slot])
                         acc[1] += float(q_mass[k, slot])
                         acc[2] += 1
-                    if len(buf) % self.row_len == 0:
+                    if (len(buf) % self.row_len == 0
+                            and req.request_id >= 0):
                         row = len(buf) // self.row_len - 1
                         # one committed grid row = one timeline segment
                         # (host-sync granularity: rows finishing inside one
@@ -838,12 +1405,27 @@ class DecodeEngine:
                         row_t0[slot] = now
                         if on_rows is not None:
                             on_rows(req, row, buf[row * self.row_len:])
+                # synthetic CFG-null rows burn device work but emit no
+                # caller-visible tokens — keep the throughput counter an
+                # honest goodput number
                 counter_add("serve.tokens_emitted_total",
-                            float(len(active)))
+                            float(sum(1 for s in active
+                                      if sched.request_at(s).request_id
+                                      >= 0)))
                 for slot in active:
                     if not fins[k, slot]:
                         continue
                     req = sched.complete(slot)
+                    if self.paged:
+                        self._release_slot_blocks(slot)
+                    if req.request_id < 0:
+                        # synthetic CFG-null row: its tokens are bitwise
+                        # duplicates of the cond partner's — nothing to
+                        # surface, just free the slot
+                        buffers.pop(slot, None)
+                        qual.pop(slot, None)
+                        row_t0.pop(slot, None)
+                        continue
                     tail = len(buffers[slot]) % self.row_len
                     if tail:
                         # trailing partial row of a max_tokens request
@@ -916,5 +1498,6 @@ class DecodeEngine:
                     gauge_set("serve.request_latency_s", cr.latency_s)
                 self.stats.steps += 1
         self.stats.aborted_in_flight = [
-            sched.request_at(s).request_id for s in sched.active_slots()]
+            sched.request_at(s).request_id for s in sched.active_slots()
+            if sched.request_at(s).request_id >= 0]
         return completed

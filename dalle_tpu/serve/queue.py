@@ -65,6 +65,13 @@ class Request:
     group_id: Optional[int] = None
     group_size: int = 1
     group_index: int = 0
+    # classifier-free guidance (graftpage): cond_scale != 1.0 makes the
+    # engine admit this request as a COHORT of two slots — the conditioned
+    # row plus a synthetic null-text row (negative request_id, never
+    # surfaced) — merging logits per step exactly like the sequential
+    # ``generate_images_tokens(cond_scale=...)`` path. Requires an engine
+    # with slots >= 2.
+    cond_scale: float = 1.0
     # stamped by the engine
     admitted_at: Optional[float] = None
     first_token_at: Optional[float] = None
@@ -126,7 +133,8 @@ class RequestQueue:
                trace_id: Optional[str] = None,
                group_id: Optional[int] = None,
                group_size: int = 1,
-               group_index: int = 0) -> Request:
+               group_index: int = 0,
+               cond_scale: float = 1.0) -> Request:
         """Enqueue a request; returns it (with its assigned id). An explicit
         ``request_id`` must be fresh: ids at or below the high-water mark of
         previously issued ids are rejected rather than tracked individually,
@@ -162,7 +170,8 @@ class RequestQueue:
                           max_tokens=max_tokens, tenant=tenant,
                           priority=priority, deadline_at=deadline_at,
                           trace_id=trace_id, group_id=group_id,
-                          group_size=group_size, group_index=group_index)
+                          group_size=group_size, group_index=group_index,
+                          cond_scale=float(cond_scale))
             self._q.append(req)
             self._cond.notify_all()
         return req

@@ -39,7 +39,11 @@ contracts end-to-end over a real socket:
     retrace, no program compile on the cold replica" — a fresh jit engine
     in the same position pays its step/refill compiles). The widened
     graftloom bundle (4 programs incl. refill_shared) serves a cold
-    /v1/images request inside the same zero-compile window.
+    /v1/images request inside the same zero-compile window. A second
+    window (graftpage) pins the same zero for a CHUNK-ON engine — the
+    fixed chunk-width program family made chunked prefill exportable —
+    and serves a cond_scale request inside it (CFG is state data, not a
+    new program), bitwise vs generate_images_tokens(cond_scale=...).
 
 Artifacts (smoke.json, gateway_spans.jsonl, gateway_trace.json,
 metrics.jsonl, flight/) land in ``--outdir`` — the dir ci.yml uploads
@@ -480,6 +484,58 @@ def main(argv=None):
           and cold_img.get("reranked") is True,
           "AOT-served /v1/images candidates bit-exact + reranked")
     gw2.shutdown(drain=True, timeout=60)
+
+    # phase B2 (graftpage): chunk-on engines AOT-export now too — the chunk
+    # program set is the FIXED width family chunk_widths() enumerates, so
+    # save_engine_aot no longer refuses prefill_chunk > 0 and a cold
+    # chunk-on replica serves inside its own zero-compile window. The same
+    # window serves a cond_scale request: classifier-free guidance is pure
+    # state DATA (pair/cfg/uncond leaves), no new program.
+    from dalle_tpu.gateway import load_engine_aot
+    chunk_dir = os.path.join(os.path.dirname(aot_dir), "aot_chunk")
+    chunk_exporter = DecodeEngine(model, params, slots=args.slots,
+                                  prefill_chunk=3)
+    cmanifest = save_engine_aot(chunk_exporter, chunk_dir)
+    chunk_names = [p for p in cmanifest["programs"]
+                   if p.startswith("refill_chunk_w")]
+    check(sorted(int(p.split("_w")[1]) for p in chunk_names)
+          == sorted(chunk_exporter.chunk_widths()),
+          f"chunk-on AOT export carries one program per fixed width "
+          f"{chunk_exporter.chunk_widths()}")
+    # CFG reference BEFORE the zero-compile window opens (this sequential
+    # generate pays its own compiles)
+    cfg_ref = np.asarray(model.apply(
+        params, np.asarray(texts[2][None]), jax.random.PRNGKey(7777),
+        cond_scale=2.0, method=DALLE.generate_images_tokens)[0]).tolist()
+    model3, params3 = init_dalle(cfg, jax.random.PRNGKey(args.seed),
+                                 batch=2)
+    chunk_engine = DecodeEngine(model3, params3, slots=args.slots,
+                                prefill_chunk=3)
+    check(load_engine_aot(chunk_engine, chunk_dir, strict=True),
+          "chunk-on AOT bundle fingerprint-matched and loaded")
+    chunk_rep = Replica(chunk_engine, replica_id="aot-chunk-0",
+                        maxsize=16).start()
+    gw3 = Gateway(ReplicaRouter([chunk_rep]), AdmissionController(),
+                  vae=vae, pipeline=pipeline, slo_sentry=sentry).start()
+    before = counter.count
+    conn, resp = _post(gw3.address, {"text": texts[2].tolist(),
+                                     "seed": 1002})
+    chunk_tok = json.loads(resp.read())["tokens"]
+    conn.close()
+    conn, resp = _post(gw3.address, {"text": texts[2].tolist(),
+                                     "seed": 7777, "cond_scale": 2.0})
+    cfg_tok = json.loads(resp.read())["tokens"]
+    conn.close()
+    chunk_compiles = counter.count - before
+    check(chunk_compiles == 0,
+          f"chunk-on AOT cold start served (incl. a cond_scale pair) with "
+          f"{chunk_compiles} backend compiles")
+    check(chunk_tok == refs[2],
+          "chunk-on AOT-served tokens bit-exact vs jit reference")
+    check(cfg_tok == cfg_ref,
+          "gateway cond_scale=2.0 tokens bit-exact vs "
+          "generate_images_tokens(cond_scale=2.0)")
+    gw3.shutdown(drain=True, timeout=60)
 
     spans = tracer.snapshot_spans()
     qwaits = [s for s in spans if s[0] == "serve/request_queue_wait"]

@@ -17,6 +17,12 @@ p50/p95, TTFT p50/p95, and slot occupancy. ``--load`` scales the offered
 arrival rate relative to measured static capacity (load > 1 = saturating:
 the queue is essentially never empty).
 
+Two further sweeps share the harness: ``--candidates`` (graftloom —
+grouped candidate decoding vs independent requests) and ``--paged``
+(graftpage — a repeated-prompt trace through a dense engine vs the
+paged-KV engine at HBM parity, where radix prefix hits skip the prompt
+prefill).
+
 CPU mesh (the sandbox's referee): JAX_PLATFORMS=cpu python
 scripts/serve_bench.py --small. On-chip: drop --small, raise --slots.
 """
@@ -231,6 +237,212 @@ def bench_candidates(args):
     return 0 if (not args.assert_win or speedup >= 1.3) else 1
 
 
+def bench_paged(args):
+    """Prefix-overlap sweep (graftpage): the SAME repeated-prompt Poisson
+    trace — P distinct prompts × R repeats each, distinct sampling seeds —
+    served through a DENSE engine (every request pays its own prompt
+    prefill into a private slab) vs a PAGED engine at **HBM parity** (block
+    pool = exactly the dense slab's KV bytes: slots × ceil(total/bt)
+    blocks). Repeats radix-hit resident prompt blocks, fork the tail via
+    COW and recompute ONE position instead of the whole prompt window, so
+    at saturating load the paged engine's service rate — and therefore
+    completed req/s and TTFT under backlog — pulls ahead on exactly the
+    compute the radix cache skipped. Tokens are asserted BITWISE identical
+    to independent single-request generation in both modes; a repeat is
+    only a win if its bits don't move."""
+    import jax
+    import numpy as np
+
+    from dalle_tpu.config import DalleConfig
+    from dalle_tpu.models.dalle import DALLE, init_dalle
+    from dalle_tpu.serve import DecodeEngine, RequestQueue
+
+    if args.small:
+        # text-heavy on purpose (same rationale as the candidates sweep):
+        # prefix reuse amortizes the PROMPT prefill, so the measured regime
+        # is long prompt / modest grid — the product shape
+        cfg = DalleConfig(num_text_tokens=256, text_seq_len=96, dim=64,
+                          depth=2, heads=2, dim_head=32, image_size=16,
+                          image_vocab_size=32, image_fmap_size=4)
+    else:
+        cfg = DalleConfig(num_text_tokens=1000, text_seq_len=64, dim=256,
+                          depth=4, heads=4, dim_head=64, image_size=32,
+                          image_vocab_size=512, image_fmap_size=8)
+    model, params = init_dalle(cfg, jax.random.PRNGKey(0), batch=2)
+    P = args.n_groups
+    R = args.repeats
+    n = P * R
+    slots = args.slots
+    bt = 8
+    blocks_per_slot = -(-cfg.total_seq_len // bt)
+    # HBM parity: the paged pool holds EXACTLY the dense slab's KV bytes.
+    # Overlap is what buys residency headroom at parity — R repeats of a
+    # prompt share its full prefix blocks, so live demand stays well under
+    # slots × blocks_per_slot whenever the trace actually repeats prompts.
+    pool_blocks = slots * blocks_per_slot
+    dense = DecodeEngine(model, params, slots=slots,
+                         steps_per_sync=args.steps_per_sync)
+    paged = DecodeEngine(model, params, slots=slots,
+                         steps_per_sync=args.steps_per_sync,
+                         kv_block_tokens=bt, kv_pool_blocks=pool_blocks)
+    engines = {"dense": dense, "paged": paged}
+    rng = np.random.RandomState(args.seed)
+    prompts = [rng.randint(1, cfg.num_text_tokens,
+                           (cfg.text_seq_len,)).astype(np.int32)
+               for _ in range(P)]
+    # request i = repeat i // P of prompt i % P: round-robin over prompts,
+    # so every prompt's FIRST occurrence (the cold prefill) lands early and
+    # the tail of the trace is hit-heavy — the steady state of a serving
+    # fleet with a popular-prompt distribution
+    order = [(i % P, i // P) for i in range(n)]
+
+    def req_seed(g, i):
+        return args.seed_base + g * R + i
+
+    # bitwise bar: every repeat of the first two prompts against
+    # single-request generation — radix hits and COW forks included
+    check_prompts = list(range(min(2, P)))
+    refs = {}
+    for g in check_prompts:
+        for i in range(R):
+            ids = model.apply(params, np.asarray(prompts[g][None]),
+                              jax.random.PRNGKey(req_seed(g, i)),
+                              method=DALLE.generate_images_tokens)
+            refs[(g, i)] = np.asarray(ids[0])
+
+    def run_closed(eng, k):
+        q = RequestQueue()
+        for rid in range(k):
+            g, i = order[rid]
+            q.submit(prompts[g], seed=req_seed(g, i), request_id=rid)
+        q.close()
+        return eng.run(q)
+
+    # warm every program out of the timed runs. The paged set is wider
+    # than dense (bulk refill + per-width prefill chunks + cow_copy + the
+    # width-1 hit recompute), so the warmup mixes a burst with trickled
+    # fresh-and-repeat arrivals — the same recipe serve_smoke's
+    # zero-compile phase locks in.
+    for eng in engines.values():
+        run_closed(eng, min(slots + 2, n))
+        wq = RequestQueue()
+        wq.submit(prompts[0], seed=req_seed(0, 0), request_id=0)
+
+        def warm_producer():
+            for rid, (g, i) in ((1, (min(1, P - 1), R - 1)),
+                                (2, (0, R - 1))):
+                time.sleep(0.05)
+                wq.submit(prompts[g], seed=req_seed(g, i), request_id=rid)
+            wq.close()
+
+        th = threading.Thread(target=warm_producer)
+        th.start()
+        eng.run(wq)
+        th.join()
+
+    # difference calibration off the PAGED (fast) mode, same convention as
+    # the candidates sweep: (t_k − t_1)/(k − 1) cancels run()'s fixed
+    # setup cost; load > 1 relative to the fast mode keeps BOTH modes
+    # backlogged, so the measured ratio is service-bound throughput
+    def timed_closed(k):
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            run_closed(paged, k)
+            best = min(best, time.perf_counter() - t0)
+        return best
+    cal_n = min(2 * slots, n)
+    t_req = (timed_closed(cal_n) - timed_closed(1)) / (cal_n - 1)
+    t_req = max(t_req, 1e-4)
+    inter_arrival = t_req / args.load
+    print(json.dumps({"calibration": {
+        "t_req_s": round(t_req, 4),
+        "inter_arrival_s": round(inter_arrival, 4),
+        "slots": slots, "prompts": P, "repeats": R,
+        "block_tokens": bt, "pool_blocks": pool_blocks,
+        "dense_slab_blocks_equiv": slots * blocks_per_slot}}), flush=True)
+
+    gaps = rng.exponential(inter_arrival, size=n)
+    gaps[0] = 0.0
+
+    def one_trial(mode):
+        eng = engines[mode]
+        q = RequestQueue()
+
+        def producer():
+            for rid, gap in enumerate(gaps):
+                time.sleep(gap)
+                g, i = order[rid]
+                q.submit(prompts[g], seed=req_seed(g, i), request_id=rid)
+            q.close()
+
+        th = threading.Thread(target=producer)
+        eng.stats = type(eng.stats)()       # fresh counters per trial
+        t0 = time.perf_counter()
+        th.start()
+        done = eng.run(q)
+        wall = time.perf_counter() - t0
+        th.join()
+        by_id = {c.request_id: c for c in done}
+        exact = True
+        for rid, (g, i) in enumerate(order):
+            if g in check_prompts:
+                exact &= bool(np.array_equal(by_id[rid].tokens,
+                                             refs[(g, i)]))
+        assert exact, f"{mode}: tokens diverged from single-request refs"
+        lat = [c.latency_s for c in done]
+        ttft = [c.ttft_s for c in done]
+        row = {"mode": mode, "requests": len(done),
+               "wall_s": round(wall, 3),
+               "completed_per_s": round(len(done) / wall, 3),
+               "p50_latency_s": round(percentile(lat, 0.5), 4),
+               "p95_latency_s": round(percentile(lat, 0.95), 4),
+               "p50_ttft_s": round(percentile(ttft, 0.5), 4),
+               "p95_ttft_s": round(percentile(ttft, 0.95), 4),
+               "slot_occupancy": round(eng.stats.occupancy_while_queued, 4),
+               "tokens_bitwise_exact": exact}
+        if mode == "paged":
+            row.update({"radix_full_hits": eng.stats.radix_full_hits,
+                        "radix_partial_hits": eng.stats.radix_partial_hits,
+                        "prefix_hit_tokens": eng.stats.prefix_hit_tokens,
+                        "cow_forks": eng.stats.cow_forks,
+                        "pages_evicted": eng.stats.pages_evicted})
+        return row
+
+    # best-of-2 per mode, trials interleaved so background-load drift on
+    # the shared box hits both modes symmetrically
+    results = {}
+    for trial in range(2):
+        for mode in ("dense", "paged"):
+            row = one_trial(mode)
+            best = results.get(mode)
+            if best is None or row["completed_per_s"] > best["completed_per_s"]:
+                results[mode] = row
+    for mode in ("dense", "paged"):
+        print(json.dumps(results[mode]), flush=True)
+
+    speedup = (results["paged"]["completed_per_s"]
+               / results["dense"]["completed_per_s"])
+    ttft_win = (results["paged"]["p95_ttft_s"]
+                < results["dense"]["p95_ttft_s"])
+    verdict = {"metric": "serve_bench_paged_req_per_s_speedup",
+               "value": round(speedup, 3), "unit": "x",
+               "load": args.load, "prompts": P, "repeats": R,
+               "hbm_parity_pool_blocks": pool_blocks,
+               "paged_req_per_s": results["paged"]["completed_per_s"],
+               "dense_req_per_s": results["dense"]["completed_per_s"],
+               "ttft_p95_dense_s": results["dense"]["p95_ttft_s"],
+               "ttft_p95_paged_s": results["paged"]["p95_ttft_s"],
+               "ttft_p95_win": ttft_win,
+               "radix_full_hits": results["paged"]["radix_full_hits"],
+               "prefix_hit_tokens": results["paged"]["prefix_hit_tokens"],
+               "cow_forks": results["paged"]["cow_forks"],
+               "tokens_bitwise_exact": True}
+    print(json.dumps(verdict), flush=True)
+    return 0 if (not args.assert_win
+                 or (speedup >= 1.3 and ttft_win)) else 1
+
+
 def bench(args):
     import jax
     import jax.numpy as jnp
@@ -382,8 +594,19 @@ def main(argv=None):
                          "independent requests; reports completed images/s "
                          "+ the amortization ledger (graftloom)")
     ap.add_argument("--n_groups", type=int, default=16,
-                    help="candidate-mode group count")
+                    help="candidate-mode group count / paged-mode distinct "
+                         "prompt count")
+    ap.add_argument("--paged", action="store_true",
+                    help="prefix-overlap sweep: serve a repeated-prompt "
+                         "trace dense vs paged-KV at HBM parity; reports "
+                         "completed req/s + TTFT p95 + the radix ledger "
+                         "(graftpage). --assert_win requires paged ≥ 1.3× "
+                         "dense req/s AND a TTFT p95 win")
+    ap.add_argument("--repeats", type=int, default=12,
+                    help="paged-mode repeats per distinct prompt")
     args = ap.parse_args(argv)
+    if args.paged:
+        return bench_paged(args)
     if args.candidates and args.candidates > 1:
         return bench_candidates(args)
     return bench(args)

@@ -68,6 +68,24 @@ def build_parser():
     eng.add_argument("--steps_per_sync", type=int, default=4)
     eng.add_argument("--queue_maxsize", type=int, default=64)
     eng.add_argument("--prefill_chunk", type=int, default=0)
+    eng.add_argument("--kv_block_tokens", type=int, default=0,
+                     help="graftpage paged KV: > 0 swaps the dense "
+                          "per-slot cache slab for a fixed block pool + "
+                          "per-slot page tables (device data — admission "
+                          "never recompiles) with radix prefix reuse and "
+                          "COW forks; 0 = dense slabs. Mutually exclusive "
+                          "with --prefill_chunk (docs/SERVING.md)")
+    eng.add_argument("--kv_pool_blocks", type=int, default=None,
+                     help="paged pool size in blocks (default slots x "
+                          "ceil(total_seq_len / kv_block_tokens) — exact "
+                          "HBM parity with the dense slabs; add headroom "
+                          "above parity to keep evicted-before-reuse "
+                          "prefixes resident)")
+    eng.add_argument("--no_radix_cache", dest="radix_cache",
+                     action="store_false",
+                     help="disable the radix prefix cache (paged engine "
+                          "still pages + COW-shares; repeats just stop "
+                          "hitting resident prompt blocks)")
     eng.add_argument("--policy", type=str, default="fifo",
                      choices=["fifo", "priority_deadline"])
     eng.add_argument("--decode_health", action="store_true",
@@ -136,7 +154,10 @@ def build_engine(args):
     return dv.serve_engine(slots=args.slots, precision=args.precision,
                            steps_per_sync=args.steps_per_sync,
                            decode_health=args.decode_health,
-                           prefill_chunk=args.prefill_chunk)
+                           prefill_chunk=args.prefill_chunk,
+                           kv_block_tokens=args.kv_block_tokens,
+                           kv_pool_blocks=args.kv_pool_blocks,
+                           radix_cache=args.radix_cache)
 
 
 def warmup(replica, text_seq_len: int) -> None:

@@ -14,6 +14,12 @@ serving contracts that must never drift:
     ``serve/request_ttft`` span per request with sane timings, and the
     queue-depth / occupancy gauges + token counters are live.
 
+A second phase reruns the workload through the PAGED engine (graftpage,
+``kv_block_tokens=4``): exactness must survive block remaps, radix prefix
+hits and COW forks, repeated prompts must actually hit the radix cache,
+and — after one warmup run — a fresh admission mix must trigger ZERO XLA
+compiles (the page table is device data, never program shape).
+
 Artifacts (smoke.json, serve_spans.jsonl) land in ``--outdir`` — the dir
 ci.yml uploads. Run: JAX_PLATFORMS=cpu python scripts/serve_smoke.py
 """
@@ -138,6 +144,108 @@ def main(argv=None):
           >= args.n_requests * cfg.image_seq_len,
           "serve.tokens_emitted_total covers every request's tokens")
 
+    # ----- phase 2: paged KV (graftpage) ---------------------------------
+    # the same workload through the paged engine: tokens must stay bitwise
+    # the sequential references through block remaps, radix prefix hits and
+    # COW forks — and once one warmup run has compiled the fixed program
+    # set, a fresh run with a DIFFERENT admission mix (staggered arrivals,
+    # repeated prompts, pool churn) must compile NOTHING. That is the
+    # no-recompile invariant: the page table is data, never shape.
+    counter = obs.install_compile_counter()
+    # pool sized for live rows PLUS radix residency: the default (slots ×
+    # blocks/slot) keeps HBM parity with the dense slab but leaves zero
+    # headroom for cached prefixes, so every resident would be evicted
+    # before its repeat arrives — the smoke wants hits to be demonstrable
+    bt = 4
+    blocks_per_slot = -(-cfg.total_seq_len // bt)
+    peng = DecodeEngine(model, params, slots=args.slots,
+                        cache_dtype=cache_dtype, kv_block_tokens=bt,
+                        kv_pool_blocks=(args.slots + args.n_requests)
+                        * blocks_per_slot)
+    # warmup must touch EVERY program in the fixed set: a burst (bulk
+    # refill + step scan), a trickled fresh prompt (the block-width prefill
+    # chunks), and a trickled repeat (radix hit -> COW fork + the width-1
+    # recompute chunk)
+    warm = {2: (4, 3000), 3: (0, 3001)}        # id -> (text idx, seed)
+    warm_refs = {}
+    for rid, (src, seed) in warm.items():
+        ids = model.apply(params, jnp.asarray(texts[src][None]),
+                          jax.random.PRNGKey(seed), cache_dtype=cache_dtype,
+                          method=DALLE.generate_images_tokens)
+        warm_refs[rid] = np.asarray(ids[0])
+    wq = RequestQueue()
+    for i in range(2):
+        wq.submit(texts[i], seed=1000 + i, request_id=i)
+
+    def warm_producer():
+        for rid, (src, seed) in warm.items():
+            time.sleep(0.05)
+            wq.submit(texts[src], seed=seed, request_id=rid)
+        wq.close()
+
+    wth = threading.Thread(target=warm_producer)
+    wth.start()
+    wdone = peng.run(wq)
+    wth.join()
+    check(all(bool((c.tokens == (warm_refs[c.request_id]
+                                 if c.request_id in warm_refs
+                                 else refs[c.request_id])).all())
+              for c in wdone),
+          "paged warmup: token-exact vs the sequential references")
+    warm_hit_tok = peng.stats.prefix_hit_tokens   # stats reset per run()
+    # repeat prompts ride NEW seeds — a radix hit shares prompt KV between
+    # requests whose decodes then diverge; references are sequential and
+    # fully independent, computed BEFORE the zero-compile window opens
+    dup_refs = {}
+    for j, src in enumerate((2, 3)):
+        rid, seed = args.n_requests + j, 4000 + j
+        ids = model.apply(params, jnp.asarray(texts[src][None]),
+                          jax.random.PRNGKey(seed), cache_dtype=cache_dtype,
+                          method=DALLE.generate_images_tokens)
+        dup_refs[rid] = (src, np.asarray(ids[0]), seed)
+    compiles_before = counter.count
+    q2 = RequestQueue()
+    for i in range(2, args.slots + 3):
+        q2.submit(texts[i], seed=1000 + i, request_id=i)
+
+    def paged_producer():
+        for i in range(args.slots + 3, args.n_requests):
+            time.sleep(0.02)
+            q2.submit(texts[i], seed=1000 + i, request_id=i)
+        for rid, (src, _, seed) in dup_refs.items():
+            time.sleep(0.02)
+            q2.submit(texts[src], seed=seed, request_id=rid)
+        q2.close()
+
+    th2 = threading.Thread(target=paged_producer)
+    th2.start()
+    pdone = peng.run(q2)
+    th2.join()
+    paged_compiles = counter.count - compiles_before
+    check(len(pdone) == args.n_requests,
+          f"paged drain: {len(pdone)}/{args.n_requests} requests completed")
+    pexact = all(bool((c.tokens == (dup_refs[c.request_id][1]
+                                    if c.request_id in dup_refs
+                                    else refs[c.request_id])).all())
+                 for c in pdone)
+    check(pexact, "paged: token-exact vs sequential references (radix "
+          "hits and COW forks included)")
+    check(peng.stats.radix_full_hits >= 2,
+          f"paged: repeated prompts hit the radix cache "
+          f"({peng.stats.radix_full_hits} full hits)")
+    check(paged_compiles == 0,
+          f"paged no-recompile invariant: {paged_compiles} XLA compiles "
+          "after warmup (page-table updates are data, not shape)")
+    kv = peng.kv_stats()
+    m2 = obs.metrics_snapshot()
+    # the counter is cumulative across serve loops; the radix ledger and
+    # EngineStats reset per run — the warmup run's hits are part of the
+    # counter's total
+    check(m2.get("kv.prefix_hit_tokens_total", 0)
+          == warm_hit_tok + kv["prefix_hit_tokens"]
+          and kv["prefix_hit_tokens"] > 0,
+          "kv.prefix_hit_tokens_total counter matches the radix ledger")
+
     n_spans = obs.export_spans_jsonl(
         os.path.join(args.outdir, "serve_spans.jsonl"))
     summary = {
@@ -147,6 +255,11 @@ def main(argv=None):
         "refills": eng.stats.refills,
         "occupancy_while_queued": round(occ, 4),
         "token_exact": exact, "spans_exported": n_spans,
+        "paged": {"token_exact": pexact, "compiles_after_warmup":
+                  paged_compiles, "radix_full_hits":
+                  peng.stats.radix_full_hits, "prefix_hit_tokens":
+                  kv["prefix_hit_tokens"], "cow_copies": kv["cow_copies"],
+                  "pages_evicted": peng.stats.pages_evicted},
         "completed_per_s": round(len(done) / wall, 3),
         "p50_latency_s": round(float(np.median(
             [c.latency_s for c in done])), 4) if done else None,

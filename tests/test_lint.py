@@ -784,6 +784,71 @@ def test_untested_op_detection_on_fixtures():
 
 
 # ---------------------------------------------------------------------------
+# page-table-dynamic-shape
+# ---------------------------------------------------------------------------
+
+def test_paged_rule_flags_host_conversions():
+    # int()/.item() on the table are a blocking sync one step away from a
+    # shape or static arg — each block layout would trace its own program
+    src = """
+    def admit(state):
+        first = int(state["pages"][0, 0])
+        top = state["pages"].max().item()
+        return first + top
+    """
+    found = lint_source("page-table-dynamic-shape", src,
+                        rel_path="dalle_tpu/serve/_fixture.py")
+    assert len(found) == 2
+    assert all("device data" in f.message for f in found)
+
+
+def test_paged_rule_flags_value_branch_and_shape_arg():
+    src = """
+    import jax.numpy as jnp
+    def plan(pages, n):
+        if pages[0, 0] >= 0:
+            return jnp.zeros((pages[0, 1], n))
+        while pages.min() < 0:
+            n += 1
+        return None
+    """
+    found = lint_source("page-table-dynamic-shape", src,
+                        rel_path="dalle_tpu/serve/_fixture.py")
+    msgs = [f.message for f in found]
+    assert len(found) == 3
+    assert any("`if` test" in m for m in msgs)
+    assert any("`while` test" in m for m in msgs)
+    assert any("shape argument" in m for m in msgs)
+
+
+def test_paged_rule_clean_cases():
+    # is-None engine probes, the table's OWN static shape, host mirrors
+    # (_pages_host suffix), data-plane gathers, and out-of-scope paths
+    # must all stay silent
+    src = """
+    import jax.numpy as jnp
+    def bind(state, cache):
+        pages = state.get("pages")
+        if pages is None:
+            return cache
+        width = pages.shape[1]
+        page = jnp.take_along_axis(pages, jnp.zeros((2, 1), jnp.int32), 1)
+        return cache.replace(pages=pages), page, width
+    def mirror(self, slot, blocks):
+        self._pages_host[slot, :] = -1
+        return int(self._pages_host[slot, 0])
+    """
+    assert lint_source("page-table-dynamic-shape", src,
+                       rel_path="dalle_tpu/ops/_fixture.py") == []
+    bad = """
+    def f(pages):
+        return int(pages[0, 0])
+    """
+    assert lint_source("page-table-dynamic-shape", bad,
+                       rel_path="dalle_tpu/train/_fixture.py") == []
+
+
+# ---------------------------------------------------------------------------
 # the repo itself
 # ---------------------------------------------------------------------------
 

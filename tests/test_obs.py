@@ -684,6 +684,39 @@ def test_report_gateway_by_tenant_parses_labeled_counters(tmp_path):
     assert gw["verdict"] == "ADMISSION-LIMITED"
 
 
+def test_report_paged_kv_hit_rate_and_verdict(tmp_path):
+    """graftpage section: pool gauges + mode-tagged prefill spans render
+    the radix hit-rate line; the verdict flips on tokens actually served
+    from cache, and dense-slab runs get no section at all."""
+    path = str(tmp_path / "metrics.jsonl")
+    with open(path, "w") as fh:
+        fh.write(json.dumps({
+            "step": 0, "kv.pages_free": 10.0, "kv.pages_used": 14.0,
+            "kv.pages_shared": 3.0, "kv.pages_cow_copies": 2.0,
+            "kv.prefix_hit_tokens_total": 21.0}) + "\n")
+        for mode in ("paged-hit", "paged-hit", "paged-partial", "paged"):
+            fh.write(json.dumps({
+                "name": "serve/prefill", "t0_rel_s": 0.0, "dur_s": 0.01,
+                "trace_id": "t", "depth": 0,
+                "args": {"mode": mode}}) + "\n")
+    text = obs_report.summarize_run(path)
+    assert "paged KV (graftpage)" in text
+    assert "radix hit-rate 75% over 4 admissions (2 full, 1 partial)" in text
+    assert "21 prompt tokens served from cache" in text
+    assert "PAGED-KV: prefix-sharing" in text
+
+    with open(path, "w") as fh:
+        fh.write(json.dumps({
+            "step": 0, "kv.pages_free": 0.0, "kv.pages_used": 24.0,
+            "kv.prefix_hit_tokens_total": 0.0}) + "\n")
+    cold = obs_report.summarize_run(path)
+    assert "PAGED-KV: cold" in cold
+
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"step": 0, "gateway.inflight": 0.0}) + "\n")
+    assert "paged KV" not in obs_report.summarize_run(path)
+
+
 # -- SIGUSR2 on-demand profiler (scripts/_common.py, PR 8 satellite) --------
 
 def _load_common():
