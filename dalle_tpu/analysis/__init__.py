@@ -16,6 +16,15 @@ Public surface:
 
 The runtime companion (jit-recompilation budgets for tests) lives in
 :mod:`dalle_tpu.analysis.recompile_guard`.
+
+Four sibling audit layers share this package but gate through their own
+CLIs rather than the lint registry (each with a committed golden under
+``contracts/`` and the same ``--check``/``--update`` exit-code split):
+graftir (:mod:`ir_flow`, jaxpr/HLO contracts), graftnum
+(:mod:`precision_flow`, quantization dataflow), graftsync
+(:mod:`sync_flow`, locksets + lock-order graph) and graftwire
+(:mod:`wire_flow`, the cross-process fleet protocol + lifecycle state
+machines). See docs/ANALYSIS.md for the full layer table.
 """
 
 from .core import (  # noqa: F401

@@ -1,0 +1,544 @@
+"""graftwire rules + golden protocol contract (the wire_audit.py machinery).
+
+Rules consume the :class:`~dalle_tpu.analysis.wire_flow.WireModel` — they
+join sender and receiver schemas ACROSS the process boundary, so they do
+not register in the graftlint per-file registry; ``scripts/wire_audit.py``
+is their CLI, with the graftir golden workflow (``contracts/wire.json``,
+``--check`` / ``--update`` / ``--explain``) and
+``# graftwire: allow=<rule> -- <reason>`` waivers.
+
+| rule | hazard |
+|---|---|
+| ``wire-field-unread`` | a field is serialized onto a channel but no mapped receiver ever reads it — dead wire weight, or a consumer the endpoint map forgot |
+| ``wire-field-unsourced`` | a receiver reads a field no sender path of the channel ever sets — it silently sees the ``.get`` default forever |
+| ``wire-optional-no-default`` | a receiver SUBSCRIPTS a field some sender path omits — the KeyError that kills a replica worker mid-stream |
+| ``wire-verb-orphan`` | a verb is sent but never dispatched server-side (or dispatched but never sent) |
+| ``undeclared-lifecycle-transition`` | a ``record_event`` emission the declared request/replica state machines cannot place (or a machine with a cycle) |
+
+The golden (``contracts/wire.json``) pins verbs × direction × field sets ×
+lifecycle edges with ``file::function`` endpoint sites and NO line
+numbers; drift lines name the verb, the field and both endpoint sites, so
+a protocol change lands only with an explicit, reviewable golden update.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import REPO_ROOT, Finding
+from . import wire_flow
+from .wire_flow import (Channel, EVENT_EDGES, LIFECYCLES, WireModel,
+                        lifecycle_cycles)
+
+SCHEMA = 1
+
+WIRE_RULES: Dict[str, str] = {
+    "wire-field-unread":
+        "field sent on a wire channel but never read by any mapped "
+        "receiver",
+    "wire-field-unsourced":
+        "field read off a wire channel but never sent by any sender path",
+    "wire-optional-no-default":
+        "receiver subscripts a field some sender path omits",
+    "wire-verb-orphan":
+        "verb sent but never dispatched, or dispatched but never sent",
+    "undeclared-lifecycle-transition":
+        "emitted event is not a declared request/replica lifecycle "
+        "transition",
+}
+
+
+def _chan_name(verb: str, direction: str, kind: Optional[str]) -> str:
+    base = f"{verb}.{direction}"
+    return f"{base}.{kind}" if kind is not None else base
+
+
+def _sites(items) -> str:
+    return ", ".join(sorted({i.site for i in items}))
+
+
+def _site_path_line(site: str, line: int) -> Tuple[str, int]:
+    return site.split("::", 1)[0], line
+
+
+# --------------------------------------------------------------------------
+# the rules
+# --------------------------------------------------------------------------
+
+def _stream_union(channels, verb: str) -> Tuple[Set[str], bool]:
+    """(union of sent fields, any-sender-dynamic) across every stream
+    sub-channel of ``verb`` — kind-agnostic readers see them all."""
+    fields: Set[str] = set()
+    dynamic = False
+    for (v, d, _k), ch in channels.items():
+        if v == verb and d == "stream":
+            fields |= ch.sent_fields
+            dynamic = dynamic or ch.dynamic
+    return fields, dynamic
+
+
+def check_field_unread(model: WireModel) -> List[Finding]:
+    out = []
+    for (verb, direction, kind), ch in sorted(
+            model.channels().items(), key=lambda kv: str(kv[0])):
+        if direction == "stream" and kind is None:
+            continue                    # aggregate view, not a channel
+        if ch.open or not ch.senders or not ch.reads:
+            # open receivers are policy (CHANNEL_POLICY); a channel with
+            # no mapped reader at all is either policy-open or handled by
+            # golden drift, not a per-field finding
+            continue
+        read = ch.read_fields
+        for field in sorted(ch.sent_fields - read):
+            sender = min(ch.senders, key=lambda s: (s.site, s.line))
+            path, line = _site_path_line(sender.site, sender.line)
+            out.append(Finding(
+                "wire-field-unread", path, line,
+                f"field '{field}' of {_chan_name(verb, direction, kind)} "
+                f"is sent by {_sites(ch.senders)} but no mapped receiver "
+                f"({_sites(ch.reads) or 'none'}) reads it — drop it or "
+                f"map the consumer in wire_flow.ENDPOINTS"))
+    return out
+
+
+def check_field_unsourced(model: WireModel) -> List[Finding]:
+    out = []
+    channels = model.channels()
+    # one physical read (site, line, field) may map to several channels
+    # (overlapping Recv specs, e.g. the shared submit/submit_group ack
+    # reader): the variable holds a message from ONE of them at runtime,
+    # so the field is unsourced only if NO mapped channel sets it
+    groups: Dict[Tuple[str, int, str], List] = {}
+    for r in model.reads:
+        groups.setdefault((r.site, r.line, r.field), []).append(r)
+    for (site, line, field), reads in sorted(groups.items()):
+        sourced = False
+        names = []
+        for r in reads:
+            if r.direction == "stream":
+                fields, dynamic = _stream_union(channels, r.verb)
+            else:
+                ch = channels.get((r.verb, r.direction, None))
+                if ch is None or not ch.senders:
+                    sourced = True      # no sender mapped: golden territory
+                    break
+                fields, dynamic = ch.sent_fields, ch.dynamic
+            if dynamic or not fields or field in fields:
+                sourced = True
+                break
+            names.append(_chan_name(r.verb, r.direction, r.kind))
+        if sourced:
+            continue
+        path, fline = _site_path_line(site, line)
+        out.append(Finding(
+            "wire-field-unsourced", path, fline,
+            f"{site.split('::')[-1]} reads '{field}' off "
+            f"{', '.join(sorted(set(names)))} but no sender path sets it "
+            f"— the read sees its default forever"))
+    return out
+
+
+def check_optional_no_default(model: WireModel) -> List[Finding]:
+    out, seen = [], set()
+    channels = model.channels()
+    for r in model.reads:
+        if not r.hard:
+            continue
+        if r.direction == "stream":
+            # a hard read against every sub-channel where the field occurs
+            targets = [ch for (v, d, k), ch in channels.items()
+                       if v == r.verb and d == "stream" and k is not None
+                       and (r.kind is None or k == r.kind)
+                       and r.field in ch.sent_fields]
+        else:
+            ch = channels.get((r.verb, r.direction, None))
+            targets = [ch] if ch is not None and ch.senders else []
+        for ch in targets:
+            static = [s for s in ch.senders if not s.dynamic]
+            if not static:
+                continue
+            missing = [s for s in static
+                       if r.field not in s.fields or r.field in s.optional]
+            if not missing:
+                continue
+            dedup = (r.site, r.line, r.field, ch.kind)
+            if dedup in seen:
+                continue
+            seen.add(dedup)
+            path, line = _site_path_line(r.site, r.line)
+            out.append(Finding(
+                "wire-optional-no-default", path, line,
+                f"{r.site.split('::')[-1]} subscripts '{r.field}' of "
+                f"{_chan_name(ch.verb, ch.direction, ch.kind)} but sender "
+                f"path {_sites(missing)} omits it — a KeyError here kills "
+                f"the worker mid-stream; use .get with a default or make "
+                f"every sender set it"))
+    return out
+
+
+def check_verb_orphans(model: WireModel) -> List[Finding]:
+    out = []
+    sent = {}
+    for u in model.sent_verbs:
+        sent.setdefault(u.verb, u)
+    dispatched = {}
+    for u in model.dispatched_verbs:
+        dispatched.setdefault(u.verb, u)
+    for verb in sorted(set(sent) - set(dispatched)):
+        u = sent[verb]
+        path, line = _site_path_line(u.site, u.line)
+        out.append(Finding(
+            "wire-verb-orphan", path, line,
+            f"verb '{verb}' is sent by {u.site} but no server dispatch "
+            f"compares against it — requests would draw the unknown_verb "
+            f"error ack"))
+    for verb in sorted(set(dispatched) - set(sent)):
+        u = dispatched[verb]
+        path, line = _site_path_line(u.site, u.line)
+        out.append(Finding(
+            "wire-verb-orphan", path, line,
+            f"verb '{verb}' is dispatched at {u.site} but no client ever "
+            f"sends it — dead protocol surface"))
+    return out
+
+
+def check_lifecycles(model: WireModel) -> List[Finding]:
+    out = []
+    for cycle in lifecycle_cycles():
+        out.append(Finding(
+            "undeclared-lifecycle-transition",
+            "dalle_tpu/analysis/wire_flow.py", 1,
+            f"lifecycle machine '{cycle[0]}' declares a cycle "
+            f"{' -> '.join(cycle[1:])} — machines must be acyclic"))
+    for e in sorted(model.events, key=lambda e: (e.site, e.line, e.name)):
+        path, line = _site_path_line(e.site, e.line)
+        edges = EVENT_EDGES.get(e.name)
+        if edges is None:
+            out.append(Finding(
+                "undeclared-lifecycle-transition", path, line,
+                f"record_event('{e.name}') at {e.site} is not mapped to "
+                f"any declared lifecycle transition — add it to "
+                f"wire_flow.EVENT_EDGES (as a transition or explicitly "
+                f"non-lifecycle)"))
+            continue
+        for machine, src, dst in edges:
+            declared = LIFECYCLES.get(machine, {}).get("edges", ())
+            if (src, dst) not in declared:
+                out.append(Finding(
+                    "undeclared-lifecycle-transition", path, line,
+                    f"event '{e.name}' at {e.site} claims transition "
+                    f"{machine}:{src}->{dst}, which machine '{machine}' "
+                    f"does not declare"))
+    return out
+
+
+_CHECKS = (check_field_unread, check_field_unsourced,
+           check_optional_no_default, check_verb_orphans,
+           check_lifecycles)
+
+
+def run_wire(model: WireModel) -> List[Finding]:
+    findings: List[Finding] = []
+    for check in _CHECKS:
+        findings.extend(check(model))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# golden protocol contract (contracts/wire.json)
+# --------------------------------------------------------------------------
+
+def _channel_entry(ch: Channel) -> dict:
+    return {
+        "sender": {
+            "fields": sorted(ch.sent_fields),
+            "optional": sorted(ch.optional_fields),
+            "dynamic": ch.dynamic,
+            "sites": sorted({s.site for s in ch.senders}),
+        },
+        "receiver": {
+            "fields": sorted(ch.read_fields),
+            "sites": sorted({r.site for r in ch.reads}),
+            "open": ch.open,
+        },
+    }
+
+
+def wire_contract(model: WireModel) -> dict:
+    """The golden: verbs × direction × field sets × lifecycle edges. Keyed
+    on stable identities (verbs, fields, file::function sites) — NOT line
+    numbers, so unrelated edits don't read as drift."""
+    verbs: Dict[str, dict] = {}
+    for (verb, direction, kind), ch in model.channels().items():
+        if not ch.senders and not ch.reads:
+            continue
+        v = verbs.setdefault(verb, {})
+        if direction == "stream":
+            v.setdefault("stream", {})[kind or "*"] = _channel_entry(ch)
+        else:
+            v[direction] = _channel_entry(ch)
+    events: Dict[str, dict] = {}
+    for e in model.events:
+        entry = events.setdefault(e.name, {"edges": [], "sites": set()})
+        entry["sites"].add(e.site)
+        entry["edges"] = sorted(
+            f"{m}:{s}->{d}" for m, s, d in EVENT_EDGES.get(e.name, ()))
+    return {
+        "schema": SCHEMA,
+        "verbs": verbs,
+        "lifecycles": {
+            name: {"states": sorted(m["states"]),
+                   "edges": sorted([s, d] for s, d in m["edges"])}
+            for name, m in LIFECYCLES.items()},
+        "events": {name: {"edges": entry["edges"],
+                          "sites": sorted(entry["sites"])}
+                   for name, entry in events.items()},
+    }
+
+
+def save_contract(contract: dict, path: str) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(contract, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+
+
+def load_contract(path: str) -> Optional[dict]:
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def _iter_channels(contract: dict):
+    for verb, dirs in contract.get("verbs", {}).items():
+        for direction, entry in dirs.items():
+            if direction == "stream":
+                for kind, sub in entry.items():
+                    yield (verb, "stream", kind), sub
+            else:
+                yield (verb, direction, None), entry
+
+
+def _endpoint_sites(entry: dict) -> str:
+    """'sender A, B; receiver C' — both file::function endpoint sites of a
+    channel, the drift line's anchor."""
+    s = ", ".join(entry["sender"]["sites"]) or "none"
+    r = ", ".join(entry["receiver"]["sites"]) or "none"
+    return f"sender {s}; receiver {r}"
+
+
+def diff_contract(old: dict, new: dict) -> List[str]:
+    """Human-readable drift lines; empty == no drift. Field lines name the
+    verb, the field, and both endpoint sites."""
+    lines: List[str] = []
+    oc = dict(_iter_channels(old))
+    nc = dict(_iter_channels(new))
+    overbs = {v for v, _, _ in oc}
+    nverbs = {v for v, _, _ in nc}
+    for verb in sorted(nverbs - overbs):
+        lines.append(f"+ verb {verb}")
+    for verb in sorted(overbs - nverbs):
+        lines.append(f"- verb {verb}")
+    for key in sorted(set(oc) | set(nc), key=str):
+        verb, direction, kind = key
+        name = _chan_name(verb, direction, kind)
+        o, n = oc.get(key), nc.get(key)
+        if o is None:
+            lines.append(f"+ channel {name} ({_endpoint_sites(n)})")
+            continue
+        if n is None:
+            lines.append(f"- channel {name} ({_endpoint_sites(o)})")
+            continue
+        for sign, a, b in (("+", n, o), ("-", o, n)):
+            anchor = a if sign == "+" else o
+            for f in sorted(set(a["sender"]["fields"])
+                            - set(b["sender"]["fields"])):
+                lines.append(f"{sign} field {name} {f} "
+                             f"({_endpoint_sites(anchor)})")
+            for f in sorted(set(a["receiver"]["fields"])
+                            - set(b["receiver"]["fields"])):
+                lines.append(f"{sign} read {name} {f} "
+                             f"({_endpoint_sites(anchor)})")
+            for s in sorted(set(a["sender"]["sites"])
+                            - set(b["sender"]["sites"])):
+                lines.append(f"{sign} sender {name} at {s}")
+            for s in sorted(set(a["receiver"]["sites"])
+                            - set(b["receiver"]["sites"])):
+                lines.append(f"{sign} receiver {name} at {s}")
+        if o["sender"]["dynamic"] != n["sender"]["dynamic"]:
+            lines.append(f"~ {name} sender dynamic: "
+                         f"{o['sender']['dynamic']} -> "
+                         f"{n['sender']['dynamic']}")
+        if o["receiver"]["open"] != n["receiver"]["open"]:
+            lines.append(f"~ {name} receiver open: "
+                         f"{o['receiver']['open']} -> "
+                         f"{n['receiver']['open']}")
+    ol = old.get("lifecycles", {})
+    nl = new.get("lifecycles", {})
+    for machine in sorted(set(ol) | set(nl)):
+        oe = {tuple(e) for e in ol.get(machine, {}).get("edges", [])}
+        ne = {tuple(e) for e in nl.get(machine, {}).get("edges", [])}
+        for s, d in sorted(ne - oe):
+            lines.append(f"+ lifecycle-edge {machine}: {s} -> {d}")
+        for s, d in sorted(oe - ne):
+            lines.append(f"- lifecycle-edge {machine}: {s} -> {d}")
+    oev = old.get("events", {})
+    nev = new.get("events", {})
+    for name in sorted(set(nev) - set(oev)):
+        e = nev[name]
+        lines.append(f"+ event {name} -> "
+                     f"{', '.join(e['edges']) or 'non-lifecycle'} "
+                     f"(at {', '.join(e['sites'])})")
+    for name in sorted(set(oev) - set(nev)):
+        lines.append(f"- event {name}")
+    for name in sorted(set(oev) & set(nev)):
+        if oev[name]["edges"] != nev[name]["edges"]:
+            lines.append(f"~ event {name} edges: "
+                         f"{', '.join(oev[name]['edges']) or 'none'} -> "
+                         f"{', '.join(nev[name]['edges']) or 'none'}")
+        elif oev[name]["sites"] != nev[name]["sites"]:
+            lines.append(f"~ event {name} sites: "
+                         f"{', '.join(oev[name]['sites'])} -> "
+                         f"{', '.join(nev[name]['sites'])}")
+    return lines
+
+
+# --------------------------------------------------------------------------
+# audit orchestration (CLI + tests)
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class WireReport:
+    findings: List[Finding]                  # unwaived rule findings
+    waived: List[Tuple[Finding, str]]        # (finding, reason)
+    problems: List[str]                      # waiver syntax issues
+    drift: List[str]                         # golden drift lines
+    missing: bool                            # no golden yet
+    contract: dict                           # the live contract
+    model: WireModel
+    updated: bool = False
+
+    @property
+    def failed(self) -> bool:
+        return bool(self.findings or self.problems or self.drift)
+
+
+def _apply_waivers(findings: Sequence[Finding],
+                   sources: Dict[str, str]
+                   ) -> Tuple[List[Finding], List[Tuple[Finding, str]],
+                              List[str]]:
+    """Split findings into (unwaived, waived-with-reason, problems) using
+    per-file ``# graftwire: allow=`` comments (finding line or line above)."""
+    by_file: Dict[str, Dict[Tuple[str, int], str]] = {}
+    problems: List[str] = []
+    for path, src in sources.items():
+        waivers, probs = wire_flow.collect_waivers(
+            src, path, tuple(WIRE_RULES))
+        problems.extend(probs)
+        table = by_file.setdefault(path, {})
+        for w in waivers:
+            table[(w.rule, w.line)] = w.reason
+    unwaived, waived = [], []
+    for f in findings:
+        table = by_file.get(f.path, {})
+        reason = table.get((f.rule, f.line)) or table.get((f.rule, f.line - 1))
+        if reason is not None:
+            waived.append((f, reason))
+        else:
+            unwaived.append(f)
+    return unwaived, waived, problems
+
+
+def audit(repo_root: str = REPO_ROOT,
+          contract_path: Optional[str] = None,
+          update: bool = False,
+          paths: Optional[Sequence[str]] = None) -> WireReport:
+    """Build the protocol model over the wire roots, run the rules, apply
+    waivers, and compare (or rewrite) the golden contract."""
+    if contract_path is None:
+        contract_path = os.path.join(repo_root, "contracts", "wire.json")
+    rels = list(paths) if paths is not None \
+        else wire_flow.wire_files(repo_root)
+    sources = {}
+    for rel in rels:
+        with open(os.path.join(repo_root, rel), encoding="utf-8") as fh:
+            sources[rel] = fh.read()
+    model = wire_flow.build_model(sorted(sources.items()))
+    live = wire_contract(model)
+    unwaived, waived, problems = _apply_waivers(run_wire(model), sources)
+
+    if update:
+        save_contract(live, contract_path)
+        return WireReport(unwaived, waived, problems, [], False, live,
+                          model, updated=True)
+
+    golden = load_contract(contract_path)
+    if golden is None:
+        return WireReport(unwaived, waived, problems, [], True, live, model)
+    return WireReport(unwaived, waived, problems,
+                      diff_contract(golden, live), False, live, model)
+
+
+def render_report(report: WireReport, scope: str) -> str:
+    lines = [str(f) for f in report.findings]
+    lines += [f"{f} [waived: {reason}]" for f, reason in report.waived]
+    lines += [f"waiver-problem: {p}" for p in report.problems]
+    for d in report.drift:
+        lines.append(f"wire-contract drift: {d}")
+    if report.missing:
+        lines.append("no golden protocol contract at contracts/wire.json "
+                     "— run scripts/wire_audit.py --update")
+    n = len(report.findings) + len(report.problems)
+    if report.failed:
+        lines.append(
+            f"graftwire: {n} finding{'s' if n != 1 else ''}"
+            + (f", {len(report.drift)} drift line"
+               f"{'s' if len(report.drift) != 1 else ''}"
+               if report.drift else "")
+            + f" ({scope})")
+        if report.drift:
+            lines.append("intentional protocol change? regenerate with "
+                         "scripts/wire_audit.py --update and commit the "
+                         "diff — it is the PR's reviewable wire story")
+    else:
+        lines.append(f"graftwire: clean ({scope})")
+    return "\n".join(lines)
+
+
+def explain(model: WireModel) -> str:
+    """Pretty-print the protocol: channels, fields, verbs, lifecycles
+    (the --explain CLI path)."""
+    channels = model.channels()
+    lines = [f"channels ({sum(1 for k in channels if not (k[1] == 'stream' and k[2] is None))}):"]
+    for key in sorted(channels, key=str):
+        verb, direction, kind = key
+        if direction == "stream" and kind is None:
+            continue
+        ch = channels[key]
+        tag = "".join([" [dynamic]" if ch.dynamic else "",
+                       " [open]" if ch.open else ""])
+        lines.append(f"  {_chan_name(verb, direction, kind)}{tag}")
+        opt = ch.optional_fields
+        lines.append("    sent: " + (", ".join(
+            f + ("?" if f in opt else "")
+            for f in sorted(ch.sent_fields)) or "(none)"))
+        lines.append("      by: " + (_sites(ch.senders) or "(unmapped)"))
+        lines.append("    read: " + (", ".join(sorted(ch.read_fields))
+                                     or "(none)"))
+        lines.append("      by: " + (_sites(ch.reads) or "(unmapped)"))
+    sent = sorted({u.verb for u in model.sent_verbs})
+    disp = sorted({u.verb for u in model.dispatched_verbs})
+    lines.append(f"verbs sent: {', '.join(sent)}")
+    lines.append(f"verbs dispatched: {', '.join(disp)}")
+    lines.append("lifecycles:")
+    for name, machine in sorted(LIFECYCLES.items()):
+        lines.append(f"  {name}: "
+                     + "; ".join(f"{s}->{d}" for s, d in machine["edges"]))
+    emitted = sorted({e.name for e in model.events})
+    lines.append(f"events emitted ({len(emitted)}): {', '.join(emitted)}")
+    return "\n".join(lines)

@@ -1,0 +1,804 @@
+"""graftwire — static wire-protocol + lifecycle model of the fleet RPC.
+
+The fleet speaks a hand-grown protocol — ``submit``/``submit_group``/
+``health``/``drain``/``telemetry`` verbs over length-prefixed JSON frames
+(fleet/transport.py), a one-line JSON handshake (scripts/serve_replica.py
+→ fleet/manager.py) and the gateway's SSE event stream — and bitwise-exact
+failover depends on both endpoints agreeing on every field. No other
+analysis layer sees across that socket: graftsync's model stops at the
+process boundary, graftlint reads one call site. This module builds the
+cross-process model:
+
+  * **sent schemas** — every dict that goes onto the wire, recovered from
+    the AST at the send sites (``send_frame(...)``/``call(...)``/
+    ``sse_event(...)``/``print(json.dumps(...))``/``return`` for reply
+    builders), including incrementally-built dicts (``h.update(ok=...)``,
+    ``out["k"] = v``, ``setdefault``) and conditional ``**{...} if ...``
+    spreads (optional fields). A dict fed from a call
+    (``telemetry_payload(...)``) is *dynamic* — its full key set is not
+    statically known and source-side rules soften accordingly.
+  * **read schemas** — every ``msg.get("k")`` (soft) and ``msg["k"]``
+    (hard) read of a wire message, attributed to its channel through the
+    curated :data:`ENDPOINTS` map (which variables in which functions ARE
+    wire messages, and of which verb/direction).
+  * **channels** — the (verb × direction) join of the two, with stream
+    verbs split per ``kind`` sub-channel; :data:`CHANNEL_POLICY` marks
+    reflective channels (health/telemetry replies, the operator-facing
+    handshake line, SSE) whose receivers are deliberately open-ended.
+  * **verb dispatch** — verbs sent (``{"verb": ...}`` request dicts) vs
+    verbs dispatched (``verb == "submit"`` comparisons against a name
+    bound from ``msg.get("verb")``): an asymmetry is an orphan.
+  * **lifecycle machines** — the request and replica state machines
+    (:data:`LIFECYCLES`, both acyclic) plus the :data:`EVENT_EDGES` map
+    from every ``record_event`` name emitted in the wire roots to its
+    declared transition(s); an emission the map can't place is a finding.
+
+The model is pure AST — no imports of the analyzed code. Rules live in
+:mod:`dalle_tpu.analysis.rules_wire`; the CLI is ``scripts/wire_audit.py``
+(golden protocol contract in ``contracts/wire.json``); the runtime half is
+:mod:`dalle_tpu.obs.wiretap` (an opt-in frame tap in fleet/transport.py —
+the smokes assert every observed frame ⊆ this golden). Waivers are source
+comments on the finding's line or the line above::
+
+    # graftwire: allow=wire-field-unread -- <reason>
+
+A waiver without a reason, or naming an unknown rule, is itself a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from typing import (Dict, FrozenSet, Iterable, List, Optional, Sequence,
+                    Set, Tuple)
+
+from .core import REPO_ROOT, iter_repo_files
+from .jit_scan import dotted_name
+
+# every package that puts bytes on (or takes bytes off) the fleet wire
+WIRE_ROOTS = ("dalle_tpu/fleet", "dalle_tpu/gateway", "dalle_tpu/serve",
+              "scripts/serve_replica.py")
+
+_WAIVER_RE = re.compile(r"#\s*graftwire:\s*allow=([\w\-]+)(?:\s*--\s*(.*))?")
+
+# calls whose argument (by index) is a dict that goes onto the wire
+_SEND_CALLS = {"send_frame": 1, "call": 1, "sse_event": 1,
+               "_open_stream": 0}
+
+
+# --------------------------------------------------------------------------
+# extracted facts
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class SentDict:
+    """One dict observed at a send site, classified onto a channel."""
+    verb: str
+    direction: str              # request | reply | stream
+    kind: Optional[str]         # stream sub-kind ("*" when not constant)
+    fields: FrozenSet[str]
+    optional: FrozenSet[str]    # conditional-spread keys
+    dynamic: bool               # fed from a call / non-constant update
+    site: str                   # path::qualname
+    line: int
+
+
+@dataclasses.dataclass(frozen=True)
+class FieldRead:
+    verb: str
+    direction: str
+    kind: Optional[str]         # stream sub-kind; None = kind-agnostic
+    field: str
+    hard: bool                  # subscript (KeyError on absence) vs .get
+    site: str
+    line: int
+
+
+@dataclasses.dataclass(frozen=True)
+class EventEmit:
+    name: str
+    site: str
+    line: int
+
+
+@dataclasses.dataclass(frozen=True)
+class VerbUse:
+    verb: str
+    site: str
+    line: int
+
+
+# --------------------------------------------------------------------------
+# endpoint map: which functions touch the wire, and in which role
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Send:
+    """This function sends on (verb, direction). Dicts captured at send
+    calls are classified here; ``returns=True`` additionally captures
+    ``return <dict>`` (reply-builder helpers like ``_health``)."""
+    verb: str
+    direction: str
+    returns: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Recv:
+    """In this function, reads of the named variables are reads of a
+    (verb, direction) wire message. ``kind`` narrows a stream read to one
+    sub-channel (None = the reader sees every kind)."""
+    verb: str
+    direction: str
+    vars: Tuple[str, ...]
+    kind: Optional[str] = None
+
+
+_T = "dalle_tpu/fleet/transport.py"
+_M = "dalle_tpu/fleet/manager.py"
+_C = "dalle_tpu/fleet/controller.py"
+_R = "dalle_tpu/gateway/replica.py"
+_G = "dalle_tpu/gateway/server.py"
+_S = "scripts/serve_replica.py"
+
+_ALL_VERBS = ("submit", "submit_group", "health", "drain", "telemetry")
+
+# path::qualname -> endpoint specs. This is the curated half of the model:
+# the extractor recovers field sets generically, but WHICH variable is a
+# wire message (and on which channel) is a protocol fact, pinned here.
+ENDPOINTS: Dict[str, Tuple[object, ...]] = {
+    # -- client (RemoteReplica) -------------------------------------------
+    f"{_T}::RemoteReplica.__init__": (
+        Recv("health", "reply", ("first",)),),
+    f"{_T}::RemoteReplica._observe_clock": (
+        Recv("health", "reply", ("reply",)),
+        Recv("telemetry", "reply", ("reply",)),),
+    f"{_T}::RemoteReplica._track_progress": (
+        Recv("health", "reply", ("h",)),),
+    f"{_T}::RemoteReplica.healthy": (
+        Recv("health", "reply", ("self._last_health",)),),
+    f"{_T}::RemoteReplica.load": (
+        Recv("health", "reply", ("h",)),),
+    f"{_T}::RemoteReplica._open_stream": (
+        Recv("submit", "reply", ("ack",)),
+        Recv("submit_group", "reply", ("ack",)),
+        Recv("any", "reply", ("ack",)),),
+    f"{_T}::RemoteReplica.migrate": (
+        Recv("drain", "reply", ("reply",)),),
+    f"{_T}::RemoteCompletion.__init__": (
+        Recv("submit", "stream", ("frame",), kind="done"),
+        Recv("submit_group", "stream", ("frame",), kind="done"),),
+    f"{_T}::RemoteResultStream.events": (
+        Recv("submit", "stream", ("frame",)),),
+    f"{_T}::RemoteGroupStream.events": (
+        Recv("submit_group", "stream", ("frame",)),),
+    # -- server (ReplicaServer) -------------------------------------------
+    f"{_T}::ReplicaServer._serve_conn": (
+        Send("any", "reply"),
+        *(Recv(v, "request", ("msg",)) for v in _ALL_VERBS)),
+    f"{_T}::ReplicaServer._health": (
+        Send("health", "reply", returns=True),),
+    f"{_T}::ReplicaServer._telemetry": (
+        Send("telemetry", "reply", returns=True),
+        Recv("telemetry", "request", ("msg",)),),
+    f"{_T}::ReplicaServer._submit_kwargs": (
+        Recv("submit", "request", ("msg",)),
+        Recv("submit_group", "request", ("msg",)),),
+    f"{_T}::ReplicaServer._handle_submit": (
+        Send("submit", "reply"), Send("submit", "stream"),
+        Recv("submit", "request", ("msg",)),),
+    f"{_T}::ReplicaServer._handle_group": (
+        Send("submit_group", "reply"), Send("submit_group", "stream"),
+        Recv("submit_group", "request", ("msg",)),),
+    f"{_T}::ReplicaServer._failed_frame": (
+        Send("submit", "stream", returns=True),
+        Send("submit_group", "stream", returns=True),),
+    f"{_T}::ReplicaServer._handle_drain": (
+        Send("drain", "reply"),
+        Recv("drain", "request", ("msg",)),),
+    # -- handshake (stdout JSON line, not a frame) ------------------------
+    f"{_S}::main": (
+        Send("handshake", "reply"),),
+    f"{_M}::FleetManager.spawn": (
+        Recv("handshake", "reply", ("shake",)),),
+    f"{_C}::FleetController._attach_fresh": (
+        Recv("handshake", "reply", ("rp.handshake",)),),
+    # -- controller-side health-reply consumers ---------------------------
+    f"{_C}::FleetController._degraded": (
+        Recv("health", "reply", ("health",)),),
+    # -- in-process replica: the OTHER sender of the health-reply body ----
+    f"{_R}::Replica.health": (
+        Send("health", "reply", returns=True),),
+    f"{_R}::classify_failure": (
+        Recv("submit", "stream", ("payload",), kind="replica_failed"),
+        Recv("submit_group", "stream", ("payload",),
+             kind="replica_failed"),),
+    # -- gateway SSE (server-push to browsers; no in-repo receiver) -------
+    f"{_G}::_make_handler.Handler._stream": (
+        Send("sse", "stream"),),
+    f"{_G}::_make_handler.Handler._images_stream": (
+        Send("sse", "stream"),),
+}
+
+# (verb, direction, kind-or-None) -> why the receiver side is deliberately
+# open-ended. Open channels skip wire-field-unread (their consumers are
+# reflective: dict-merging health(), the telemetry collector, operators
+# reading the handshake line in CI logs, browsers on SSE) — drift on them
+# is still caught by the golden contract, field by field.
+CHANNEL_POLICY: Dict[Tuple[str, str, Optional[str]], str] = {
+    ("health", "reply", None):
+        "reflective consumers: RemoteReplica.health() merges the whole "
+        "dict; smokes/operators read fields the controller never does",
+    ("telemetry", "reply", None):
+        "the graftlens collector consumes the whole snapshot generically",
+    ("handshake", "reply", None):
+        "operator-facing JSON line (CI logs, smokes) beyond the fields "
+        "the manager reads",
+    ("any", "reply", None):
+        "the unknown-verb error ack; every single-verb client may see it",
+    ("drain", "reply", None):
+        "fire-and-forget ack: drain() discards the body by design "
+        "(migrate() reads 'migrated')",
+    ("sse", "stream", None):
+        "server-push to HTTP clients; the receivers live in browsers",
+    ("submit", "stream", "shed"):
+        "the router synthesizes its own shed error without reading the "
+        "frame body",
+    ("submit_group", "stream", "shed"):
+        "the router synthesizes its own shed error without reading the "
+        "frame body",
+    ("submit", "stream", "replica_failed"):
+        "classify_failure reads only 'reason'; the router forwards the "
+        "whole payload into the failover event detail",
+    ("submit_group", "stream", "replica_failed"):
+        "classify_failure reads only 'reason'; the router forwards the "
+        "whole payload into the failover event detail",
+}
+
+
+def channel_open(verb: str, direction: str, kind: Optional[str]) -> bool:
+    return ((verb, direction, kind) in CHANNEL_POLICY
+            or (kind is not None
+                and (verb, direction, None) in CHANNEL_POLICY))
+
+
+# --------------------------------------------------------------------------
+# lifecycle state machines (both ACYCLIC — a request/replica never returns
+# to an earlier state; re-admission after failover is its own state)
+# --------------------------------------------------------------------------
+
+LIFECYCLES: Dict[str, Dict[str, Tuple]] = {
+    "request": {
+        "states": ("submitted", "admitted", "prefill", "decode", "done",
+                   "shed", "failed", "readmitted"),
+        "edges": (("submitted", "admitted"), ("submitted", "shed"),
+                  ("admitted", "prefill"), ("admitted", "shed"),
+                  ("prefill", "decode"), ("decode", "done"),
+                  ("decode", "shed"), ("decode", "failed"),
+                  ("failed", "readmitted")),
+    },
+    "replica": {
+        "states": ("spawned", "attached", "serving", "draining", "wedged",
+                   "dead"),
+        "edges": (("spawned", "attached"), ("attached", "serving"),
+                  ("serving", "draining"), ("serving", "wedged"),
+                  ("serving", "dead"), ("wedged", "draining"),
+                  ("draining", "dead")),
+    },
+}
+
+# record_event name -> declared transition(s) it witnesses; () marks a
+# deliberately non-lifecycle event (quality gauges, control-loop errors).
+# An emission in the wire roots that is absent here — or maps to an edge
+# its machine does not declare — is an undeclared-lifecycle-transition.
+EVENT_EDGES: Dict[str, Tuple[Tuple[str, str, str], ...]] = {
+    # request lifecycle
+    "request_submitted": (("request", "submitted", "admitted"),),
+    "images_submitted": (("request", "submitted", "admitted"),),
+    "request_rejected": (("request", "submitted", "shed"),),
+    "request_admitted": (("request", "admitted", "prefill"),),
+    "request_completed": (("request", "decode", "done"),),
+    "request_shed": (("request", "admitted", "shed"),
+                     ("request", "decode", "shed")),
+    "failover": (("request", "decode", "failed"),
+                 ("request", "failed", "readmitted")),
+    # replica lifecycle
+    "replica_spawned": (("replica", "spawned", "attached"),),
+    "replica_killed": (("replica", "serving", "dead"),
+                       ("replica", "draining", "dead")),
+    "replica_heartbeat_lost": (("replica", "serving", "dead"),),
+    "replica_progress_stalled": (("replica", "serving", "wedged"),),
+    "replica_wedged": (("replica", "serving", "wedged"),),
+    "replica_failed": (("replica", "serving", "dead"),),
+    "replica_migrate": (("replica", "serving", "draining"),
+                        ("replica", "wedged", "draining")),
+    # non-lifecycle telemetry
+    "decode_quality": (),
+    "replica_unreaped": (),
+    "warm_refill_failed": (),
+    "fleet_action": (),
+    "fleet_tick_error": (),
+}
+
+
+def lifecycle_cycles(machines: Optional[Dict] = None) -> List[List[str]]:
+    """Cycles in the declared machines (each as a state list); the
+    contract requires both machines acyclic, and the smokes re-assert it
+    against the shipped golden."""
+    out: List[List[str]] = []
+    for name, machine in sorted((machines or LIFECYCLES).items()):
+        adj: Dict[str, List[str]] = {}
+        for src, dst in machine["edges"]:
+            adj.setdefault(src, []).append(dst)
+        color: Dict[str, int] = {}
+        stack: List[str] = []
+
+        def dfs(node: str) -> None:
+            color[node] = 1
+            stack.append(node)
+            for nxt in adj.get(node, []):
+                if color.get(nxt, 0) == 1:
+                    out.append([name] + stack[stack.index(nxt):] + [nxt])
+                elif color.get(nxt, 0) == 0:
+                    dfs(nxt)
+            stack.pop()
+            color[node] = 2
+
+        for state in sorted(adj):
+            if color.get(state, 0) == 0:
+                dfs(state)
+    return out
+
+
+# --------------------------------------------------------------------------
+# per-function extraction
+# --------------------------------------------------------------------------
+
+def _recv_name(node: ast.AST) -> str:
+    """Dotted receiver name; sees through ``(ack or {})``."""
+    if isinstance(node, ast.BoolOp) and node.values:
+        return dotted_name(node.values[0])
+    return dotted_name(node)
+
+
+class _DictShape:
+    """Statically-known shape of one dict value."""
+
+    def __init__(self) -> None:
+        self.fields: Set[str] = set()
+        self.optional: Set[str] = set()
+        self.dynamic = False
+        self.verb_const: Optional[str] = None
+        self.kind_const: Optional[str] = None
+
+    def merge_literal(self, node: ast.Dict) -> "_DictShape":
+        for key, val in zip(node.keys, node.values):
+            if key is None:                       # ** spread
+                self._merge_spread(val)
+            elif isinstance(key, ast.Constant) and isinstance(key.value,
+                                                              str):
+                self.fields.add(key.value)
+                if isinstance(val, ast.Constant) and isinstance(val.value,
+                                                                str):
+                    if key.value == "verb":
+                        self.verb_const = val.value
+                    elif key.value == "kind":
+                        self.kind_const = val.value
+            else:
+                self.dynamic = True               # computed key
+        return self
+
+    def _merge_spread(self, val: ast.AST) -> None:
+        if isinstance(val, ast.Dict):
+            sub = _DictShape().merge_literal(val)
+            self.fields |= sub.fields
+            self.optional |= sub.optional
+            self.dynamic |= sub.dynamic
+        elif isinstance(val, ast.IfExp):
+            # **({...} if cond else {...}): keys of either arm are
+            # conditionally present — optional on the wire
+            for branch in (val.body, val.orelse):
+                if isinstance(branch, ast.Dict):
+                    sub = _DictShape().merge_literal(branch)
+                    self.optional |= sub.fields | sub.optional
+                    self.dynamic |= sub.dynamic
+                else:
+                    self.dynamic = True
+        else:
+            self.dynamic = True                   # **payload
+
+
+class _FuncWalker:
+    """Ordered walk of one function body: tracked var-dicts, send-site
+    captures, wire-message reads, verb dispatch, record_event emissions."""
+
+    def __init__(self, path: str, qualname: str, node: ast.AST,
+                 specs: Tuple[object, ...], collect_nested) -> None:
+        self.path = path
+        self.qualname = qualname
+        self.site = f"{path}::{qualname}"
+        self.sends = tuple(s for s in specs if isinstance(s, Send))
+        self.recvs = tuple(s for s in specs if isinstance(s, Recv))
+        self.collect_nested = collect_nested
+        self.var_dicts: Dict[str, _DictShape] = {}
+        self.verb_vars: Set[str] = set()          # names bound from
+        self.raw_reads: List[Tuple[str, str, bool, int]] = []
+        self.sent: List[SentDict] = []
+        self.sent_verbs: List[VerbUse] = []
+        self.dispatched: List[VerbUse] = []
+        self.events: List[EventEmit] = []
+        for stmt in node.body:
+            self._walk(stmt)
+
+    # -- shape resolution --------------------------------------------------
+
+    def _shape_of(self, node: ast.AST) -> Optional[_DictShape]:
+        if isinstance(node, ast.Dict):
+            return _DictShape().merge_literal(node)
+        if isinstance(node, ast.Name):
+            return self.var_dicts.get(node.id)
+        return None
+
+    # -- the ordered walk --------------------------------------------------
+
+    def _walk(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.collect_nested(f"{self.qualname}.{node.name}", node)
+            return
+        if isinstance(node, ast.ClassDef):
+            # a class defined inside a function (the gateway's request
+            # Handler): keep the class name in the qualname
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self.collect_nested(
+                        f"{self.qualname}.{node.name}.{item.name}", item)
+            return
+        if isinstance(node, ast.Lambda):
+            return
+        if isinstance(node, ast.Assign):
+            self._visit_assign(node)
+        elif isinstance(node, ast.Return) and node.value is not None:
+            if any(s.returns for s in self.sends):
+                shape = self._shape_of(node.value)
+                if shape is not None:
+                    self._classify(shape, node.lineno)
+        elif isinstance(node, ast.Call):
+            self._visit_call(node)
+        elif isinstance(node, ast.Subscript) and isinstance(node.ctx,
+                                                            ast.Load):
+            self._visit_subscript_read(node)
+        elif isinstance(node, ast.Compare):
+            self._visit_compare(node)
+        for child in ast.iter_child_nodes(node):
+            self._walk(child)
+
+    def _visit_assign(self, node: ast.Assign) -> None:
+        if len(node.targets) != 1:
+            return
+        tgt = node.targets[0]
+        if isinstance(tgt, ast.Name):
+            if isinstance(node.value, ast.Dict):
+                self.var_dicts[tgt.id] = \
+                    _DictShape().merge_literal(node.value)
+            elif isinstance(node.value, ast.Call):
+                func = node.value.func
+                if (isinstance(func, ast.Attribute) and func.attr == "get"
+                        and node.value.args
+                        and isinstance(node.value.args[0], ast.Constant)
+                        and node.value.args[0].value == "verb"):
+                    # verb = msg.get("verb") — dispatch variable
+                    self.verb_vars.add(tgt.id)
+                else:
+                    # fed from a call: a dict whose full key set is not
+                    # statically known (telemetry_payload, replica.health)
+                    shape = _DictShape()
+                    shape.dynamic = True
+                    self.var_dicts[tgt.id] = shape
+        elif isinstance(tgt, ast.Subscript) and isinstance(tgt.value,
+                                                           ast.Name):
+            shape = self.var_dicts.get(tgt.value.id)
+            if shape is not None and isinstance(tgt.slice, ast.Constant) \
+                    and isinstance(tgt.slice.value, str):
+                shape.fields.add(tgt.slice.value)
+
+    def _visit_call(self, node: ast.Call) -> None:
+        func = node.func
+        fname = dotted_name(func)
+        # record_event("name", ...)
+        if fname.endswith("record_event") and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            self.events.append(EventEmit(node.args[0].value, self.site,
+                                         node.lineno))
+        # tracked-dict mutation: d.update(k=...), d.setdefault("k", ...)
+        if isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name):
+            shape = self.var_dicts.get(func.value.id)
+            if shape is not None and func.attr == "update":
+                for kw in node.keywords:
+                    if kw.arg is not None:
+                        shape.fields.add(kw.arg)
+                    else:
+                        shape.dynamic = True
+                for arg in node.args:
+                    if isinstance(arg, ast.Dict):
+                        sub = _DictShape().merge_literal(arg)
+                        shape.fields |= sub.fields
+                        shape.optional |= sub.optional
+                        shape.dynamic |= sub.dynamic
+                    else:
+                        shape.dynamic = True      # update(payload)
+            elif shape is not None and func.attr == "setdefault" \
+                    and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                shape.fields.add(node.args[0].value)
+        # .get("k") soft read
+        if isinstance(func, ast.Attribute) and func.attr == "get" \
+                and node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            recv = _recv_name(func.value)
+            if recv:
+                self.raw_reads.append((recv, node.args[0].value, False,
+                                       node.lineno))
+        # send sites
+        short = fname.rsplit(".", 1)[-1]
+        idx = _SEND_CALLS.get(short)
+        if idx is not None and len(node.args) > idx:
+            shape = self._shape_of(node.args[idx])
+            if shape is not None:
+                self._classify(shape, node.lineno)
+        elif short == "print" and node.args \
+                and isinstance(node.args[0], ast.Call) \
+                and dotted_name(node.args[0].func) == "json.dumps" \
+                and node.args[0].args:
+            shape = self._shape_of(node.args[0].args[0])
+            if shape is not None:
+                self._classify(shape, node.lineno)
+
+    def _visit_subscript_read(self, node: ast.Subscript) -> None:
+        if isinstance(node.slice, ast.Constant) \
+                and isinstance(node.slice.value, str):
+            recv = _recv_name(node.value)
+            if recv:
+                self.raw_reads.append((recv, node.slice.value, True,
+                                       node.lineno))
+
+    def _visit_compare(self, node: ast.Compare) -> None:
+        # verb == "submit" where verb came from <msg>.get("verb")
+        if isinstance(node.left, ast.Name) \
+                and node.left.id in self.verb_vars \
+                and len(node.ops) == 1 and isinstance(node.ops[0], ast.Eq) \
+                and isinstance(node.comparators[0], ast.Constant) \
+                and isinstance(node.comparators[0].value, str):
+            self.dispatched.append(VerbUse(node.comparators[0].value,
+                                           self.site, node.lineno))
+
+    # -- channel classification -------------------------------------------
+
+    def _classify(self, shape: _DictShape, line: int) -> None:
+        if shape.verb_const is not None:
+            self.sent.append(SentDict(
+                shape.verb_const, "request", None,
+                frozenset(shape.fields), frozenset(shape.optional),
+                shape.dynamic, self.site, line))
+            self.sent_verbs.append(VerbUse(shape.verb_const, self.site,
+                                           line))
+            return
+        is_stream = "kind" in shape.fields or shape.kind_const is not None
+        if not is_stream and not any(s.direction == "reply"
+                                     for s in self.sends):
+            # a stream sender whose event kind is a variable (the SSE
+            # handlers pass the kind as sse_event's first argument)
+            is_stream = any(s.direction == "stream" for s in self.sends)
+        if is_stream:
+            for spec in self.sends:
+                if spec.direction == "stream":
+                    self.sent.append(SentDict(
+                        spec.verb, "stream", shape.kind_const or "*",
+                        frozenset(shape.fields),
+                        frozenset(shape.optional), shape.dynamic,
+                        self.site, line))
+            return
+        for spec in self.sends:
+            if spec.direction == "reply":
+                self.sent.append(SentDict(
+                    spec.verb, "reply", None, frozenset(shape.fields),
+                    frozenset(shape.optional), shape.dynamic, self.site,
+                    line))
+
+    def reads(self) -> List[FieldRead]:
+        out = []
+        for recv, field, hard, line in self.raw_reads:
+            for spec in self.recvs:
+                if recv in spec.vars:
+                    out.append(FieldRead(spec.verb, spec.direction,
+                                         spec.kind, field, hard,
+                                         self.site, line))
+        return out
+
+
+# --------------------------------------------------------------------------
+# model build
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Channel:
+    """One (verb, direction[, kind]) sub-channel: the sender/receiver
+    join the rules and the golden both consume."""
+    verb: str
+    direction: str
+    kind: Optional[str]
+    senders: List[SentDict] = dataclasses.field(default_factory=list)
+    reads: List[FieldRead] = dataclasses.field(default_factory=list)
+
+    @property
+    def sent_fields(self) -> Set[str]:
+        out: Set[str] = set()
+        for s in self.senders:
+            out |= s.fields | s.optional
+        return out
+
+    @property
+    def optional_fields(self) -> Set[str]:
+        """Fields some sender path omits: conditional-spread keys plus
+        any field absent from at least one non-dynamic sender literal."""
+        out: Set[str] = set()
+        static = [s for s in self.senders if not s.dynamic]
+        for s in self.senders:
+            out |= s.optional
+        for f in self.sent_fields:
+            if any(f not in s.fields | s.optional for s in static):
+                out.add(f)
+        return out
+
+    @property
+    def dynamic(self) -> bool:
+        return any(s.dynamic for s in self.senders)
+
+    @property
+    def read_fields(self) -> Set[str]:
+        return {r.field for r in self.reads}
+
+    @property
+    def open(self) -> bool:
+        return channel_open(self.verb, self.direction, self.kind)
+
+
+@dataclasses.dataclass
+class WireModel:
+    """The whole-protocol model."""
+    sends: List[SentDict]
+    reads: List[FieldRead]
+    events: List[EventEmit]
+    sent_verbs: List[VerbUse]
+    dispatched_verbs: List[VerbUse]
+
+    def channels(self) -> Dict[Tuple[str, str, Optional[str]], Channel]:
+        """(verb, direction, kind) -> Channel. Stream reads with
+        ``kind=None`` are attached to every sub-channel of their verb AND
+        kept on a ``(verb, "stream", None)`` aggregate so the golden
+        records the kind-agnostic reader once."""
+        out: Dict[Tuple[str, str, Optional[str]], Channel] = {}
+
+        def chan(verb, direction, kind) -> Channel:
+            return out.setdefault((verb, direction, kind),
+                                  Channel(verb, direction, kind))
+
+        for s in self.sends:
+            chan(s.verb, s.direction, s.kind).senders.append(s)
+        for r in self.reads:
+            chan(r.verb, r.direction, r.kind).reads.append(r)
+        # fan kind-agnostic stream reads out to the concrete sub-channels
+        for (verb, direction, kind), ch in list(out.items()):
+            if direction == "stream" and kind is None:
+                for (v2, d2, k2), ch2 in out.items():
+                    if v2 == verb and d2 == "stream" and k2 is not None:
+                        ch2.reads.extend(ch.reads)
+        return out
+
+
+def wire_files(repo_root: str = REPO_ROOT) -> List[str]:
+    """Repo-relative .py files in the wire roots."""
+    return iter_repo_files(WIRE_ROOTS, repo_root)
+
+
+def build_model(files: Sequence[Tuple[str, str]]) -> WireModel:
+    """Build the protocol model from (rel_path, source) pairs."""
+    sends: List[SentDict] = []
+    reads: List[FieldRead] = []
+    events: List[EventEmit] = []
+    sent_verbs: List[VerbUse] = []
+    dispatched: List[VerbUse] = []
+
+    for path, source in files:
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError:
+            continue
+        pending: List[Tuple[str, ast.AST]] = []
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                pending.append((node.name, node))
+            elif isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        pending.append((f"{node.name}.{item.name}", item))
+        while pending:
+            qualname, fnode = pending.pop(0)
+            specs = ENDPOINTS.get(f"{path}::{qualname}", ())
+
+            def _collect(q, n):
+                pending.append((q, n))
+            w = _FuncWalker(path, qualname, fnode, specs, _collect)
+            sends.extend(w.sent)
+            reads.extend(w.reads())
+            events.extend(w.events)
+            sent_verbs.extend(w.sent_verbs)
+            dispatched.extend(w.dispatched)
+
+    return WireModel(sends=sends, reads=reads, events=events,
+                     sent_verbs=sent_verbs, dispatched_verbs=dispatched)
+
+
+def build_repo_model(repo_root: str = REPO_ROOT,
+                     paths: Optional[Sequence[str]] = None) -> WireModel:
+    import os
+    files = []
+    for rel in (paths if paths is not None else wire_files(repo_root)):
+        with open(os.path.join(repo_root, rel), encoding="utf-8") as fh:
+            files.append((rel, fh.read()))
+    return build_model(sorted(files))
+
+
+# --------------------------------------------------------------------------
+# waivers
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class WireWaiver:
+    rule: str
+    reason: str
+    line: int
+
+
+def collect_waivers(source: str, rel_path: str, known_rules: Sequence[str]
+                    ) -> Tuple[List[WireWaiver], List[str]]:
+    """(waivers, problems) from real comment tokens of one file. A waiver
+    applies to findings of its rule on its own line or the line below
+    (comment-above placement, graftlint-style)."""
+    waivers: List[WireWaiver] = []
+    problems: List[str] = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError):
+        return waivers, problems
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _WAIVER_RE.search(tok.string)
+        if not m:
+            continue
+        rule, reason = m.group(1), (m.group(2) or "").strip()
+        if rule not in known_rules:
+            problems.append(
+                f"{rel_path}:{tok.start[0]}: unknown graftwire rule "
+                f"'{rule}' in waiver (known: {', '.join(known_rules)})")
+            continue
+        if not reason:
+            problems.append(
+                f"{rel_path}:{tok.start[0]}: graftwire waiver for "
+                f"'{rule}' has no reason — write "
+                f"'# graftwire: allow={rule} -- <why>'")
+            continue
+        waivers.append(WireWaiver(rule, reason, tok.start[0]))
+    return waivers, problems
+
+
+def _iter_endpoint_specs() -> Iterable[Tuple[str, object]]:
+    for key, specs in ENDPOINTS.items():
+        for spec in specs:
+            yield key, spec

@@ -22,11 +22,11 @@ from .controller import FleetController
 from .manager import FleetManager, ReplicaProcess, SpawnError
 from .transport import (RemoteCompletion, RemoteGroupStream, RemoteReplica,
                         RemoteResultStream, ReplicaServer, TransportError,
-                        call, dial, recv_frame, send_frame)
+                        call, dial, recv_frame, send_frame, set_frame_tap)
 
 __all__ = [
     "FleetController", "FleetManager", "ReplicaProcess", "SpawnError",
     "RemoteCompletion", "RemoteGroupStream", "RemoteReplica",
     "RemoteResultStream", "ReplicaServer", "TransportError", "call",
-    "dial", "recv_frame", "send_frame",
+    "dial", "recv_frame", "send_frame", "set_frame_tap",
 ]

@@ -94,6 +94,16 @@ class ReplicaProcess:
                 pass
 
 
+def _handshake_error(msg: str) -> SpawnError:
+    # handshake refusals share the wire-protocol error counter
+    # (fleet.protocol_errors_total{kind=handshake}) with transport.py's
+    # malformed-frame paths: one metric family covers "a peer did not
+    # speak the protocol", whatever the channel
+    counter_add("fleet.protocol_errors_total", 1.0,
+                labels={"kind": "handshake"})
+    return SpawnError(msg)
+
+
 def _read_handshake(proc: subprocess.Popen, timeout_s: float) -> dict:
     """Read stdout lines until the handshake JSON appears. Non-handshake
     lines (jax chatter) pass through to our stdout so replica logs stay
@@ -103,14 +113,15 @@ def _read_handshake(proc: subprocess.Popen, timeout_s: float) -> dict:
     fd = proc.stdout.fileno()
     while time.monotonic() < deadline:
         if proc.poll() is not None:
-            raise SpawnError(f"replica process exited rc={proc.returncode} "
-                             "before handshake")
+            raise _handshake_error(
+                f"replica process exited rc={proc.returncode} "
+                "before handshake")
         ready, _, _ = select.select([fd], [], [], 0.25)
         if not ready:
             continue
         chunk = os.read(fd, 65536)
         if not chunk:
-            raise SpawnError("replica stdout closed before handshake")
+            raise _handshake_error("replica stdout closed before handshake")
         buf += chunk
         while b"\n" in buf:
             line, buf = buf.split(b"\n", 1)
@@ -131,7 +142,7 @@ def _read_handshake(proc: subprocess.Popen, timeout_s: float) -> dict:
                             print(f"[replica] {rest}", flush=True)
                     return doc
             print(f"[replica] {text}", flush=True)
-    raise SpawnError(f"no replica handshake within {timeout_s:.0f}s")
+    raise _handshake_error(f"no replica handshake within {timeout_s:.0f}s")
 
 
 def _drain_stdout(proc: subprocess.Popen, rid: str) -> None:

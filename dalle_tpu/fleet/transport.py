@@ -71,7 +71,31 @@ class TransportError(RuntimeError):
     """A wire-level failure the caller should treat as replica failure."""
 
 
+# opt-in frame tap: the graftwire runtime-conformance hook
+# (dalle_tpu/obs/wiretap.py). When set, every frame is reported as
+# ("send"|"recv", decoded_dict) AFTER length/JSON validation — the smokes
+# install it and assert every observed frame ⊆ the static golden in
+# contracts/wire.json. None (the default) is zero-cost on the hot path.
+_frame_tap: Optional[Callable[[str, dict], None]] = None
+
+
+def set_frame_tap(cb: Optional[Callable[[str, dict], None]]) -> None:
+    global _frame_tap
+    _frame_tap = cb
+
+
+def _proto_error(kind: str) -> None:
+    # fleet.protocol_errors_total{kind=oversize_frame|torn_frame|bad_json|
+    # unknown_verb|handshake}: every malformed-wire path increments
+    # exactly one kind, so a corrupt peer is visible in /metrics before
+    # anyone reads a stack trace
+    counter_add("fleet.protocol_errors_total", 1.0, labels={"kind": kind})
+
+
 def send_frame(sock: socket.socket, obj: dict) -> None:
+    tap = _frame_tap
+    if tap is not None:
+        tap("send", obj)
     payload = json.dumps(obj).encode()
     sock.sendall(_LEN.pack(len(payload)) + payload)
 
@@ -87,6 +111,7 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
 
 
 def _torn(got: int, want: int):
+    _proto_error("torn_frame")
     raise TransportError(f"torn frame: connection closed after {got}/{want} "
                          "bytes")
 
@@ -102,18 +127,24 @@ def recv_frame(sock: socket.socket,
         return None
     (n,) = _LEN.unpack(head)
     if n > MAX_FRAME_BYTES:
+        _proto_error("oversize_frame")
         raise TransportError(f"frame length {n} exceeds {MAX_FRAME_BYTES}")
     body = _recv_exact(sock, n)
     if body is None:
         _torn(0, n)
     try:
-        return json.loads(body.decode())
+        obj = json.loads(body.decode())
     except ValueError as exc:
         # must surface as TransportError: callers (the heartbeat loop
         # above all) catch transport failures, and a raw JSONDecodeError
         # would kill the heartbeat thread and freeze health at its last
         # good value
+        _proto_error("bad_json")
         raise TransportError(f"undecodable frame body: {exc!r}") from exc
+    tap = _frame_tap
+    if tap is not None and isinstance(obj, dict):
+        tap("recv", obj)
+    return obj
 
 
 def _connect_raw(addr: str, timeout: float = 5.0) -> socket.socket:
@@ -189,16 +220,22 @@ class _FrameReader:
             if len(self._buf) >= _LEN.size:
                 (n,) = _LEN.unpack(self._buf[:_LEN.size])
                 if n > MAX_FRAME_BYTES:
+                    _proto_error("oversize_frame")
                     raise TransportError(
                         f"frame length {n} exceeds {MAX_FRAME_BYTES}")
                 if len(self._buf) >= _LEN.size + n:
                     body = bytes(self._buf[_LEN.size:_LEN.size + n])
                     del self._buf[:_LEN.size + n]
                     try:
-                        return json.loads(body.decode())
+                        obj = json.loads(body.decode())
                     except ValueError as exc:
+                        _proto_error("bad_json")
                         raise TransportError(
                             f"undecodable frame body: {exc!r}") from exc
+                    tap = _frame_tap
+                    if tap is not None and isinstance(obj, dict):
+                        tap("recv", obj)
+                    return obj
             chunk = self._sock.recv(65536)
             if not chunk:
                 if self._buf:
@@ -556,6 +593,10 @@ class RemoteReplica:
             detail = (ack or {}).get("detail", "connection closed at ack")
             if err == "queue_full":
                 raise QueueFull(detail)
+            if err == "unknown_verb":
+                # a protocol-level disagreement (version skew, bad client),
+                # not a replica health problem — count it as such
+                _proto_error("unknown_verb")
             from ..gateway.replica import ReplicaFailure
             raise ReplicaFailure(f"{self.replica_id}: {err}: {detail}")
         return cls(sock, self.replica_id)

@@ -425,6 +425,10 @@ class Replica:
         self._fail_after_rows = int(n)
 
     def health(self) -> dict:
+        # co-sender of the graftwire health.reply channel with
+        # ReplicaServer._health (which wraps this dict for the socket
+        # path): the union of both builders' keys is pinned in
+        # contracts/wire.json, so field drift here is a wire_audit failure
         return {"replica_id": self.replica_id, "healthy": self.healthy,
                 "draining": self.draining, "queue_depth": self.queue_depth,
                 "inflight": self.inflight, "aot_loaded": self.aot_loaded,

@@ -42,7 +42,10 @@ def _count_failover(trace_id: str, replica_id: str, failovers: int,
     family (``classify_failure`` — the one mapping, shared with the fleet
     transport), and the lifecycle event — all BEFORE the resubmission
     attempt so a post-mortem bundle holds the classification next to the
-    death."""
+    death. graftwire pins 'failover' to the request machine's
+    decode->failed->readmitted transitions (wire_flow.EVENT_EDGES); an
+    event name this plane emits without a declared transition fails
+    wire_audit."""
     reason = classify_failure(payload)
     counter_add("gateway.failovers_total", 1.0)
     counter_add("gateway.failover_total", 1.0, labels={"reason": reason})

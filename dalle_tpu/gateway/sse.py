@@ -24,6 +24,11 @@ Wire format (all payloads single-line JSON):
                       "pixels_shape"]}
   event: done  data: {"request_id", "tokens", "ttft_s", "latency_s"}
   event: error data: {"request_id", "reason", "detail"}
+
+graftwire tracks these sends as the ``sse`` pseudo-verb of the protocol
+contract (``contracts/wire.json``) — the receivers live in browsers, so
+the channel is policy-open, but the payload field sets still pin the
+golden and drift still fails ``scripts/wire_audit.py --check``.
 """
 
 from __future__ import annotations

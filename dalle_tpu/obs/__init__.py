@@ -9,6 +9,13 @@ watchdog. See docs/OBSERVABILITY.md for the operator guide.
 Everything is off by default and near-free when off: ``span`` costs one
 global ``None`` check until ``configure()`` enables tracing
 (``TrainConfig.obs.trace`` / ``--obs.trace true`` from the CLIs).
+
+Two submodules are the runtime halves of static analysis layers and are
+imported explicitly by the smokes (never re-exported here):
+:mod:`dalle_tpu.obs.lockorder` records observed lock-acquisition edges
+against graftsync's golden lock graph, and :mod:`dalle_tpu.obs.wiretap`
+records observed wire-frame shapes against graftwire's golden protocol
+contract (``contracts/wire.json``).
 """
 
 from .anomaly import (Breach, CodebookCollapseDetector, GradExplosionDetector,

@@ -89,6 +89,24 @@ def run_sync_audit_stage() -> int:
     return subprocess.run(cmd, cwd=ROOT, env=env).returncode
 
 
+def run_wire_audit_stage() -> int:
+    """The graftwire stage: the cross-process wire-protocol model over the
+    fleet RPC (sender vs receiver field schemas per verb, verb dispatch
+    symmetry, request/replica lifecycle machines vs emitted events —
+    analysis/wire_flow.py + rules_wire.py) plus drift of the protocol
+    against the golden in contracts/wire.json (scripts/wire_audit.py; the
+    workflow's matching step is skipped below). Waivers are
+    '# graftwire: allow=<rule> -- why' source comments. Report + findings +
+    SARIF land in ./wire_artifacts — the dir ci.yml uploads. The runtime
+    half runs inside the gateway/fleet smokes (obs/wiretap.py asserts
+    every observed frame ⊆ the golden)."""
+    cmd = [sys.executable, os.path.join(ROOT, "scripts", "wire_audit.py"),
+           "--check", "--report", os.path.join(ROOT, "wire_artifacts")]
+    print(f"== [graftwire] {' '.join(cmd[1:])}")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(cmd, cwd=ROOT, env=env).returncode
+
+
 def run_obs_smoke_stage() -> int:
     """The grafttrace + host-overlap + graftpulse smoke stage: a short
     synthetic traced fit (device prefetch + async checkpointing + deferred
@@ -230,6 +248,17 @@ def main():
               "graph drift) — test tiers not run")
         return 1
 
+    rc = run_wire_audit_stage()
+    if rc == 3:
+        print("ci_local: FAILED (graftwire golden protocol contract "
+              "MISSING — run scripts/wire_audit.py --update and commit "
+              "contracts/wire.json) — test tiers not run")
+        return 1
+    if rc != 0:
+        print("ci_local: FAILED (graftwire protocol findings / contract "
+              "drift) — test tiers not run")
+        return 1
+
     if run_obs_smoke_stage() != 0:
         print("ci_local: FAILED (observability smoke) — test tiers not run")
         return 1
@@ -272,6 +301,9 @@ def main():
             continue
         if "scripts/sync_audit.py" in cmd:
             print(f"-- [skip] {name}: already run in the graftsync stage")
+            continue
+        if "scripts/wire_audit.py" in cmd:
+            print(f"-- [skip] {name}: already run in the graftwire stage")
             continue
         if "scripts/obs_smoke.py" in cmd:
             print(f"-- [skip] {name}: already run in the obs smoke stage")

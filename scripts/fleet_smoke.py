@@ -110,12 +110,15 @@ def main(argv=None):
     import numpy as np
 
     from dalle_tpu import obs
-    from dalle_tpu.obs import lockorder
+    from dalle_tpu.obs import lockorder, wiretap
 
     # graftsync runtime half: every dalle_tpu lock created from here on is
     # instrumented; the end of the smoke asserts the acquisition order this
     # real run exhibited is acyclic and within the static golden
     lockorder.install()
+    # graftwire runtime half: every frame this process sends/receives is
+    # recorded; the end of the smoke asserts observed ⊆ contracts/wire.json
+    wiretap.install()
     from dalle_tpu.chaos.faults import Fault, FaultPlan
     from dalle_tpu.config import DalleConfig
     from dalle_tpu.fleet import FleetController, FleetManager
@@ -161,7 +164,10 @@ def main(argv=None):
         untrained=True, dalle_path=None, model_seed=0,
         precision="float32", slots=args.slots, steps_per_sync=4,
         queue_maxsize=args.queue_maxsize, prefill_chunk=0,
-        decode_health=False)
+        decode_health=False,
+        # dense engine (graftpage knobs off): build_engine reads these
+        # unconditionally, matching serve_replica's CLI defaults
+        kv_block_tokens=0, kv_pool_blocks=None, radix_cache=True)
     aot_dir = os.path.join(args.outdir, "aot")
     manifest = save_engine_aot(sr.build_engine(eng_args), aot_dir)
     check(all(v > 0 for v in manifest["payload_bytes"].values()),
@@ -719,12 +725,33 @@ def main(argv=None):
               f"{unknown or 'none'}; edges beyond golden: "
               f"{extra or 'none'})")
 
+        # graftwire cross-check: every frame this gateway-side process put
+        # on (or took off) the wire must fit a sender schema of the golden
+        # protocol contract, and the declared lifecycle machines it pins
+        # must be acyclic
+        from dalle_tpu.analysis.wire_flow import lifecycle_cycles
+        with open(os.path.join(root, "contracts", "wire.json")) as fh:
+            wire_golden = json.load(fh)
+        frames = wiretap.observed()
+        violations = [str(v) for v in wiretap.conformance(wire_golden)]
+        check(frames and not violations,
+              f"observed wire frames ⊆ static golden ({len(frames)} "
+              f"distinct frame shapes; violations: {violations or 'none'})")
+        cyc = lifecycle_cycles(
+            {n: {"edges": [tuple(e) for e in m["edges"]]}
+             for n, m in wire_golden["lifecycles"].items()})
+        check(not cyc,
+              f"golden lifecycle machines acyclic ({cyc or 'no cycles'})")
+
         summary = {
             "burst0": {"offered": n0, "completed": len(ok0),
                        "rps": len(ok0) / wall0[0]},
             "lock_sites_observed": len(lockorder.observed_sites()),
             "lock_edges_observed": [lockorder.format_edge(e)
                                     for e in obs_edges],
+            "wire_frames_observed": [
+                [verb, direction, kind, sorted(fields)]
+                for verb, direction, kind, fields in frames],
             "burst1": {"offered": n1, "completed": len(ok1),
                        "rps": len(ok1) / wall1},
             "warm_backend_compiles_delta":

@@ -85,12 +85,17 @@ def main(argv=None):
     import numpy as np
 
     from dalle_tpu import obs
-    from dalle_tpu.obs import lockorder
+    from dalle_tpu.obs import lockorder, wiretap
 
     # graftsync runtime half: every dalle_tpu lock created from here on is
     # instrumented; the end of the smoke asserts the acquisition order this
     # real run exhibited is acyclic and within the static golden
     lockorder.install()
+    # graftwire runtime half: record any frame touching the socket
+    # transport (this smoke's replicas are in-process, so the set is
+    # usually empty — the assertion is that nothing observed ESCAPES the
+    # golden; fleet_smoke provides the non-empty cross-process run)
+    wiretap.install()
     from dalle_tpu.config import DalleConfig
     from dalle_tpu.gateway import (AdmissionController, Gateway, Replica,
                                    ReplicaRouter, TenantQuotas, iter_sse,
@@ -622,11 +627,31 @@ def main(argv=None):
           "observed lock graph ⊆ static golden (unknown locks: "
           f"{unknown or 'none'}; edges beyond golden: {extra or 'none'})")
 
+    # graftwire cross-check: anything that DID touch the socket transport
+    # must fit the golden protocol contract, and the lifecycle machines
+    # the golden pins must be acyclic
+    from dalle_tpu.analysis.wire_flow import lifecycle_cycles
+    with open(os.path.join(root, "contracts", "wire.json")) as fh:
+        wire_golden = json.load(fh)
+    wire_frames = wiretap.observed()
+    wire_violations = [str(v) for v in wiretap.conformance(wire_golden)]
+    check(not wire_violations,
+          f"observed wire frames ⊆ static golden ({len(wire_frames)} "
+          f"distinct frame shapes; violations: {wire_violations or 'none'})")
+    wire_cycles = lifecycle_cycles(
+        {n: {"edges": [tuple(e) for e in m["edges"]]}
+         for n, m in wire_golden["lifecycles"].items()})
+    check(not wire_cycles,
+          f"golden lifecycle machines acyclic ({wire_cycles or 'no cycles'})")
+
     summary = {
         "requests": n_req, "slots": args.slots,
         "lock_sites_observed": len(lockorder.observed_sites()),
         "lock_edges_observed": [lockorder.format_edge(e)
                                 for e in obs_edges],
+        "wire_frames_observed": [
+            [verb, direction, kind, sorted(fields)]
+            for verb, direction, kind, fields in wire_frames],
         "images_requests": snapshot.get("gateway.images_requests_total", 0),
         "images_candidates": snapshot.get(
             "gateway.images_candidates_total", 0),

@@ -49,8 +49,13 @@ def main(argv=None):
     ap.add_argument("--contracts-dir",
                     default=os.path.join(ROOT, "contracts"),
                     help="golden directory (default: contracts/)")
+    ap.add_argument("--format", choices=("text", "sarif"), default="text",
+                    help="report output format (sarif: a SARIF 2.1.0 "
+                         "document on stdout for GitHub PR annotation; "
+                         "the text report moves to stderr)")
     ap.add_argument("--report", metavar="DIR",
-                    help="write report.txt + drift.json into DIR (CI artifact)")
+                    help="write report.txt + drift.json + ir.sarif into "
+                         "DIR (CI artifact)")
     ap.add_argument("--list-entries", action="store_true")
     args = ap.parse_args(argv)
 
@@ -85,9 +90,13 @@ def main(argv=None):
         return 0
 
     update = bool(args.update)
+    # progress goes to stderr under --format sarif: stdout must stay a
+    # single parseable SARIF document for `> ir.sarif` redirection
+    progress_out = sys.stderr if args.format == "sarif" else sys.stdout
     reports = []
     for name in names:
-        print(f"-- [{'update' if update else 'check'}] {name}", flush=True)
+        print(f"-- [{'update' if update else 'check'}] {name}", flush=True,
+              file=progress_out)
         report, _ = A.audit_entry(name, C.ENTRIES[name], args.contracts_dir,
                                   update=update)
         reports.append(report)
@@ -95,7 +104,34 @@ def main(argv=None):
     sources = {n: C.ENTRIES[n].source for n in names}
     scope = f"{len(names)} entr{'y' if len(names) == 1 else 'ies'}"
     text = A.render_report(reports, sources, scope)
-    print(text)
+
+    # drift/problem lines as SARIF findings: contracts pin whole programs,
+    # so each finding anchors at the entry's source file (line 1 — there
+    # is no single culprit line in a jaxpr diff)
+    from dalle_tpu.analysis.core import Finding, to_sarif
+    sarif_findings = []
+    sarif_rules = {}
+    for r in reports:
+        for rule, drift_lines in sorted(r.drift.items()):
+            if rule == "missing":
+                continue
+            rid = f"ir-drift-{rule}"
+            sarif_rules[rid] = (f"graftir contract drift in the "
+                                f"'{rule}' section")
+            for line in drift_lines:
+                sarif_findings.append(Finding(rid, sources[r.name], 1,
+                                              f"{r.name}: {line}"))
+        for prob in r.problems:
+            sarif_rules["ir-waiver-problem"] = "malformed graftir waiver"
+            sarif_findings.append(Finding("ir-waiver-problem",
+                                          sources[r.name], 1,
+                                          f"{r.name}: {prob}"))
+    sarif = to_sarif(sarif_findings, "graftir", sarif_rules)
+    if args.format == "sarif":
+        print(json.dumps(sarif, indent=1))
+        print(text, file=sys.stderr)
+    else:
+        print(text)
 
     if args.report:
         os.makedirs(args.report, exist_ok=True)
@@ -107,6 +143,10 @@ def main(argv=None):
             json.dump([{"entry": r.name, "drift": r.drift,
                         "waived": r.waived, "problems": r.problems}
                        for r in reports], fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        with open(os.path.join(args.report, "ir.sarif"), "w",
+                  encoding="utf-8") as fh:
+            json.dump(sarif, fh, indent=1)
             fh.write("\n")
 
     # distinct exit codes so CI logs can tell the two failure classes

@@ -53,8 +53,13 @@ if "xla_force_host_platform_device_count" not in flags:
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--entries", help="comma-separated subset of entries")
+    ap.add_argument("--format", choices=("text", "sarif"), default="text",
+                    help="finding output format (sarif: a SARIF 2.1.0 "
+                         "document on stdout for GitHub PR annotation; "
+                         "the text report moves to stderr)")
     ap.add_argument("--report", metavar="DIR",
-                    help="write report.txt + boundary_map.json into DIR")
+                    help="write report.txt + boundary_map.json + "
+                         "precision.sarif into DIR")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -78,12 +83,18 @@ def main(argv=None):
             sys.exit(f"precision_audit.py: unknown entries: "
                      f"{', '.join(unknown)} (see ir_audit.py --list-entries)")
 
+    from dalle_tpu.analysis.core import Finding, to_sarif
+
     failures = 0
     waived_count = 0
     boundary_map = {}
     lines = []
+    sarif_findings = []
+    # progress goes to stderr under --format sarif: stdout must stay a
+    # single parseable SARIF document for `> precision.sarif` redirection
+    progress_out = sys.stderr if args.format == "sarif" else sys.stdout
     for name in names:
-        print(f"-- [trace] {name}", flush=True)
+        print(f"-- [trace] {name}", flush=True, file=progress_out)
         spec = C.ENTRIES[name]
         built = spec.build()
         rep = pf.analyze_fn(built.fn, built.args,
@@ -101,6 +112,11 @@ def main(argv=None):
             else:
                 lines.append(line)
                 failures += 1
+                # findings anchor at the entry's source: the site names a
+                # traced function, not a stable file:line in this repo
+                sarif_findings.append(Finding(
+                    f["rule"], spec.source, max(1, f.get("line", 1) or 1),
+                    f"{name}: {f['site']}: {f['detail']}{n}"))
 
     scope = f"{len(names)} entr{'y' if len(names) == 1 else 'ies'}"
     if failures:
@@ -112,7 +128,13 @@ def main(argv=None):
         extra = f", {waived_count} waived" if waived_count else ""
         lines.append(f"graftnum: precision flow clean ({scope}{extra})")
     text = "\n".join(lines)
-    print(text)
+    rules = {r: r for r in pf.PRECISION_RULES}
+    sarif = to_sarif(sarif_findings, "graftnum", rules)
+    if args.format == "sarif":
+        print(json.dumps(sarif, indent=1))
+        print(text, file=sys.stderr)
+    else:
+        print(text)
 
     if args.report:
         os.makedirs(args.report, exist_ok=True)
@@ -122,6 +144,10 @@ def main(argv=None):
         with open(os.path.join(args.report, "boundary_map.json"), "w",
                   encoding="utf-8") as fh:
             json.dump(boundary_map, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        with open(os.path.join(args.report, "precision.sarif"), "w",
+                  encoding="utf-8") as fh:
+            json.dump(sarif, fh, indent=1)
             fh.write("\n")
 
     return 1 if failures else 0

@@ -21,6 +21,13 @@ The handshake is ONE JSON line on stdout once the socket is listening:
   {"fleet_replica": 1, "addr": "127.0.0.1:PORT", "pid": ..,
    "replica_id": .., "aot_loaded": bool, "aot_refusal": str|null, ...}
 
+Its field set — like every frame this process sends or reads — is pinned
+by the graftwire protocol contract (``contracts/wire.json``, the
+``handshake.reply`` channel): adding or renaming a key here fails
+``scripts/wire_audit.py --check`` until the golden is regenerated, and a
+refused/absent handshake counts ``fleet.protocol_errors_total
+{kind="handshake"}`` on the manager side.
+
 Postmortem story matches the gateway process: ``--flight_dir`` configures
 a flight recorder (bundles on worker death / SIGQUIT), ``kill -USR2``
 captures a bounded jax profile, SIGTERM drains gracefully. A

@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""graftwire CLI — static wire-protocol + lifecycle audit of the fleet RPC.
+
+    python scripts/wire_audit.py --check            # CI gate (default)
+    python scripts/wire_audit.py --update           # regenerate the golden
+    python scripts/wire_audit.py --explain          # print the protocol
+    python scripts/wire_audit.py --list-rules
+    python scripts/wire_audit.py --check --format sarif > wire.sarif
+    python scripts/wire_audit.py --check --report wire_artifacts
+
+--check builds the cross-process protocol model (sender schemas, receiver
+schemas, verb dispatch, lifecycle event emissions) over the wire roots
+(fleet/, gateway/, serve/, scripts/serve_replica.py) and fails on: rule
+findings (unread/unsourced fields, optional-field subscripts, verb
+orphans, undeclared lifecycle transitions), waiver problems, or drift of
+the protocol against the golden in contracts/wire.json. An intentional
+protocol change is accepted with --update (commit the JSON diff — it is
+the PR's reviewable wire story, naming both endpoints of every changed
+field). The runtime half is dalle_tpu/obs/wiretap.py: fleet_smoke/
+gateway_smoke tap every live frame and assert observed ⊆ this golden.
+
+Waivers are source comments on the finding's line or the line above
+(``# graftwire: allow=wire-field-unread -- <reason>``); see
+docs/ANALYSIS.md.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+# pure-AST analysis — but the analysis package import pulls jax via the
+# vmem rule; keep it on CPU so auditing never touches an accelerator
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument("--check", action="store_true",
+                      help="findings + golden drift (default)")
+    mode.add_argument("--update", action="store_true",
+                      help="regenerate the golden protocol contract")
+    mode.add_argument("--explain", action="store_true",
+                      help="pretty-print the live protocol model")
+    ap.add_argument("--contract",
+                    default=os.path.join(ROOT, "contracts", "wire.json"),
+                    help="golden path (default: contracts/wire.json)")
+    ap.add_argument("--format", choices=("text", "sarif"), default="text",
+                    help="finding output format (sarif: a SARIF 2.1.0 "
+                         "document on stdout for GitHub PR annotation)")
+    ap.add_argument("--report", metavar="DIR",
+                    help="write report.txt + findings.json + wire.sarif "
+                         "into DIR (CI artifact)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    from dalle_tpu.analysis import rules_wire as R
+    from dalle_tpu.analysis.core import to_sarif
+
+    if args.list_rules:
+        width = max(len(n) for n in R.WIRE_RULES)
+        for name, desc in sorted(R.WIRE_RULES.items()):
+            print(f"{name:<{width}}  {desc}")
+        return 0
+
+    if args.explain:
+        report = R.audit(ROOT, args.contract, update=False)
+        print(R.explain(report.model))
+        return 0
+
+    report = R.audit(ROOT, args.contract, update=bool(args.update))
+    n_chan = sum(1 for (v, d, k) in report.model.channels()
+                 if not (d == "stream" and k is None))
+    scope = (f"{n_chan} channels, "
+             f"{len({u.verb for u in report.model.sent_verbs})} verbs, "
+             f"{len({e.name for e in report.model.events})} event names")
+    text = R.render_report(report, scope)
+    if args.format == "sarif":
+        print(json.dumps(to_sarif(report.findings, "graftwire",
+                                  R.WIRE_RULES), indent=1))
+        print(text, file=sys.stderr)
+    else:
+        print(text)
+
+    if args.report:
+        os.makedirs(args.report, exist_ok=True)
+        with open(os.path.join(args.report, "report.txt"), "w",
+                  encoding="utf-8") as fh:
+            fh.write(text + "\n")
+        with open(os.path.join(args.report, "findings.json"), "w",
+                  encoding="utf-8") as fh:
+            json.dump({"findings": [vars(f) for f in report.findings],
+                       "waived": [{**vars(f), "reason": r}
+                                  for f, r in report.waived],
+                       "problems": report.problems,
+                       "drift": report.drift}, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        with open(os.path.join(args.report, "wire.sarif"), "w",
+                  encoding="utf-8") as fh:
+            json.dump(to_sarif(report.findings, "graftwire", R.WIRE_RULES),
+                      fh, indent=1)
+            fh.write("\n")
+
+    # distinct exit codes, graftir-style: 1 = findings/waiver problems/
+    # contract drift (a regression); 3 = ONLY a missing golden (first run —
+    # needs --update, not a code change)
+    if report.failed:
+        return 1
+    if report.missing:
+        print("wire_audit: exit 3 — golden protocol contract MISSING; run "
+              "scripts/wire_audit.py --update and commit contracts/wire.json")
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

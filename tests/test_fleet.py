@@ -88,6 +88,116 @@ def test_frame_timeout_raises():
         b.close()
 
 
+def test_oversize_length_prefix_is_a_protocol_error(tracer):
+    """A corrupt/hostile 4-byte length beyond MAX_FRAME_BYTES raises a
+    clear TransportError BEFORE any allocation is attempted, on both the
+    one-shot reader and the buffered stream reader, and each counts as
+    fleet.protocol_errors_total{kind=oversize_frame}."""
+    from dalle_tpu import obs
+    from dalle_tpu.fleet import TransportError, recv_frame
+    from dalle_tpu.fleet.transport import _LEN, MAX_FRAME_BYTES, _FrameReader
+    a, b = socket.socketpair()
+    try:
+        a.sendall(_LEN.pack(MAX_FRAME_BYTES + 1))
+        with pytest.raises(TransportError, match="exceeds"):
+            recv_frame(b, timeout=2.0)
+    finally:
+        a.close()
+        b.close()
+    a, b = socket.socketpair()
+    try:
+        a.sendall(_LEN.pack(MAX_FRAME_BYTES + 1))
+        with pytest.raises(TransportError, match="exceeds"):
+            _FrameReader(b).read(timeout=2.0)
+    finally:
+        a.close()
+        b.close()
+    snap = obs.metrics_snapshot()
+    assert snap['fleet.protocol_errors_total{kind="oversize_frame"}'] == 2
+
+
+def test_truncated_frame_mid_payload_counts_torn(tracer):
+    """A connection dying mid-body raises (never silently truncates) on
+    both readers and counts as protocol_errors_total{kind=torn_frame}."""
+    from dalle_tpu import obs
+    from dalle_tpu.fleet import TransportError, recv_frame
+    from dalle_tpu.fleet.transport import _FrameReader
+    for reader in (lambda s: recv_frame(s, timeout=2.0),
+                   lambda s: _FrameReader(s).read(timeout=2.0)):
+        a, b = socket.socketpair()
+        try:
+            a.sendall(b"\x00\x00\x00\x10{\"par")
+            a.close()
+            with pytest.raises(TransportError, match="torn frame"):
+                reader(b)
+        finally:
+            b.close()
+    snap = obs.metrics_snapshot()
+    assert snap['fleet.protocol_errors_total{kind="torn_frame"}'] == 2
+
+
+def test_undecodable_frame_body_counts_bad_json(tracer):
+    from dalle_tpu import obs
+    from dalle_tpu.fleet import TransportError, recv_frame
+    from dalle_tpu.fleet.transport import _LEN, _FrameReader
+    body = b"}{ not json"
+    for reader in (lambda s: recv_frame(s, timeout=2.0),
+                   lambda s: _FrameReader(s).read(timeout=2.0)):
+        a, b = socket.socketpair()
+        try:
+            a.sendall(_LEN.pack(len(body)) + body)
+            with pytest.raises(TransportError, match="undecodable"):
+                reader(b)
+        finally:
+            a.close()
+            b.close()
+    snap = obs.metrics_snapshot()
+    assert snap['fleet.protocol_errors_total{kind="bad_json"}'] == 2
+
+
+def test_unknown_verb_typed_error_and_counter(remote_pair):
+    """A verb the server does not dispatch draws the unknown_verb error
+    ack; the client surfaces a TYPED ReplicaFailure promptly (no hung
+    RemoteReplica waiting on a stream) and the protocol-error counter
+    records the disagreement."""
+    from dalle_tpu import obs
+    from dalle_tpu.fleet import call
+    from dalle_tpu.fleet.transport import RemoteResultStream
+    from dalle_tpu.gateway.replica import ReplicaFailure
+    _rep, srv, rem = remote_pair()
+    assert call(srv.addr, {"verb": "bogus"}) == {"error": "unknown_verb",
+                                                 "detail": "bogus"}
+    t0 = time.monotonic()
+    with pytest.raises(ReplicaFailure, match="unknown_verb"):
+        rem._open_stream({"verb": "bogus"}, RemoteResultStream)
+    assert time.monotonic() - t0 < 5.0
+    snap = obs.metrics_snapshot()
+    assert snap['fleet.protocol_errors_total{kind="unknown_verb"}'] == 1
+
+
+def test_handshake_refusal_typed_error_and_counter(tracer):
+    """A replica process that exits before its handshake surfaces as a
+    typed SpawnError naming the exit code — not a hang — and counts as
+    fleet.protocol_errors_total{kind=handshake}."""
+    import subprocess
+    import sys
+    from dalle_tpu import obs
+    from dalle_tpu.fleet import SpawnError
+    from dalle_tpu.fleet.manager import _read_handshake
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "print('refusing to serve'); raise SystemExit(7)"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        with pytest.raises(SpawnError, match="before handshake"):
+            _read_handshake(proc, timeout_s=10.0)
+    finally:
+        proc.wait(timeout=10)
+        proc.stdout.close()
+    snap = obs.metrics_snapshot()
+    assert snap['fleet.protocol_errors_total{kind="handshake"}'] == 1
+
+
 # ---------------------------------------------------------------------------
 # fake engine: deterministic tokens, semaphore-paced rows — lets transport
 # and failover tests hold a stream open without a device in sight

@@ -1,0 +1,531 @@
+"""graftwire — the static wire-protocol model, its rules, the golden
+protocol-contract workflow, and the runtime frame tap.
+
+Fixture style mirrors test_sync_flow.py: small synthetic sources fed
+through ``build_model`` (keyed on the REAL endpoint-map paths/qualnames so
+the curated ENDPOINTS specs apply), plus repo-level invariants (the tree
+stays wire-clean; the committed golden matches the live model) and the two
+injected-drift acceptance cases: a new field on the health reply must
+produce a drift line naming the verb, the field and both endpoint sites,
+and an unmapped ``record_event`` must be an
+undeclared-lifecycle-transition finding.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+from dalle_tpu.analysis import rules_wire, wire_flow
+from dalle_tpu.analysis.wire_flow import (
+    build_model, build_repo_model, lifecycle_cycles,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(ROOT, "contracts", "wire.json")
+
+# synthetic sources are parsed AS this file so the curated ENDPOINTS map
+# (keyed path::qualname) classifies their sends/reads onto real channels
+_TP = "dalle_tpu/fleet/transport.py"
+
+
+def model_of(src, path=_TP):
+    return build_model([(path, textwrap.dedent(src))])
+
+
+def findings_of(src, rule, path=_TP):
+    return [f for f in rules_wire.run_wire(model_of(src, path))
+            if f.rule == rule]
+
+
+def _repo_sources():
+    out = {}
+    for rel in wire_flow.wire_files(ROOT):
+        with open(os.path.join(ROOT, rel), encoding="utf-8") as fh:
+            out[rel] = fh.read()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# shape extraction: literals, incremental builds, optional spreads, verbs
+# ---------------------------------------------------------------------------
+
+WIRE_FIX = """
+    class ReplicaServer:
+        def _serve_conn(self, conn):
+            msg = recv_frame(conn)
+            verb = msg.get("verb")
+            if verb == "submit":
+                self._handle_submit(conn, msg)
+            elif verb == "health":
+                send_frame(conn, self._health(msg))
+            else:
+                send_frame(conn, {"error": "unknown_verb", "detail": verb})
+
+        def _health(self, msg):
+            h = {"ok": True, "slots": 4,
+                 **({"wedged": True} if msg else {})}
+            h.update(pid=123)
+            h["step"] = 7
+            h.setdefault("draining", False)
+            return h
+
+        def _submit_kwargs(self, msg):
+            d = msg.get("deadline")
+
+        def _handle_submit(self, conn, msg):
+            send_frame(conn, {"ok": True, "junk": 1})
+            send_frame(conn, {"kind": "row", "row": 0, "tokens": []})
+            send_frame(conn, {"kind": "done", "rows": 2})
+
+        def _handle_group(self, conn, msg):
+            send_frame(conn, {"ok": True})
+
+
+    class RemoteReplica:
+        def _track_progress(self, h):
+            step = h["step"]
+            wedged = h["wedged"]
+            ok = h.get("ok")
+
+        def _open_stream(self, req, cls):
+            ack = recv_frame(self._sock)
+            if not ack.get("ok"):
+                err = ack.get("missing")
+
+
+    class RemoteResultStream:
+        def events(self):
+            frame = recv_frame(self._sock)
+            k = frame.get("kind")
+            r = frame.get("row")
+            t = frame.get("tokens")
+            n = frame.get("rows")
+
+
+    def client_call(addr):
+        call(addr, {"verb": "submit", "deadline": 1.0})
+        call(addr, {"verb": "teleport"})
+    """
+
+
+def test_incremental_dict_build_and_optional_spread():
+    ch = model_of(WIRE_FIX).channels()[("health", "reply", None)]
+    # literal + update(kw=) + subscript assign + setdefault all land
+    assert ch.sent_fields == {"ok", "slots", "wedged", "pid", "step",
+                             "draining"}
+    # **({...} if cond else {}) keys are conditionally present
+    assert ch.optional_fields == {"wedged"}
+    assert not ch.dynamic
+
+
+def test_verb_requests_and_stream_subchannels():
+    channels = model_of(WIRE_FIX).channels()
+    assert channels[("submit", "request", None)].sent_fields == \
+        {"verb", "deadline"}
+    assert channels[("submit", "stream", "row")].sent_fields == \
+        {"kind", "row", "tokens"}
+    assert channels[("submit", "stream", "done")].sent_fields == \
+        {"kind", "rows"}
+    # the kind-agnostic reader is fanned onto every concrete sub-channel
+    assert "row" in channels[("submit", "stream", "row")].read_fields
+    assert "rows" in channels[("submit", "stream", "done")].read_fields
+
+
+def test_call_fed_dict_is_dynamic():
+    src = """
+    class ReplicaServer:
+        def _telemetry(self, msg):
+            body = telemetry_payload(self._tel)
+            return body
+    """
+    ch = model_of(src).channels()[("telemetry", "reply", None)]
+    assert ch.dynamic and ch.sent_fields == set()
+
+
+def test_nested_handler_class_keeps_its_qualname():
+    """The gateway's Handler is a class nested inside _make_handler; the
+    walker must keep the class segment or the SSE endpoint map misses."""
+    model = build_repo_model(ROOT)
+    ch = model.channels().get(("sse", "stream", "*"))
+    assert ch is not None and ch.senders
+    sites = {s.site for s in ch.senders}
+    assert ("dalle_tpu/gateway/server.py::_make_handler.Handler._stream"
+            in sites)
+
+
+# ---------------------------------------------------------------------------
+# the rules
+# ---------------------------------------------------------------------------
+
+def test_unread_field_flagged_with_both_sites():
+    found = findings_of(WIRE_FIX, "wire-field-unread")
+    assert len(found) == 1
+    assert "'junk'" in found[0].message
+    assert "submit.reply" in found[0].message
+    assert "_handle_submit" in found[0].message       # sender site
+    assert "_open_stream" in found[0].message         # mapped receiver
+
+
+def test_unsourced_read_flagged_once_across_overlapping_channels():
+    # ack.get("missing") maps to submit/submit_group/any replies; no
+    # sender of ANY of them sets it -> exactly one finding at the read
+    found = findings_of(WIRE_FIX, "wire-field-unsourced")
+    assert len(found) == 1
+    assert "'missing'" in found[0].message
+    assert "_open_stream" in found[0].message
+    assert "default forever" in found[0].message
+
+
+def test_sourced_anywhere_suppresses_the_overlap_false_positive():
+    # "ok" is set by submit.reply but NOT by any.reply — the shared read
+    # must stay clean (the variable holds one message at runtime)
+    found = findings_of(WIRE_FIX, "wire-field-unsourced")
+    assert all("'ok'" not in f.message for f in found)
+
+
+def test_hard_read_of_optional_field_flagged():
+    found = findings_of(WIRE_FIX, "wire-optional-no-default")
+    assert len(found) == 1
+    assert "'wedged'" in found[0].message
+    assert "health.reply" in found[0].message
+    assert "KeyError" in found[0].message
+    # the required field read the same way is fine
+    assert all("'step'" not in f.message for f in found)
+
+
+def test_verb_orphans_both_directions():
+    found = findings_of(WIRE_FIX, "wire-verb-orphan")
+    msgs = "\n".join(f.message for f in found)
+    assert len(found) == 2
+    assert "'teleport' is sent" in msgs and "unknown_verb" in msgs
+    assert "'health' is dispatched" in msgs and "no client" in msgs
+
+
+def test_unmapped_record_event_flagged():
+    src = """
+    def _bogus_probe():
+        record_event("bogus_event_name", x=1)
+    """
+    found = findings_of(src, "undeclared-lifecycle-transition")
+    assert len(found) == 1
+    assert "bogus_event_name" in found[0].message
+    assert "_bogus_probe" in found[0].message
+    assert "EVENT_EDGES" in found[0].message
+
+
+def test_event_claiming_undeclared_edge_flagged(monkeypatch):
+    monkeypatch.setitem(wire_flow.EVENT_EDGES, "request_completed",
+                        (("request", "done", "submitted"),))
+    src = """
+    def _finish():
+        record_event("request_completed")
+    """
+    found = findings_of(src, "undeclared-lifecycle-transition")
+    assert len(found) == 1
+    assert "done->submitted" in found[0].message
+    assert "does not declare" in found[0].message
+
+
+def test_lifecycle_cycle_detection():
+    assert lifecycle_cycles() == []                  # the shipped machines
+    cyc = lifecycle_cycles({"m": {"edges": (("a", "b"), ("b", "a"))}})
+    assert len(cyc) == 1 and cyc[0][0] == "m"
+
+
+# ---------------------------------------------------------------------------
+# waivers (through the full audit pipeline on a tmp repo)
+# ---------------------------------------------------------------------------
+
+def _tmp_audit(tmp_path, source, update=False):
+    mod = tmp_path / "dalle_tpu" / "fleet" / "transport.py"
+    mod.parent.mkdir(parents=True, exist_ok=True)
+    mod.write_text(textwrap.dedent(source))
+    return rules_wire.audit(repo_root=str(tmp_path),
+                            contract_path=str(tmp_path / "wire.json"),
+                            update=update, paths=[_TP])
+
+
+def test_waiver_with_reason_suppresses_finding(tmp_path):
+    src = WIRE_FIX.replace(
+        '            send_frame(conn, {"ok": True, "junk": 1})',
+        '            # graftwire: allow=wire-field-unread -- operator '
+        'dashboard field, reader lands next PR\n'
+        '            send_frame(conn, {"ok": True, "junk": 1})')
+    report = _tmp_audit(tmp_path, src)
+    assert all(f.rule != "wire-field-unread" for f in report.findings)
+    waived_rules = [f.rule for f, _ in report.waived]
+    assert waived_rules == ["wire-field-unread"]
+    assert "dashboard" in report.waived[0][1]
+    assert all("wire-field-unread" not in p for p in report.problems)
+
+
+def test_waiver_without_reason_is_a_problem(tmp_path):
+    src = WIRE_FIX.replace(
+        '            send_frame(conn, {"ok": True, "junk": 1})',
+        '            # graftwire: allow=wire-field-unread\n'
+        '            send_frame(conn, {"ok": True, "junk": 1})')
+    report = _tmp_audit(tmp_path, src)
+    assert any("has no reason" in p for p in report.problems)
+    assert any(f.rule == "wire-field-unread" for f in report.findings)
+
+
+def test_waiver_with_unknown_rule_is_a_problem(tmp_path):
+    src = WIRE_FIX.replace(
+        '            send_frame(conn, {"ok": True, "junk": 1})',
+        '            # graftwire: allow=wire-feild-unread -- typo\n'
+        '            send_frame(conn, {"ok": True, "junk": 1})')
+    report = _tmp_audit(tmp_path, src)
+    assert any("unknown graftwire rule" in p for p in report.problems)
+
+
+# ---------------------------------------------------------------------------
+# golden protocol-contract workflow
+# ---------------------------------------------------------------------------
+
+CLEAN_FIX = """
+    class ReplicaServer:
+        def _serve_conn(self, conn):
+            msg = recv_frame(conn)
+            verb = msg.get("verb")
+            if verb == "submit":
+                pass
+
+        def _handle_submit(self, conn, msg):
+            send_frame(conn, {"ok": True})
+
+
+    class RemoteReplica:
+        def _open_stream(self, req, cls):
+            ack = recv_frame(self._sock)
+            ok = ack.get("ok")
+
+
+    def client_call(addr):
+        call(addr, {"verb": "submit"})
+    """
+
+
+def test_golden_roundtrip_then_drift(tmp_path):
+    report = _tmp_audit(tmp_path, CLEAN_FIX, update=True)
+    assert report.updated and not report.failed
+    assert (tmp_path / "wire.json").exists()
+
+    # unchanged source: clean check, no drift
+    report = _tmp_audit(tmp_path, CLEAN_FIX)
+    assert not report.failed and not report.missing
+    assert report.drift == []
+
+    # a new reply field drifts, named with verb + field + endpoint sites
+    report = _tmp_audit(tmp_path, CLEAN_FIX.replace(
+        '{"ok": True}', '{"ok": True, "extra": 1}'))
+    assert report.failed
+    [line] = [d for d in report.drift if d.startswith("+ field")]
+    assert line.startswith("+ field submit.reply extra")
+    assert "_handle_submit" in line and "_open_stream" in line
+
+    # a removed sender drifts too (the reader keeps the channel alive)
+    report = _tmp_audit(tmp_path, CLEAN_FIX.replace(
+        'call(addr, {"verb": "submit"})', "pass"))
+    assert report.failed
+    assert any(d.startswith("- sender submit.request")
+               for d in report.drift)
+
+
+def test_missing_golden_is_distinct_from_drift(tmp_path):
+    report = _tmp_audit(tmp_path, CLEAN_FIX)
+    assert report.missing and not report.failed
+
+
+def _run_audit_cli(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "wire_audit.py"),
+         *args],
+        cwd=ROOT, capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+
+def test_cli_exit_codes_missing_vs_drift(tmp_path):
+    # missing golden: the distinct exit 3 (needs --update, not a code fix)
+    r = _run_audit_cli("--check", "--contract", str(tmp_path / "nope.json"))
+    assert r.returncode == 3, r.stdout + r.stderr
+    assert "MISSING" in r.stdout
+
+    # doctored golden (one health-reply field dropped): real drift, exit 1
+    golden = json.load(open(GOLDEN))
+    fields = golden["verbs"]["health"]["reply"]["sender"]["fields"]
+    assert fields, "repo golden has no health-reply fields to doctor"
+    doctored_path = tmp_path / "doctored.json"
+    doctored_path.write_text(json.dumps(golden))
+    fields.pop()
+    doctored_path.write_text(json.dumps(golden))
+    r = _run_audit_cli("--check", "--contract", str(doctored_path))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "wire-contract drift: + field health.reply" in r.stdout
+
+
+def test_cli_list_rules():
+    r = _run_audit_cli("--list-rules")
+    assert r.returncode == 0
+    for rule in rules_wire.WIRE_RULES:
+        assert rule in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# injected-drift acceptance: a new field on the health reply
+# ---------------------------------------------------------------------------
+
+def test_injected_health_field_names_verb_field_and_both_sites():
+    files = _repo_sources()
+    src = files[_TP]
+    anchor = "ok=True, pid=os.getpid(),"
+    assert src.count(anchor) == 1, "health-reply builder moved; fix anchor"
+    files[_TP] = src.replace(anchor,
+                             "ok=True, pid=os.getpid(), extra_field=1,")
+    model = build_model(sorted(files.items()))
+    drift = rules_wire.diff_contract(json.load(open(GOLDEN)),
+                                     rules_wire.wire_contract(model))
+    assert len(drift) == 1, drift
+    line = drift[0]
+    assert line.startswith("+ field health.reply extra_field")
+    # both endpoint sites: every sender of the channel, every receiver
+    assert "dalle_tpu/fleet/transport.py::ReplicaServer._health" in line
+    assert "dalle_tpu/gateway/replica.py::Replica.health" in line
+    assert "receiver" in line
+    assert "dalle_tpu/fleet/controller.py::FleetController._degraded" in line
+
+
+def test_injected_undeclared_event_in_a_wire_root():
+    files = _repo_sources()
+    files[_TP] += ("\n\ndef _bogus_probe():\n"
+                   "    record_event(\"bogus_event_name\", x=1)\n")
+    model = build_model(sorted(files.items()))
+    found = [f for f in rules_wire.run_wire(model)
+             if f.rule == "undeclared-lifecycle-transition"]
+    assert len(found) == 1
+    assert found[0].path == _TP
+    assert "bogus_event_name" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# repo-level invariants
+# ---------------------------------------------------------------------------
+
+def test_repo_is_wire_clean():
+    """The real wire roots carry no graftwire findings — not even waived
+    ones — and match the committed golden: the same invariant ci_local's
+    graftwire stage and the ci.yml step enforce."""
+    report = rules_wire.audit(repo_root=ROOT, contract_path=GOLDEN)
+    msgs = [str(f) for f in report.findings] \
+        + [f"waiver-problem: {p}" for p in report.problems] \
+        + [f"drift: {d}" for d in report.drift]
+    assert not report.missing, "golden contracts/wire.json missing"
+    assert not report.failed, "\n".join(msgs)
+    assert report.waived == [], "wire roots must carry zero waivers"
+
+
+def test_golden_is_schema_current_and_acyclic():
+    golden = json.load(open(GOLDEN))
+    assert golden["schema"] == rules_wire.SCHEMA
+    machines = {n: {"edges": [tuple(e) for e in m["edges"]]}
+                for n, m in golden["lifecycles"].items()}
+    assert lifecycle_cycles(machines) == []
+    # every declared edge stays within its machine's state set
+    for name, m in golden["lifecycles"].items():
+        states = set(m["states"])
+        for s, d in m["edges"]:
+            assert s in states and d in states
+
+
+def test_golden_events_reference_declared_edges():
+    golden = json.load(open(GOLDEN))
+    declared = {f"{name}:{s}->{d}"
+                for name, m in golden["lifecycles"].items()
+                for s, d in m["edges"]}
+    for name, entry in golden["events"].items():
+        for edge in entry["edges"]:
+            assert edge in declared, f"event {name} claims {edge}"
+        assert entry["sites"], f"event {name} has no emission site"
+
+
+# ---------------------------------------------------------------------------
+# runtime frame tap (obs/wiretap.py)
+# ---------------------------------------------------------------------------
+
+def test_wiretap_records_real_frames_and_conforms():
+    from dalle_tpu.fleet import transport
+    from dalle_tpu.obs import wiretap
+    golden = json.load(open(GOLDEN))
+    req = {f: 1 for f in
+           golden["verbs"]["health"]["request"]["sender"]["fields"]}
+    req["verb"] = "health"
+    wiretap.install()
+    try:
+        wiretap.reset()
+        a, b = socket.socketpair()
+        try:
+            transport.send_frame(a, req)
+            assert transport.recv_frame(b, timeout=2.0) == req
+        finally:
+            a.close()
+            b.close()
+        # send and recv of the same frame dedup to one shape
+        assert wiretap.observed() == [
+            ("health", "request", None, frozenset(req))]
+        assert wiretap.conformance(golden) == []
+        # a verb outside the contract is a violation
+        wiretap._tap("send", {"verb": "teleport"})
+        violations = wiretap.conformance(golden)
+        assert len(violations) == 1
+        assert "teleport" in str(violations[0])
+        wiretap.reset()
+        assert wiretap.observed() == []
+    finally:
+        wiretap.uninstall()
+    assert transport._frame_tap is None and not wiretap.installed()
+
+
+SYNTH_GOLDEN = {"verbs": {
+    "submit": {
+        "reply": {"sender": {"fields": ["ok"], "dynamic": False}},
+        "stream": {"row": {"sender": {"fields": ["kind", "row"],
+                                      "dynamic": False}}},
+    },
+    # HTTP-side pseudo-verb: must NOT wildcard-cover transport frames
+    "sse": {"stream": {"*": {"sender": {"fields": [], "dynamic": True}}}},
+}}
+
+
+def test_wiretap_classification():
+    from dalle_tpu.obs import wiretap
+    assert wiretap._classify("send", {"verb": "submit", "deadline": 1}) \
+        == ("submit", "request", None, frozenset({"verb", "deadline"}))
+    assert wiretap._classify("recv", {"kind": "row", "row": 0}) \
+        == (None, "stream", "row", frozenset({"kind", "row"}))
+    assert wiretap._classify("recv", {"ok": True}) \
+        == (None, "reply", None, frozenset({"ok"}))
+
+
+def test_wiretap_conformance_violation_kinds():
+    from dalle_tpu.obs import wiretap
+    wiretap.reset()
+
+    def violations_of(frame):
+        wiretap.reset()
+        wiretap._tap("send", frame)
+        out = wiretap.conformance(SYNTH_GOLDEN)
+        wiretap.reset()
+        return out
+
+    assert violations_of({"ok": True}) == []                 # reply covered
+    assert violations_of({"kind": "row", "row": 0}) == []    # stream covered
+    [v] = violations_of({"nope": 1})                         # unknown reply
+    assert "reply fields not covered" in v.why
+    [v] = violations_of({"kind": "row", "row": 0, "extra": 1})
+    assert "stream fields not covered" in v.why
+    # the sse "*" dynamic sender is excluded from the tap's view: an
+    # unknown stream kind still violates
+    [v] = violations_of({"kind": "bogus_kind"})
+    assert "not in the golden" in v.why
